@@ -1,0 +1,32 @@
+"""pytorch_operator_tpu — a TPU-native distributed training job framework.
+
+A ground-up rebuild of the capabilities of the Kubeflow PyTorch operator
+(reference: sd3g14/pytorch-operator, a fork of kubeflow/pytorch-operator —
+see SURVEY.md for the structural analysis) designed TPU-first:
+
+- ``api``        — the TPUJob spec: typed job objects, defaulting, validation,
+                   YAML serialization (mirrors ``pkg/apis/pytorch/v1/``).
+- ``controller`` — the supervisor/reconciler: gang process launch, restart
+                   policies, the Created→Running→Succeeded/Failed/Restarting
+                   condition state machine, cleanup, events, metrics (mirrors
+                   ``pkg/controller.v1/pytorch/`` + the vendored
+                   ``kubeflow/common`` job framework).
+- ``runtime``    — cluster-spec env injection and jax.distributed rendezvous
+                   (mirrors ``SetClusterSpec`` in ``pod.go``; replaces the
+                   c10d MASTER_ADDR/NCCL wiring with PJRT/XLA-collective
+                   equivalents per BASELINE.json:5).
+- ``parallel``   — device meshes, sharding rules, collectives: the TPU-native
+                   stand-in for the NCCL/Gloo layer the reference delegated to
+                   user containers.
+- ``models`` / ``ops`` — JAX/flax workload model zoo (MNIST, ResNet-50, BERT,
+                   Llama) and TPU kernels (attention, etc.).
+- ``workloads``  — runnable training entrypoints launched by the supervisor
+                   (mirrors ``examples/`` of the reference).
+- ``client``     — the ``tpujob`` CLI (submit/get/describe/logs/delete), the
+                   stand-in for kubectl+CRD.
+
+The control plane is pure Python with no jax import at module scope, so the
+supervisor stays lightweight; workload processes import jax themselves.
+"""
+
+__version__ = "0.1.0"

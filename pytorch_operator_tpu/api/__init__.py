@@ -1,0 +1,40 @@
+"""TPUJob API: types, defaulting, validation, serialization.
+
+Mirror of the reference's ``pkg/apis/pytorch/v1/`` (SURVEY.md §1 layer 1).
+"""
+
+from .types import (  # noqa: F401
+    API_VERSION,
+    DEFAULT_PORT,
+    DEFAULT_PORT_NAME,
+    KIND,
+    RETRYABLE_EXIT_CODE_MIN,
+    TERMINAL_CONDITIONS,
+    CleanPodPolicy,
+    ConditionType,
+    ElasticPolicy,
+    JobCondition,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaPhase,
+    ReplicaSpec,
+    ReplicaStatus,
+    ReplicaType,
+    Resources,
+    RestartPolicy,
+    RunPolicy,
+    SchedulingPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUJobStatus,
+)
+from .defaults import set_defaults  # noqa: F401
+from .validation import ValidationError, validate, validate_spec  # noqa: F401
+from .serialization import (  # noqa: F401
+    dump_job,
+    dump_job_json,
+    job_from_dict,
+    load_job,
+    loads_job,
+    save_job,
+)

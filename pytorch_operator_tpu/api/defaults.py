@@ -1,0 +1,51 @@
+"""Defaulting for TPUJob specs.
+
+Reference: ``SetDefaults_PyTorchJob`` in ``pkg/apis/pytorch/v1/defaults.go``
+(SURVEY.md §2 "Defaulting"): default port 23456, default replicas=1, default
+restart policy, default cleanup policy.
+
+Deviations, documented:
+
+- The upstream default CleanPodPolicy is believed version-dependent
+  (SURVEY.md tags it without a committed value). Locally, leaving worker
+  *processes* running after job end leaks real PIDs on the host — unlike k8s
+  pods there is no kubelet to reap them — so the default here is RUNNING
+  (terminate still-running replicas when the job finishes). ``None`` remains
+  selectable for parity.
+- Default restart policy is ON_FAILURE (the sensible default for training
+  replicas; upstream exact default is version-dependent).
+"""
+
+from __future__ import annotations
+
+from .types import (
+    DEFAULT_PORT,
+    CleanPodPolicy,
+    RestartPolicy,
+    TPUJob,
+)
+
+
+def set_defaults(job: TPUJob) -> TPUJob:
+    """Fill defaulted fields in place (idempotent); returns the job."""
+    spec = job.spec
+
+    if spec.port is None:
+        spec.port = DEFAULT_PORT
+
+    for rs in spec.replica_specs.values():
+        if rs.replicas is None:
+            rs.replicas = 1
+        if rs.restart_policy is None:
+            rs.restart_policy = RestartPolicy.ON_FAILURE
+
+    rp = spec.run_policy
+    if rp.clean_pod_policy is None:
+        rp.clean_pod_policy = CleanPodPolicy.RUNNING
+    if rp.scheduling_policy.min_available is None:
+        rp.scheduling_policy.min_available = spec.total_replicas()
+
+    if not job.metadata.namespace:
+        job.metadata.namespace = "default"
+
+    return job

@@ -1,0 +1,49 @@
+"""YAML/JSON (de)serialization for TPUJob.
+
+The kubectl-apply surface of the reference (CRD YAML under ``manifests/`` and
+``examples/*.yaml``; SURVEY.md §1 layers 6–7) becomes plain YAML files loaded
+into :class:`~pytorch_operator_tpu.api.types.TPUJob` dataclasses.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Union
+
+import yaml
+
+from .types import TPUJob
+
+
+def job_from_dict(d: dict) -> TPUJob:
+    return TPUJob.from_dict(d)
+
+
+def load_job(path: Union[str, Path]) -> TPUJob:
+    """Load a TPUJob from a YAML (or JSON) file."""
+    text = Path(path).read_text()
+    data = yaml.safe_load(text)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a mapping at the top level")
+    return job_from_dict(data)
+
+
+def loads_job(text: str) -> TPUJob:
+    data = yaml.safe_load(text)
+    if not isinstance(data, dict):
+        raise ValueError("expected a mapping at the top level")
+    return job_from_dict(data)
+
+
+def dump_job(job: TPUJob) -> str:
+    """Serialize a TPUJob to YAML (round-trips through from_dict)."""
+    return yaml.safe_dump(job.to_dict(), sort_keys=False)
+
+
+def dump_job_json(job: TPUJob) -> str:
+    return json.dumps(job.to_dict(), indent=2)
+
+
+def save_job(job: TPUJob, path: Union[str, Path]) -> None:
+    Path(path).write_text(dump_job(job))

@@ -1,0 +1,618 @@
+"""TPUJob API types.
+
+TPU-native re-design of the reference's CRD types (reference:
+``pkg/apis/pytorch/v1/types.go`` plus the shared ``ReplicaSpec``/``RunPolicy``/
+``JobCondition`` types vendored from ``kubeflow/common``; see SURVEY.md §2
+rows 1–4). Where the reference describes Kubernetes pods, this API describes
+local worker *processes* that rendezvous via ``jax.distributed`` and compute
+with XLA collectives over ICI/DCN (BASELINE.json:5).
+
+Design notes (TPU-first, not a translation):
+
+- There is no apimachinery; these are plain dataclasses with explicit
+  ``to_dict``/``from_dict`` used by the YAML layer (serialization.py).
+- A "pod template" becomes a :class:`ProcessTemplate` — argv or a python
+  module, env, resource request (TPU chip count), working dir.
+- The rendezvous port (reference default 23456, port name
+  ``pytorchjob-port``) becomes the jax.distributed coordinator port.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+API_VERSION = "tpujob.dev/v1"
+KIND = "TPUJob"
+
+
+def _parse_enum(enum_cls, value, field_path: str):
+    """Coerce a raw spec value to an enum, failing with a field-pathed,
+    valid-values-listing error instead of the bare Enum ValueError."""
+    try:
+        return enum_cls(value)
+    except ValueError:
+        valid = ", ".join(e.value for e in enum_cls)
+        raise ValueError(
+            f"{field_path}: unknown value {value!r} (valid: {valid})"
+        ) from None
+
+
+def _parse_int(value, field_path: str) -> int:
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise ValueError(f"{field_path}: invalid integer {value!r}") from None
+
+# Reference parity: default rendezvous port and port name
+# (pkg/apis/pytorch/v1/defaults.go — SURVEY.md §2 "Defaulting").
+DEFAULT_PORT = 23456
+DEFAULT_PORT_NAME = "tpujob-port"
+
+
+class ReplicaType(str, enum.Enum):
+    """Replica roles. Reference: PyTorchReplicaType (Master exactly-1, Worker 0..N)."""
+
+    MASTER = "Master"
+    WORKER = "Worker"
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return self.value
+
+
+class RestartPolicy(str, enum.Enum):
+    """Per-replica restart policy.
+
+    Reference semantics (SURVEY.md §2 "Restart policies"):
+      - ALWAYS: restart the process on any exit, success included.
+      - ON_FAILURE: restart only on nonzero exit.
+      - NEVER: never restart; a failure fails the job.
+      - EXIT_CODE: exit 1–127 is a permanent failure (job fails); exit >=128
+        (signal-ish / infrastructure codes, e.g. SIGKILL=137 on preemption)
+        is retryable and triggers a restart.
+    """
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    EXIT_CODE = "ExitCode"
+
+
+class CleanPodPolicy(str, enum.Enum):
+    """What to do with worker processes when the job finishes.
+
+    Reference: CleanPodPolicy All/Running/None (SURVEY.md §2 "Job lifecycle").
+    Locally: RUNNING terminates still-running processes; ALL additionally
+    removes per-replica artifacts (log files); NONE leaves processes alone
+    (they are reparented, not killed — matches "leave pods around").
+    """
+
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+
+class ConditionType(str, enum.Enum):
+    """Job condition types — the state machine the reference drives in
+    ``pkg/controller.v1/pytorch/status.go`` (SURVEY.md §2 "Status engine")."""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+# Terminal condition types: once one of these is true the job is finished.
+TERMINAL_CONDITIONS = (ConditionType.SUCCEEDED, ConditionType.FAILED)
+
+# ExitCode policy boundary: reference classifies exit 1-127 permanent,
+# >=128 retryable (SURVEY.md §2 "Restart policies").
+RETRYABLE_EXIT_CODE_MIN = 128
+
+
+class ReplicaPhase(str, enum.Enum):
+    """Phase of one replica process (pod-phase analog)."""
+
+    PENDING = "Pending"    # created in the store, not yet started (gang hold)
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class Resources:
+    """Resource request for one replica process.
+
+    The reference swaps ``nvidia.com/gpu`` limits for ``google.com/tpu``
+    (BASELINE.json:5 north star); here the request is TPU chips for the
+    process plus an optional CPU-device count for CPU-backend (test) runs.
+    """
+
+    tpu_chips: int = 0
+    cpu_devices: int = 0  # forces JAX_PLATFORMS=cpu with N host devices
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"tpu_chips": self.tpu_chips, "cpu_devices": self.cpu_devices}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Resources":
+        return cls(
+            tpu_chips=_parse_int(d.get("tpu_chips", 0), "resources.tpu_chips"),
+            cpu_devices=_parse_int(d.get("cpu_devices", 0), "resources.cpu_devices"),
+        )
+
+
+@dataclass
+class ProcessTemplate:
+    """Template for a replica process — the pod-template analog.
+
+    Exactly one of ``command`` (argv) or ``module`` (run as ``python -m``)
+    must be set. ``args`` are appended in either case.
+    """
+
+    command: Optional[List[str]] = None
+    module: Optional[str] = None
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    working_dir: Optional[str] = None
+    resources: Resources = field(default_factory=Resources)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.command is not None:
+            d["command"] = list(self.command)
+        if self.module is not None:
+            d["module"] = self.module
+        if self.args:
+            d["args"] = list(self.args)
+        if self.env:
+            d["env"] = dict(self.env)
+        if self.working_dir:
+            d["working_dir"] = self.working_dir
+        d["resources"] = self.resources.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ProcessTemplate":
+        return cls(
+            command=list(d["command"]) if d.get("command") is not None else None,
+            module=d.get("module"),
+            args=[str(a) for a in d.get("args", [])],
+            env={str(k): str(v) for k, v in (d.get("env") or {}).items()},
+            working_dir=d.get("working_dir"),
+            resources=Resources.from_dict(d.get("resources") or {}),
+        )
+
+
+@dataclass
+class ReplicaSpec:
+    """Spec for one replica type (reference: common ReplicaSpec)."""
+
+    replicas: Optional[int] = None  # defaulted to 1
+    restart_policy: Optional[RestartPolicy] = None  # defaulted
+    template: ProcessTemplate = field(default_factory=ProcessTemplate)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"template": self.template.to_dict()}
+        if self.replicas is not None:
+            d["replicas"] = self.replicas
+        if self.restart_policy is not None:
+            d["restart_policy"] = self.restart_policy.value
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ReplicaSpec":
+        rp = d.get("restart_policy")
+        return cls(
+            replicas=(
+                _parse_int(d["replicas"], "replicas")
+                if d.get("replicas") is not None
+                else None
+            ),
+            restart_policy=(
+                _parse_enum(RestartPolicy, rp, "restart_policy") if rp is not None else None
+            ),
+            template=ProcessTemplate.from_dict(d.get("template") or {}),
+        )
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling policy (reference: volcano PodGroup via
+    ``--enable-gang-scheduling``; SURVEY.md §2 "Gang scheduling").
+
+    ``min_available`` defaults to the total replica count — all-or-nothing.
+    """
+
+    gang: bool = True
+    min_available: Optional[int] = None
+    queue: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"gang": self.gang}
+        if self.min_available is not None:
+            d["min_available"] = self.min_available
+        if self.queue is not None:
+            d["queue"] = self.queue
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "SchedulingPolicy":
+        return cls(
+            gang=bool(d.get("gang", True)),
+            min_available=(
+                int(d["min_available"]) if d.get("min_available") is not None else None
+            ),
+            queue=d.get("queue"),
+        )
+
+
+@dataclass
+class RunPolicy:
+    """Job-level run policy (reference: common RunPolicy; SURVEY.md §2
+    "Job lifecycle / cleanup")."""
+
+    clean_pod_policy: Optional[CleanPodPolicy] = None  # defaulted
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[int] = None
+    backoff_limit: Optional[int] = None  # max total restarts before Failed
+    scheduling_policy: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"scheduling_policy": self.scheduling_policy.to_dict()}
+        if self.clean_pod_policy is not None:
+            d["clean_pod_policy"] = self.clean_pod_policy.value
+        for k in ("ttl_seconds_after_finished", "active_deadline_seconds", "backoff_limit"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RunPolicy":
+        cpp = d.get("clean_pod_policy")
+        return cls(
+            clean_pod_policy=(
+                _parse_enum(CleanPodPolicy, cpp, "run_policy.clean_pod_policy")
+                if cpp is not None
+                else None
+            ),
+            ttl_seconds_after_finished=(
+                int(d["ttl_seconds_after_finished"])
+                if d.get("ttl_seconds_after_finished") is not None
+                else None
+            ),
+            active_deadline_seconds=(
+                int(d["active_deadline_seconds"])
+                if d.get("active_deadline_seconds") is not None
+                else None
+            ),
+            backoff_limit=(
+                int(d["backoff_limit"]) if d.get("backoff_limit") is not None else None
+            ),
+            scheduling_policy=SchedulingPolicy.from_dict(d.get("scheduling_policy") or {}),
+        )
+
+
+@dataclass
+class ElasticPolicy:
+    """Elastic training policy (reference: torchelastic integration /
+    ElasticPolicy in the training-operator era; SURVEY.md §2 "Elastic",
+    BASELINE.json:11).
+
+    When set, the job may run with worker counts in [min_replicas,
+    max_replicas]; on membership change the gang is re-rendezvoused (fresh
+    jax.distributed world) from the latest checkpoint, up to ``max_restarts``
+    times.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    max_restarts: int = 10
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ElasticPolicy":
+        return cls(
+            min_replicas=int(d.get("min_replicas", 1)),
+            max_replicas=int(d.get("max_replicas", 1)),
+            max_restarts=int(d.get("max_restarts", 10)),
+        )
+
+
+@dataclass
+class TPUJobSpec:
+    """The TPUJob spec (reference: PyTorchJobSpec — RunPolicy + a map
+    ReplicaType→ReplicaSpec with Master exactly-1)."""
+
+    replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    elastic_policy: Optional[ElasticPolicy] = None
+    # Coordinator (rendezvous) port — the pytorchjob-port analog.
+    port: Optional[int] = None  # defaulted to DEFAULT_PORT
+
+    def total_replicas(self) -> int:
+        return sum(rs.replicas or 0 for rs in self.replica_specs.values())
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "replica_specs": {
+                rt.value: rs.to_dict() for rt, rs in self.replica_specs.items()
+            },
+            "run_policy": self.run_policy.to_dict(),
+        }
+        if self.elastic_policy is not None:
+            d["elastic_policy"] = self.elastic_policy.to_dict()
+        if self.port is not None:
+            d["port"] = self.port
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUJobSpec":
+        replica_specs: Dict[ReplicaType, ReplicaSpec] = {}
+        for rt, rs in (d.get("replica_specs") or {}).items():
+            rtype = _parse_enum(ReplicaType, rt, "spec.replica_specs key")
+            try:
+                replica_specs[rtype] = ReplicaSpec.from_dict(rs)
+            except ValueError as e:
+                raise ValueError(f"spec.replica_specs[{rtype.value}].{e}") from None
+        return cls(
+            replica_specs=replica_specs,
+            run_policy=RunPolicy.from_dict(d.get("run_policy") or {}),
+            elastic_policy=(
+                ElasticPolicy.from_dict(d["elastic_policy"])
+                if d.get("elastic_policy") is not None
+                else None
+            ),
+            port=int(d["port"]) if d.get("port") is not None else None,
+        )
+
+
+@dataclass
+class JobCondition:
+    """One entry in status.conditions (reference: common JobCondition)."""
+
+    type: ConditionType
+    status: bool
+    reason: str = ""
+    message: str = ""
+    last_update_time: float = 0.0
+    last_transition_time: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "type": self.type.value,
+            "status": self.status,
+            "reason": self.reason,
+            "message": self.message,
+            "last_update_time": self.last_update_time,
+            "last_transition_time": self.last_transition_time,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "JobCondition":
+        return cls(
+            type=_parse_enum(ConditionType, d.get("type"), "condition.type"),
+            status=bool(d.get("status", False)),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_update_time=float(d.get("last_update_time", 0.0)),
+            last_transition_time=float(d.get("last_transition_time", 0.0)),
+        )
+
+
+@dataclass
+class ReplicaStatus:
+    """Per-replica-type counters (reference: common ReplicaStatus)."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ReplicaStatus":
+        return cls(
+            active=int(d.get("active", 0)),
+            succeeded=int(d.get("succeeded", 0)),
+            failed=int(d.get("failed", 0)),
+        )
+
+
+@dataclass
+class TPUJobStatus:
+    """Job status (reference: PyTorchJobStatus / common JobStatus)."""
+
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[ReplicaType, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    restart_count: int = 0
+    # Observability extras (north-star metric BASELINE.json:2): wall-clock
+    # timestamps of submit-accepted and first training step, set by the
+    # supervisor from workload status reports.
+    submit_time: Optional[float] = None
+    first_step_time: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "conditions": [c.to_dict() for c in self.conditions],
+            "replica_statuses": {
+                rt.value: rs.to_dict() for rt, rs in self.replica_statuses.items()
+            },
+            "start_time": self.start_time,
+            "completion_time": self.completion_time,
+            "restart_count": self.restart_count,
+            "submit_time": self.submit_time,
+            "first_step_time": self.first_step_time,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUJobStatus":
+        return cls(
+            conditions=[JobCondition.from_dict(c) for c in d.get("conditions", [])],
+            replica_statuses={
+                _parse_enum(ReplicaType, rt, "status.replica_statuses key"):
+                    ReplicaStatus.from_dict(rs)
+                for rt, rs in (d.get("replica_statuses") or {}).items()
+            },
+            start_time=d.get("start_time"),
+            completion_time=d.get("completion_time"),
+            restart_count=int(d.get("restart_count", 0)),
+            submit_time=d.get("submit_time"),
+            first_step_time=d.get("first_step_time"),
+        )
+
+
+@dataclass
+class ObjectMeta:
+    """Object metadata (name/namespace/uid/labels)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: Optional[float] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name, "namespace": self.namespace}
+        if self.uid:
+            d["uid"] = self.uid
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        if self.annotations:
+            d["annotations"] = dict(self.annotations)
+        if self.creation_timestamp is not None:
+            d["creation_timestamp"] = self.creation_timestamp
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ObjectMeta":
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            uid=d.get("uid", ""),
+            labels={str(k): str(v) for k, v in (d.get("labels") or {}).items()},
+            annotations={str(k): str(v) for k, v in (d.get("annotations") or {}).items()},
+            creation_timestamp=d.get("creation_timestamp"),
+        )
+
+
+@dataclass
+class TPUJob:
+    """The TPUJob object (reference: PyTorchJob CRD)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: TPUJobStatus = field(default_factory=TPUJobStatus)
+    api_version: str = API_VERSION
+    kind: str = KIND
+
+    # ---- condition helpers (reference: status.go condition utilities) ----
+
+    def get_condition(self, ctype: ConditionType) -> Optional[JobCondition]:
+        for c in self.status.conditions:
+            if c.type == ctype:
+                return c
+        return None
+
+    def has_condition(self, ctype: ConditionType) -> bool:
+        c = self.get_condition(ctype)
+        return c is not None and c.status
+
+    def is_finished(self) -> bool:
+        return any(self.has_condition(t) for t in TERMINAL_CONDITIONS)
+
+    def is_succeeded(self) -> bool:
+        return self.has_condition(ConditionType.SUCCEEDED)
+
+    def is_failed(self) -> bool:
+        return self.has_condition(ConditionType.FAILED)
+
+    def set_condition(
+        self,
+        ctype: ConditionType,
+        status: bool = True,
+        reason: str = "",
+        message: str = "",
+        now: Optional[float] = None,
+    ) -> None:
+        """Set a condition, mirroring the reference's updateJobConditions:
+
+        - updating an existing condition touches last_update_time, and
+          last_transition_time only when the status flips;
+        - setting RUNNING true clears RESTARTING (and vice versa) — they are
+          mutually exclusive "current state" conditions;
+        - terminal conditions clear RUNNING/RESTARTING.
+        """
+        now = time.time() if now is None else now
+        cond = self.get_condition(ctype)
+        if cond is None:
+            self.status.conditions.append(
+                JobCondition(
+                    type=ctype,
+                    status=status,
+                    reason=reason,
+                    message=message,
+                    last_update_time=now,
+                    last_transition_time=now,
+                )
+            )
+        else:
+            if cond.status != status:
+                cond.last_transition_time = now
+            cond.status = status
+            cond.reason = reason or cond.reason
+            cond.message = message or cond.message
+            cond.last_update_time = now
+
+        if status:
+            exclusive: Dict[ConditionType, List[ConditionType]] = {
+                ConditionType.RUNNING: [ConditionType.RESTARTING],
+                ConditionType.RESTARTING: [ConditionType.RUNNING],
+                ConditionType.SUCCEEDED: [
+                    ConditionType.RUNNING,
+                    ConditionType.RESTARTING,
+                ],
+                ConditionType.FAILED: [
+                    ConditionType.RUNNING,
+                    ConditionType.RESTARTING,
+                ],
+            }
+            for other in exclusive.get(ctype, []):
+                oc = self.get_condition(other)
+                if oc is not None and oc.status:
+                    oc.status = False
+                    oc.last_update_time = now
+                    oc.last_transition_time = now
+
+    # ---- serialization ----
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "api_version": self.api_version,
+            "kind": self.kind,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUJob":
+        return cls(
+            api_version=d.get("api_version", API_VERSION),
+            kind=d.get("kind", KIND),
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=TPUJobSpec.from_dict(d.get("spec") or {}),
+            status=TPUJobStatus.from_dict(d.get("status") or {}),
+        )

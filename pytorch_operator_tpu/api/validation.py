@@ -1,0 +1,131 @@
+"""Validation for TPUJob specs.
+
+Reference: ``ValidateV1PyTorchJobSpec`` (SURVEY.md §2 "Validation"): rejects a
+spec without exactly one Master, validates containers/ports. Extended with
+the local-process equivalents (template must name a runnable) and elastic
+policy consistency.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from .types import ElasticPolicy, ReplicaType, TPUJob, TPUJobSpec
+
+_NAME_RE = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")  # DNS-1123 label
+MAX_NAME_LEN = 63
+
+
+class ValidationError(ValueError):
+    """Raised when a TPUJob spec is invalid; carries all messages."""
+
+    def __init__(self, errors: List[str]):
+        self.errors = list(errors)
+        super().__init__("; ".join(errors))
+
+
+def validate_name(name: str) -> List[str]:
+    errs = []
+    if not name:
+        errs.append("metadata.name: must not be empty")
+    elif len(name) > MAX_NAME_LEN:
+        errs.append(f"metadata.name: must be at most {MAX_NAME_LEN} characters")
+    elif not _NAME_RE.match(name):
+        errs.append(
+            "metadata.name: must be a DNS-1123 label "
+            "(lowercase alphanumeric and '-', start/end alphanumeric)"
+        )
+    return errs
+
+
+def _validate_elastic(ep: ElasticPolicy, spec: TPUJobSpec) -> List[str]:
+    errs = []
+    if ep.min_replicas < 1:
+        errs.append("elastic_policy.min_replicas: must be >= 1")
+    if ep.max_replicas < ep.min_replicas:
+        errs.append("elastic_policy.max_replicas: must be >= min_replicas")
+    if ep.max_restarts < 0:
+        errs.append("elastic_policy.max_restarts: must be >= 0")
+    workers = spec.replica_specs.get(ReplicaType.WORKER)
+    if workers is not None and workers.replicas is not None:
+        if not (ep.min_replicas <= workers.replicas <= ep.max_replicas):
+            errs.append(
+                "elastic_policy: Worker replicas "
+                f"({workers.replicas}) must lie within "
+                f"[min_replicas={ep.min_replicas}, max_replicas={ep.max_replicas}]"
+            )
+    return errs
+
+
+def validate_spec(spec: TPUJobSpec) -> List[str]:
+    """Return a list of error strings (empty when valid)."""
+    errs: List[str] = []
+
+    if not spec.replica_specs:
+        errs.append("spec.replica_specs: must define at least a Master replica")
+        return errs
+
+    master = spec.replica_specs.get(ReplicaType.MASTER)
+    if master is None:
+        errs.append("spec.replica_specs: must contain exactly one Master replica type")
+    else:
+        if master.replicas is not None and master.replicas != 1:
+            errs.append(
+                f"spec.replica_specs[Master].replicas: must be 1, got {master.replicas}"
+            )
+
+    for rtype, rs in spec.replica_specs.items():
+        prefix = f"spec.replica_specs[{rtype.value}]"
+        if rs.replicas is not None and rs.replicas < 0:
+            errs.append(f"{prefix}.replicas: must be >= 0, got {rs.replicas}")
+        t = rs.template
+        has_cmd = t.command is not None and len(t.command) > 0
+        has_mod = t.module is not None and len(t.module) > 0
+        if not has_cmd and not has_mod:
+            errs.append(f"{prefix}.template: must set either 'command' or 'module'")
+        if has_cmd and has_mod:
+            errs.append(f"{prefix}.template: 'command' and 'module' are mutually exclusive")
+        if t.resources.tpu_chips < 0:
+            errs.append(f"{prefix}.template.resources.tpu_chips: must be >= 0")
+        if t.resources.cpu_devices < 0:
+            errs.append(f"{prefix}.template.resources.cpu_devices: must be >= 0")
+
+    if spec.port is not None and not (1 <= spec.port <= 65535):
+        errs.append(f"spec.port: must be in [1, 65535], got {spec.port}")
+
+    rp = spec.run_policy
+    if rp.backoff_limit is not None and rp.backoff_limit < 0:
+        errs.append("spec.run_policy.backoff_limit: must be >= 0")
+    if rp.active_deadline_seconds is not None and rp.active_deadline_seconds <= 0:
+        errs.append("spec.run_policy.active_deadline_seconds: must be > 0")
+    if rp.ttl_seconds_after_finished is not None and rp.ttl_seconds_after_finished < 0:
+        errs.append("spec.run_policy.ttl_seconds_after_finished: must be >= 0")
+    if rp.scheduling_policy.min_available is not None:
+        if rp.scheduling_policy.min_available < 0:
+            errs.append("spec.run_policy.scheduling_policy.min_available: must be >= 0")
+        # Effective total: unset replica counts default to 1, so this holds
+        # for undefaulted specs too (a min_available that can never be met
+        # would gang-hold the job forever).
+        total = sum(
+            rs.replicas if rs.replicas is not None else 1
+            for rs in spec.replica_specs.values()
+        )
+        if rp.scheduling_policy.min_available > total:
+            errs.append(
+                "spec.run_policy.scheduling_policy.min_available: "
+                f"({rp.scheduling_policy.min_available}) exceeds total replicas ({total})"
+            )
+
+    if spec.elastic_policy is not None:
+        errs.extend(_validate_elastic(spec.elastic_policy, spec))
+
+    return errs
+
+
+def validate(job: TPUJob) -> None:
+    """Raise ValidationError if the job is invalid."""
+    errs = validate_name(job.metadata.name)
+    errs.extend(validate_spec(job.spec))
+    if errs:
+        raise ValidationError(errs)

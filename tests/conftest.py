@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Forces the JAX CPU backend with 8 virtual host devices BEFORE any jax import,
+so sharding/mesh tests exercise real multi-device code paths without TPU
+hardware (SURVEY.md §4 "Rebuild translation"). Control-plane tests never
+import jax at all.
+"""
+
+import os
+
+# Must happen before jax is imported anywhere in the test process.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep XLA compilation single-threaded-ish on the 1-core CI box.
+os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "false")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_state_dir(tmp_path):
+    """A fresh supervisor state directory."""
+    d = tmp_path / "tpujob-state"
+    d.mkdir()
+    return d

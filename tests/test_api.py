@@ -1,0 +1,262 @@
+"""Tests for the TPUJob API layer: types, defaulting, validation, YAML.
+
+Modeled on the reference's api unit tests (``pkg/apis/pytorch/v1/*_test.go``,
+SURVEY.md §4): build fixtures, default them, assert invariants.
+"""
+
+import pytest
+
+from pytorch_operator_tpu.api import (
+    DEFAULT_PORT,
+    CleanPodPolicy,
+    ConditionType,
+    ElasticPolicy,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    ValidationError,
+    dump_job,
+    loads_job,
+    set_defaults,
+    validate,
+    validate_spec,
+)
+from tests.testutil import new_job
+
+
+class TestDefaults:
+    def test_port_default(self):
+        job = new_job(defaulted=False)
+        assert job.spec.port is None
+        set_defaults(job)
+        assert job.spec.port == DEFAULT_PORT
+
+    def test_replicas_default_to_one(self):
+        job = new_job(defaulted=False)
+        job.spec.replica_specs[ReplicaType.WORKER].replicas = None
+        set_defaults(job)
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 1
+
+    def test_restart_policy_default(self):
+        job = new_job(defaulted=False)
+        job.spec.replica_specs[ReplicaType.MASTER].restart_policy = None
+        set_defaults(job)
+        assert (
+            job.spec.replica_specs[ReplicaType.MASTER].restart_policy
+            == RestartPolicy.ON_FAILURE
+        )
+
+    def test_clean_pod_policy_default(self):
+        job = new_job(defaulted=False)
+        set_defaults(job)
+        assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.RUNNING
+
+    def test_gang_min_available_defaults_to_total(self):
+        job = new_job(workers=3, defaulted=False)
+        set_defaults(job)
+        assert job.spec.run_policy.scheduling_policy.min_available == 4
+
+    def test_idempotent(self):
+        job = new_job(workers=2)
+        before = job.to_dict()
+        set_defaults(job)
+        assert job.to_dict() == before
+
+
+class TestValidation:
+    def test_valid_job_passes(self):
+        validate(new_job(workers=2))
+
+    def test_missing_master_rejected(self):
+        job = new_job(workers=2)
+        del job.spec.replica_specs[ReplicaType.MASTER]
+        with pytest.raises(ValidationError, match="Master"):
+            validate(job)
+
+    def test_master_replicas_must_be_one(self):
+        job = new_job()
+        job.spec.replica_specs[ReplicaType.MASTER].replicas = 2
+        with pytest.raises(ValidationError, match="must be 1"):
+            validate(job)
+
+    def test_template_requires_runnable(self):
+        job = new_job()
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate()
+        with pytest.raises(ValidationError, match="command.*module|module.*command"):
+            validate(job)
+
+    def test_command_and_module_exclusive(self):
+        job = new_job()
+        t = job.spec.replica_specs[ReplicaType.MASTER].template
+        t.command = ["python", "x.py"]
+        with pytest.raises(ValidationError, match="mutually exclusive"):
+            validate(job)
+
+    def test_bad_name_rejected(self):
+        job = new_job(name="Bad_Name!")
+        with pytest.raises(ValidationError, match="DNS-1123"):
+            validate(job)
+
+    def test_empty_name_rejected(self):
+        job = new_job(name="")
+        with pytest.raises(ValidationError, match="empty"):
+            validate(job)
+
+    def test_bad_port(self):
+        job = new_job()
+        job.spec.port = 70000
+        errs = validate_spec(job.spec)
+        assert any("port" in e for e in errs)
+
+    def test_negative_backoff_limit(self):
+        job = new_job(backoff_limit=-1)
+        with pytest.raises(ValidationError, match="backoff_limit"):
+            validate(job)
+
+    def test_elastic_bounds(self):
+        job = new_job(workers=5, elastic=ElasticPolicy(min_replicas=2, max_replicas=4))
+        with pytest.raises(ValidationError, match="within"):
+            validate(job)
+        job2 = new_job(workers=3, elastic=ElasticPolicy(min_replicas=2, max_replicas=4))
+        validate(job2)
+
+    def test_elastic_min_leq_max(self):
+        job = new_job(workers=3, elastic=ElasticPolicy(min_replicas=4, max_replicas=2))
+        with pytest.raises(ValidationError, match="max_replicas"):
+            validate(job)
+
+    def test_min_available_cannot_exceed_total(self):
+        job = new_job(workers=1)
+        job.spec.run_policy.scheduling_policy.min_available = 10
+        with pytest.raises(ValidationError, match="min_available"):
+            validate(job)
+
+
+class TestConditions:
+    def test_created_then_running(self):
+        job = new_job()
+        job.set_condition(ConditionType.CREATED, reason="TPUJobCreated")
+        job.set_condition(ConditionType.RUNNING, reason="TPUJobRunning")
+        assert job.has_condition(ConditionType.CREATED)
+        assert job.has_condition(ConditionType.RUNNING)
+        assert not job.is_finished()
+
+    def test_restarting_clears_running(self):
+        job = new_job()
+        job.set_condition(ConditionType.RUNNING)
+        job.set_condition(ConditionType.RESTARTING)
+        assert job.has_condition(ConditionType.RESTARTING)
+        assert not job.has_condition(ConditionType.RUNNING)
+        # and back
+        job.set_condition(ConditionType.RUNNING)
+        assert not job.has_condition(ConditionType.RESTARTING)
+
+    def test_terminal_clears_running(self):
+        job = new_job()
+        job.set_condition(ConditionType.RUNNING)
+        job.set_condition(ConditionType.SUCCEEDED)
+        assert job.is_succeeded()
+        assert job.is_finished()
+        assert not job.has_condition(ConditionType.RUNNING)
+
+    def test_transition_times(self):
+        job = new_job()
+        job.set_condition(ConditionType.RUNNING, now=100.0)
+        c = job.get_condition(ConditionType.RUNNING)
+        assert c.last_transition_time == 100.0
+        # same status, later update: transition time unchanged
+        job.set_condition(ConditionType.RUNNING, now=200.0)
+        assert c.last_transition_time == 100.0
+        assert c.last_update_time == 200.0
+        # flip: transition time moves
+        job.set_condition(ConditionType.FAILED, now=300.0)
+        assert c.last_transition_time == 300.0
+        assert c.status is False
+
+
+class TestSerialization:
+    def test_round_trip_dict(self):
+        job = new_job(
+            workers=3,
+            backoff_limit=5,
+            ttl_seconds_after_finished=60,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=3),
+        )
+        job.set_condition(ConditionType.CREATED)
+        job2 = TPUJob.from_dict(job.to_dict())
+        assert job2.to_dict() == job.to_dict()
+
+    def test_round_trip_yaml(self):
+        job = new_job(workers=2)
+        text = dump_job(job)
+        job2 = loads_job(text)
+        assert job2.to_dict() == job.to_dict()
+
+    def test_load_user_yaml(self):
+        text = """
+api_version: tpujob.dev/v1
+kind: TPUJob
+metadata:
+  name: mnist
+spec:
+  replica_specs:
+    Master:
+      replicas: 1
+      template:
+        module: pytorch_operator_tpu.workloads.mnist_train
+        args: ["--epochs", "1"]
+    Worker:
+      replicas: 2
+      restart_policy: ExitCode
+      template:
+        module: pytorch_operator_tpu.workloads.mnist_train
+  run_policy:
+    backoff_limit: 3
+"""
+        job = loads_job(text)
+        set_defaults(job)
+        validate(job)
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 2
+        assert (
+            job.spec.replica_specs[ReplicaType.WORKER].restart_policy
+            == RestartPolicy.EXIT_CODE
+        )
+        assert job.spec.total_replicas() == 3
+        assert job.spec.port == DEFAULT_PORT
+
+    def test_replica_spec_round_trip(self):
+        rs = ReplicaSpec(
+            replicas=2,
+            restart_policy=RestartPolicy.EXIT_CODE,
+            template=ProcessTemplate(command=["echo", "hi"], env={"A": "1"}),
+        )
+        rs2 = ReplicaSpec.from_dict(rs.to_dict())
+        assert rs2.to_dict() == rs.to_dict()
+
+
+class TestEnumParseErrors:
+    def test_unknown_restart_policy_has_field_path(self):
+        text = """
+metadata: {name: x}
+spec:
+  replica_specs:
+    Master: {restart_policy: Sometimes, template: {module: m}}
+"""
+        with pytest.raises(ValueError, match=r"replica_specs\[Master\].restart_policy.*valid:"):
+            loads_job(text)
+
+    def test_unknown_replica_type_key(self):
+        with pytest.raises(ValueError, match="replica_specs key.*valid: Master, Worker"):
+            loads_job("metadata: {name: x}\nspec:\n  replica_specs:\n    Chief: {template: {module: m}}")
+
+    def test_non_integer_replicas(self):
+        with pytest.raises(ValueError, match=r"replica_specs\[Master\].replicas: invalid integer 'two'"):
+            loads_job("metadata: {name: x}\nspec:\n  replica_specs:\n    Master: {replicas: two, template: {module: m}}")
+
+    def test_min_available_checked_undefaulted(self):
+        job = new_job(workers=1, defaulted=False)
+        job.spec.run_policy.scheduling_policy.min_available = 10
+        errs = validate_spec(job.spec)
+        assert any("min_available" in e for e in errs)

@@ -1,0 +1,64 @@
+"""Test fixture builders.
+
+Mirror of the reference's ``pkg/common/util/v1/testutil/`` (SURVEY.md §4
+"Fixture library"): helpers that build TPUJob specs with given master/worker
+counts, so controller tests stay terse.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pytorch_operator_tpu.api import (
+    CleanPodPolicy,
+    ElasticPolicy,
+    ObjectMeta,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    TPUJob,
+    TPUJobSpec,
+    set_defaults,
+)
+
+
+def new_job(
+    name: str = "test-job",
+    workers: int = 1,
+    restart_policy: RestartPolicy = RestartPolicy.ON_FAILURE,
+    clean_pod_policy: Optional[CleanPodPolicy] = None,
+    backoff_limit: Optional[int] = None,
+    active_deadline_seconds: Optional[int] = None,
+    ttl_seconds_after_finished: Optional[int] = None,
+    elastic: Optional[ElasticPolicy] = None,
+    module: str = "pytorch_operator_tpu.workloads.noop",
+    defaulted: bool = True,
+) -> TPUJob:
+    """Build a Master(1) + Worker(N) TPUJob, defaulted unless asked not to."""
+    def mk_template() -> ProcessTemplate:
+        return ProcessTemplate(module=module)
+
+    spec = TPUJobSpec(
+        replica_specs={
+            ReplicaType.MASTER: ReplicaSpec(
+                replicas=1, restart_policy=restart_policy, template=mk_template()
+            ),
+        },
+        run_policy=RunPolicy(
+            clean_pod_policy=clean_pod_policy,
+            backoff_limit=backoff_limit,
+            active_deadline_seconds=active_deadline_seconds,
+            ttl_seconds_after_finished=ttl_seconds_after_finished,
+        ),
+        elastic_policy=elastic,
+    )
+    if workers > 0:
+        spec.replica_specs[ReplicaType.WORKER] = ReplicaSpec(
+            replicas=workers, restart_policy=restart_policy, template=mk_template()
+        )
+    job = TPUJob(metadata=ObjectMeta(name=name), spec=spec)
+    if defaulted:
+        set_defaults(job)
+    return job
