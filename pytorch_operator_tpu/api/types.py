@@ -47,6 +47,20 @@ def _parse_int(value, field_path: str) -> int:
     except (TypeError, ValueError):
         raise ValueError(f"{field_path}: invalid integer {value!r}") from None
 
+
+def _parse_opt_int(d: Dict[str, Any], key: str, field_path: str) -> Optional[int]:
+    return _parse_int(d[key], field_path) if d.get(key) is not None else None
+
+
+def _env_str(value, field_path: str) -> str:
+    """Coerce an env value: YAML booleans become 'true'/'false' (what the
+    user wrote), scalars stringify, structures are rejected."""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, (dict, list, tuple)):
+        raise ValueError(f"{field_path}: env values must be scalar strings")
+    return str(value)
+
 # Reference parity: default rendezvous port and port name
 # (pkg/apis/pytorch/v1/defaults.go — SURVEY.md §2 "Defaulting").
 DEFAULT_PORT = 23456
@@ -179,11 +193,25 @@ class ProcessTemplate:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ProcessTemplate":
+        command = d.get("command")
+        if command is not None and (
+            isinstance(command, str) or not isinstance(command, (list, tuple))
+        ):
+            raise ValueError(
+                "template.command: must be a list of argv strings "
+                f"(got {type(command).__name__}); e.g. [python, train.py]"
+            )
+        args = d.get("args", [])
+        if isinstance(args, str) or not isinstance(args, (list, tuple)):
+            raise ValueError("template.args: must be a list of strings")
         return cls(
-            command=list(d["command"]) if d.get("command") is not None else None,
+            command=[str(c) for c in command] if command is not None else None,
             module=d.get("module"),
-            args=[str(a) for a in d.get("args", [])],
-            env={str(k): str(v) for k, v in (d.get("env") or {}).items()},
+            args=[str(a) for a in args],
+            env={
+                str(k): _env_str(v, f"template.env[{k}]")
+                for k, v in (d.get("env") or {}).items()
+            },
             working_dir=d.get("working_dir"),
             resources=Resources.from_dict(d.get("resources") or {}),
         )
@@ -245,8 +273,8 @@ class SchedulingPolicy:
     def from_dict(cls, d: Dict[str, Any]) -> "SchedulingPolicy":
         return cls(
             gang=bool(d.get("gang", True)),
-            min_available=(
-                int(d["min_available"]) if d.get("min_available") is not None else None
+            min_available=_parse_opt_int(
+                d, "min_available", "scheduling_policy.min_available"
             ),
             queue=d.get("queue"),
         )
@@ -282,19 +310,13 @@ class RunPolicy:
                 if cpp is not None
                 else None
             ),
-            ttl_seconds_after_finished=(
-                int(d["ttl_seconds_after_finished"])
-                if d.get("ttl_seconds_after_finished") is not None
-                else None
+            ttl_seconds_after_finished=_parse_opt_int(
+                d, "ttl_seconds_after_finished", "run_policy.ttl_seconds_after_finished"
             ),
-            active_deadline_seconds=(
-                int(d["active_deadline_seconds"])
-                if d.get("active_deadline_seconds") is not None
-                else None
+            active_deadline_seconds=_parse_opt_int(
+                d, "active_deadline_seconds", "run_policy.active_deadline_seconds"
             ),
-            backoff_limit=(
-                int(d["backoff_limit"]) if d.get("backoff_limit") is not None else None
-            ),
+            backoff_limit=_parse_opt_int(d, "backoff_limit", "run_policy.backoff_limit"),
             scheduling_policy=SchedulingPolicy.from_dict(d.get("scheduling_policy") or {}),
         )
 
@@ -321,9 +343,9 @@ class ElasticPolicy:
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ElasticPolicy":
         return cls(
-            min_replicas=int(d.get("min_replicas", 1)),
-            max_replicas=int(d.get("max_replicas", 1)),
-            max_restarts=int(d.get("max_restarts", 10)),
+            min_replicas=_parse_int(d.get("min_replicas", 1), "elastic_policy.min_replicas"),
+            max_replicas=_parse_int(d.get("max_replicas", 1), "elastic_policy.max_replicas"),
+            max_restarts=_parse_int(d.get("max_restarts", 10), "elastic_policy.max_restarts"),
         )
 
 
@@ -371,7 +393,7 @@ class TPUJobSpec:
                 if d.get("elastic_policy") is not None
                 else None
             ),
-            port=int(d["port"]) if d.get("port") is not None else None,
+            port=_parse_opt_int(d, "port", "spec.port"),
         )
 
 

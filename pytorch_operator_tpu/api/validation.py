@@ -25,15 +25,15 @@ class ValidationError(ValueError):
         super().__init__("; ".join(errors))
 
 
-def validate_name(name: str) -> List[str]:
+def validate_name(name: str, field: str = "metadata.name") -> List[str]:
     errs = []
     if not name:
-        errs.append("metadata.name: must not be empty")
+        errs.append(f"{field}: must not be empty")
     elif len(name) > MAX_NAME_LEN:
-        errs.append(f"metadata.name: must be at most {MAX_NAME_LEN} characters")
+        errs.append(f"{field}: must be at most {MAX_NAME_LEN} characters")
     elif not _NAME_RE.match(name):
         errs.append(
-            "metadata.name: must be a DNS-1123 label "
+            f"{field}: must be a DNS-1123 label "
             "(lowercase alphanumeric and '-', start/end alphanumeric)"
         )
     return errs
@@ -124,8 +124,15 @@ def validate_spec(spec: TPUJobSpec) -> List[str]:
 
 
 def validate(job: TPUJob) -> None:
-    """Raise ValidationError if the job is invalid."""
+    """Raise ValidationError if the job is invalid.
+
+    The namespace is held to DNS-1123 as well: both name and namespace are
+    embedded in state filenames (``<ns>_<name>.json``) whose decoding relies
+    on neither containing an underscore.
+    """
     errs = validate_name(job.metadata.name)
+    if job.metadata.namespace:
+        errs.extend(validate_name(job.metadata.namespace, field="metadata.namespace"))
     errs.extend(validate_spec(job.spec))
     if errs:
         raise ValidationError(errs)

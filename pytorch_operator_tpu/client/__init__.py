@@ -1,0 +1,1 @@
+"""Client: the ``tpujob`` CLI (the kubectl+CRD analog)."""

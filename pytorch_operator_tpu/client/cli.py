@@ -1,0 +1,346 @@
+"""The ``tpujob`` CLI — the kubectl+CRD surface of the reference.
+
+Reference mapping (SURVEY.md §7 architecture sketch):
+
+- ``kubectl apply -f job.yaml``   → ``tpujob run job.yaml`` (foreground
+  supervise-to-completion) or ``tpujob submit job.yaml`` (queue for a
+  running ``tpujob supervisor`` daemon)
+- ``kubectl get pytorchjobs``     → ``tpujob get``
+- ``kubectl describe pytorchjob`` → ``tpujob describe NAME`` (spec, status,
+  Events — the reference's user-facing observability surface)
+- ``kubectl logs``                → ``tpujob logs NAME``
+- ``kubectl delete``              → ``tpujob delete NAME``
+- operator flags (--namespace, --enable-gang-scheduling, --threadiness,
+  --monitoring-port; SURVEY.md §2 "Entrypoint/CLI") → supervisor flags
+  (--state-dir, --no-gang, --max-slots, metrics file)
+
+Usage: ``python -m pytorch_operator_tpu.client.cli <command> ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+from ..api import (
+    ConditionType,
+    ValidationError,
+    load_job,
+    set_defaults,
+    validate,
+)
+from ..controller.store import JobStore, job_key
+from ..controller.supervisor import (
+    Supervisor,
+    default_state_dir,
+    schedule_to_first_step_latency,
+)
+
+
+def _state_dir(args) -> Path:
+    return Path(args.state_dir) if args.state_dir else default_state_dir()
+
+
+def _resolve_key(args) -> str:
+    return f"{args.namespace}/{args.name}"
+
+
+def _phase_of(job) -> str:
+    for ct in (
+        ConditionType.SUCCEEDED,
+        ConditionType.FAILED,
+        ConditionType.RESTARTING,
+        ConditionType.RUNNING,
+        ConditionType.CREATED,
+    ):
+        if job.has_condition(ct):
+            return ct.value
+    return "Pending"
+
+
+def _age(ts: Optional[float]) -> str:
+    if ts is None:
+        return "-"
+    s = int(time.time() - ts)
+    if s < 120:
+        return f"{s}s"
+    if s < 7200:
+        return f"{s // 60}m"
+    return f"{s // 3600}h"
+
+
+def cmd_run(args) -> int:
+    job = load_job(args.file)
+    sup = Supervisor(
+        state_dir=_state_dir(args),
+        gang_enabled=not args.no_gang,
+        max_slots=args.max_slots,
+    )
+    try:
+        key = sup.submit(job)
+    except ValidationError as e:
+        print("error: invalid TPUJob spec:", file=sys.stderr)
+        for msg in e.errors:
+            print(f"  - {msg}", file=sys.stderr)
+        return 2
+    print(f"tpujob {key} submitted")
+    printed = 0
+    deadline = None if args.timeout is None else time.time() + args.timeout
+    try:
+        while True:
+            # Sync only the submitted job — other persisted jobs in this
+            # state dir may be owned by a running daemon.
+            sup.reconciler.sync(key)
+            events = sup.events.for_job(key)
+            for ev in events[printed:]:
+                print(f"  [{ev.type}] {ev.reason}: {ev.message}")
+            printed = len(events)
+            j = sup.get(key)
+            if j is None or j.is_finished():
+                break
+            if deadline is not None and time.time() > deadline:
+                print(f"error: timeout after {args.timeout}s", file=sys.stderr)
+                sup.delete_job(key)
+                return 3
+            time.sleep(sup.poll_interval)
+    finally:
+        sup.shutdown()
+    if j is None:
+        print("job was garbage-collected")
+        return 0
+    phase = _phase_of(j)
+    lat = schedule_to_first_step_latency(j)
+    if lat is not None:
+        print(f"schedule-to-first-step latency: {lat:.3f}s")
+    print(f"tpujob {key}: {phase} (restarts={j.status.restart_count})")
+    return 0 if j.is_succeeded() else 1
+
+
+def cmd_submit(args) -> int:
+    job = load_job(args.file)
+    set_defaults(job)
+    try:
+        validate(job)
+    except ValidationError as e:
+        print("error: invalid TPUJob spec:", file=sys.stderr)
+        for msg in e.errors:
+            print(f"  - {msg}", file=sys.stderr)
+        return 2
+    store = JobStore(persist_dir=_state_dir(args) / "jobs")
+    try:
+        key = store.add(job)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(f"tpujob {key} submitted (run 'tpujob supervisor' to reconcile)")
+    return 0
+
+
+def cmd_supervisor(args) -> int:
+    sup = Supervisor(
+        state_dir=_state_dir(args),
+        gang_enabled=not args.no_gang,
+        max_slots=args.max_slots,
+    )
+    print(f"tpujob supervisor: state dir {sup.state_dir}, "
+          f"gang={'on' if not args.no_gang else 'off'}")
+    try:
+        while True:
+            sup.store.rescan()
+            sup.process_deletion_markers()
+            sup.sync_once()
+            sup.write_metrics_file()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print("supervisor: shutting down")
+        sup.shutdown()
+        return 0
+
+
+def cmd_get(args) -> int:
+    store = JobStore(persist_dir=_state_dir(args) / "jobs")
+    jobs = store.list()
+    if args.name:
+        jobs = [j for j in jobs if j.metadata.name == args.name
+                and j.metadata.namespace == args.namespace]
+        if not jobs:
+            print(f"error: tpujob {_resolve_key(args)} not found", file=sys.stderr)
+            return 1
+    rows = [("NAME", "NAMESPACE", "STATE", "RESTARTS", "AGE")]
+    for j in sorted(jobs, key=lambda j: j.metadata.creation_timestamp or 0):
+        rows.append(
+            (
+                j.metadata.name,
+                j.metadata.namespace,
+                _phase_of(j),
+                str(j.status.restart_count),
+                _age(j.metadata.creation_timestamp),
+            )
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return 0
+
+
+def cmd_describe(args) -> int:
+    state = _state_dir(args)
+    store = JobStore(persist_dir=state / "jobs")
+    key = _resolve_key(args)
+    job = store.get(key)
+    if job is None:
+        print(f"error: tpujob {key} not found", file=sys.stderr)
+        return 1
+    print(f"Name:       {job.metadata.name}")
+    print(f"Namespace:  {job.metadata.namespace}")
+    print(f"UID:        {job.metadata.uid}")
+    print(f"State:      {_phase_of(job)}")
+    print(f"Restarts:   {job.status.restart_count}")
+    if job.status.submit_time:
+        print(f"Submitted:  {time.ctime(job.status.submit_time)}")
+    lat = schedule_to_first_step_latency(job)
+    if lat is not None:
+        print(f"Schedule-to-first-step: {lat:.3f}s")
+    print("Replicas:")
+    for rtype, rs in job.spec.replica_specs.items():
+        status = job.status.replica_statuses.get(rtype)
+        line = f"  {rtype.value}: desired={rs.replicas}"
+        if status:
+            line += (
+                f" active={status.active} succeeded={status.succeeded} "
+                f"failed={status.failed}"
+            )
+        print(line)
+    print("Conditions:")
+    for c in job.status.conditions:
+        print(
+            f"  {c.type.value:<12} {str(c.status):<6} {c.reason:<24} {c.message}"
+        )
+    ev_path = state / "events" / (key.replace("/", "_") + ".events.jsonl")
+    print("Events:")
+    if ev_path.exists():
+        for line in ev_path.read_text().splitlines():
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            print(f"  [{ev['type']}] {ev['reason']}: {ev['message']}")
+    else:
+        print("  <none>")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    state = _state_dir(args)
+    key = _resolve_key(args)
+    prefix = key.replace("/", "_")
+    log_dir = state / "logs"
+    if args.replica:
+        paths = [log_dir / f"{prefix}-{args.replica}.log"]
+        if not paths[0].exists():
+            print(f"error: no log for replica {args.replica} of {key}", file=sys.stderr)
+            return 1
+    else:
+        paths = sorted(log_dir.glob(f"{prefix}-*.log"))
+        if not paths:
+            print(f"error: no logs found for tpujob {key}", file=sys.stderr)
+            return 1
+    for p in paths:
+        if len(paths) > 1:
+            print(f"==> {p.name} <==")
+        sys.stdout.write(p.read_text(errors="replace"))
+    return 0
+
+
+def cmd_delete(args) -> int:
+    state = _state_dir(args)
+    key = _resolve_key(args)
+    store = JobStore(persist_dir=state / "jobs")
+    job = store.get(key)
+    if job is None:
+        print(f"error: tpujob {key} not found", file=sys.stderr)
+        return 1
+    # Cross-process delete: leave a marker a running supervisor will act on
+    # (it owns the replica processes); also remove the stored object so the
+    # job disappears from get/describe immediately.
+    marker = state / "jobs" / (key.replace("/", "_") + ".delete")
+    marker.write_text("")
+    store.delete(key)
+    print(f"tpujob {key} deleted")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    path = _state_dir(args) / "metrics.prom"
+    if not path.exists():
+        print("no metrics recorded yet", file=sys.stderr)
+        return 1
+    sys.stdout.write(path.read_text())
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpujob", description="TPU-native distributed training jobs"
+    )
+    p.add_argument("--state-dir", default=None, help="supervisor state directory")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add_ns(sp):
+        sp.add_argument("-n", "--namespace", default="default")
+
+    sp = sub.add_parser("run", help="submit a job and supervise to completion")
+    sp.add_argument("file")
+    sp.add_argument("--timeout", type=float, default=None)
+    sp.add_argument("--no-gang", action="store_true", help="disable gang scheduling")
+    sp.add_argument("--max-slots", type=int, default=None, help="replica capacity")
+    sp.set_defaults(func=cmd_run)
+
+    sp = sub.add_parser("submit", help="queue a job for a running supervisor")
+    sp.add_argument("file")
+    sp.set_defaults(func=cmd_submit)
+
+    sp = sub.add_parser("supervisor", help="run the reconcile daemon")
+    sp.add_argument("--interval", type=float, default=0.2)
+    sp.add_argument("--no-gang", action="store_true")
+    sp.add_argument("--max-slots", type=int, default=None)
+    sp.set_defaults(func=cmd_supervisor)
+
+    sp = sub.add_parser("get", help="list jobs")
+    sp.add_argument("name", nargs="?")
+    add_ns(sp)
+    sp.set_defaults(func=cmd_get)
+
+    sp = sub.add_parser("describe", help="show job details and events")
+    sp.add_argument("name")
+    add_ns(sp)
+    sp.set_defaults(func=cmd_describe)
+
+    sp = sub.add_parser("logs", help="print replica logs")
+    sp.add_argument("name")
+    sp.add_argument("--replica", default=None, help="e.g. master-0, worker-1")
+    add_ns(sp)
+    sp.set_defaults(func=cmd_logs)
+
+    sp = sub.add_parser("delete", help="delete a job")
+    sp.add_argument("name")
+    add_ns(sp)
+    sp.set_defaults(func=cmd_delete)
+
+    sp = sub.add_parser("metrics", help="print supervisor metrics")
+    sp.set_defaults(func=cmd_metrics)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,21 @@
+"""Controller: supervisor, reconciler, runners, gang scheduling, status.
+
+Mirror of the reference's ``pkg/controller.v1/pytorch/`` plus the vendored
+``kubeflow/common`` job framework (SURVEY.md §1 layers 3–5).
+"""
+
+from .events import EventRecorder, Event  # noqa: F401
+from .expectations import ControllerExpectations  # noqa: F401
+from .gang import GangScheduler, ProcessGroup  # noqa: F401
+from .metrics import MetricsRegistry  # noqa: F401
+from .reconciler import Reconciler  # noqa: F401
+from .runner import (  # noqa: F401
+    FakeRunner,
+    ProcessRunner,
+    ReplicaHandle,
+    SubprocessRunner,
+    replica_name,
+)
+from .status import classify_exit, compute_replica_statuses  # noqa: F401
+from .store import JobStore, job_key  # noqa: F401
+from .supervisor import Supervisor, schedule_to_first_step_latency  # noqa: F401

@@ -1,0 +1,80 @@
+"""Per-job event recording.
+
+Reference: Kubernetes Events emitted on the PyTorchJob object — the
+user-facing observability surface (SURVEY.md §5 "Metrics / logging /
+observability"). Locally: an append-only per-job event list, queryable via
+``tpujob describe``, optionally mirrored to a JSONL file.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+EVENT_NORMAL = "Normal"
+EVENT_WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    timestamp: float
+    type: str  # Normal | Warning
+    reason: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return {
+            "timestamp": self.timestamp,
+            "type": self.type,
+            "reason": self.reason,
+            "message": self.message,
+        }
+
+
+@dataclass
+class EventRecorder:
+    """Thread-safe per-job event log (k8s EventRecorder analog)."""
+
+    sink_dir: Optional[Path] = None
+    _events: Dict[str, List[Event]] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def event(
+        self,
+        job_key: str,
+        etype: str,
+        reason: str,
+        message: str,
+        now: Optional[float] = None,
+    ) -> None:
+        ev = Event(
+            timestamp=time.time() if now is None else now,
+            type=etype,
+            reason=reason,
+            message=message,
+        )
+        with self._lock:
+            self._events.setdefault(job_key, []).append(ev)
+        if self.sink_dir is not None:
+            path = Path(self.sink_dir) / (job_key.replace("/", "_") + ".events.jsonl")
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with path.open("a") as f:
+                f.write(json.dumps(ev.to_dict()) + "\n")
+
+    def normal(self, job_key: str, reason: str, message: str) -> None:
+        self.event(job_key, EVENT_NORMAL, reason, message)
+
+    def warning(self, job_key: str, reason: str, message: str) -> None:
+        self.event(job_key, EVENT_WARNING, reason, message)
+
+    def for_job(self, job_key: str) -> List[Event]:
+        with self._lock:
+            return list(self._events.get(job_key, []))
+
+    def drop_job(self, job_key: str) -> None:
+        with self._lock:
+            self._events.pop(job_key, None)

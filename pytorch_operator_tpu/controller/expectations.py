@@ -1,0 +1,81 @@
+"""Controller expectations cache.
+
+Reference: ``ControllerExpectations`` from the vendored ``kubeflow/common``
+(SURVEY.md §2 "Expectations cache") — the classic k8s controller pattern that
+prevents duplicate pod creation in the window between issuing a create and the
+informer observing it. The local runner is nearly synchronous, but the same
+guard protects against double-creation when a sync races a slow process
+launch or when the supervisor threads syncs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict
+
+# Expectations are abandoned after this long (reference uses 5 minutes).
+EXPECTATION_TIMEOUT_S = 300.0
+
+
+@dataclass
+class _Expectation:
+    creations: int
+    deletions: int
+    timestamp: float
+
+
+class ControllerExpectations:
+    def __init__(self) -> None:
+        self._by_key: Dict[str, _Expectation] = {}
+        self._lock = threading.Lock()
+
+    def expect_creations(self, key: str, n: int, now: float = None) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            exp = self._by_key.get(key)
+            if exp is None:
+                self._by_key[key] = _Expectation(n, 0, now)
+            else:
+                exp.creations += n
+                exp.timestamp = now
+
+    def expect_deletions(self, key: str, n: int, now: float = None) -> None:
+        now = time.time() if now is None else now
+        with self._lock:
+            exp = self._by_key.get(key)
+            if exp is None:
+                self._by_key[key] = _Expectation(0, n, now)
+            else:
+                exp.deletions += n
+                exp.timestamp = now
+
+    def creation_observed(self, key: str) -> None:
+        with self._lock:
+            exp = self._by_key.get(key)
+            if exp is not None and exp.creations > 0:
+                exp.creations -= 1
+
+    def deletion_observed(self, key: str) -> None:
+        with self._lock:
+            exp = self._by_key.get(key)
+            if exp is not None and exp.deletions > 0:
+                exp.deletions -= 1
+
+    def satisfied(self, key: str, now: float = None) -> bool:
+        """True when it is safe to compute a fresh diff for this job."""
+        now = time.time() if now is None else now
+        with self._lock:
+            exp = self._by_key.get(key)
+            if exp is None:
+                return True
+            if exp.creations <= 0 and exp.deletions <= 0:
+                return True
+            # Expired expectations are treated as satisfied (reference
+            # behavior: controller must not deadlock on a lost event).
+            return (now - exp.timestamp) > EXPECTATION_TIMEOUT_S
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._by_key.pop(key, None)

@@ -1,0 +1,69 @@
+"""Gang scheduling — all-or-nothing replica admission.
+
+Reference: volcano ``PodGroup`` with ``minMember = Σ replicas`` synced by the
+common job framework when ``--enable-gang-scheduling`` is on (SURVEY.md §2
+"Gang scheduling", §3.5). The property preserved (BASELINE.json:5): every
+worker in a slice starts atomically, so rendezvous cannot deadlock on a
+partial gang — which is also how a TPU slice is allocated in the first
+place.
+
+Locally: a :class:`ProcessGroup` record per job; admission asks the runner
+for free slots and admits only if the whole gang fits.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from .runner import ProcessRunner
+
+
+@dataclass
+class ProcessGroup:
+    """PodGroup analog."""
+
+    job_key: str
+    min_member: int
+
+
+class GangScheduler:
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._groups: Dict[str, ProcessGroup] = {}
+        self._lock = threading.Lock()
+
+    def sync_group(self, job_key: str, min_member: int) -> ProcessGroup:
+        """Create or update the job's ProcessGroup (SyncPodGroup analog)."""
+        with self._lock:
+            pg = self._groups.get(job_key)
+            if pg is None:
+                pg = ProcessGroup(job_key=job_key, min_member=min_member)
+                self._groups[job_key] = pg
+            else:
+                pg.min_member = min_member
+            return pg
+
+    def get_group(self, job_key: str) -> Optional[ProcessGroup]:
+        with self._lock:
+            return self._groups.get(job_key)
+
+    def delete_group(self, job_key: str) -> None:
+        """DeletePodGroup analog (job finished/removed)."""
+        with self._lock:
+            self._groups.pop(job_key, None)
+
+    def can_admit(self, job_key: str, needed_now: int, runner: ProcessRunner) -> bool:
+        """All-or-nothing admission: may this job start ``needed_now`` more
+        replicas right now?
+
+        Non-gang mode admits anything the runner has room for piecewise;
+        gang mode admits only if the whole remaining gang fits at once.
+        """
+        slots = runner.schedulable_slots()
+        if slots is None:
+            return True
+        if not self.enabled:
+            return slots >= 1
+        return slots >= needed_now

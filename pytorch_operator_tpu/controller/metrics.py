@@ -1,0 +1,84 @@
+"""Prometheus-style counters for the supervisor.
+
+Reference: promauto counters (jobs created/succeeded/failed/restarted) served
+on ``--monitoring-port`` (SURVEY.md §2 "Metrics"). Locally: an in-process
+registry rendered in Prometheus text exposition format via the CLI or an
+optional HTTP endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+
+class Counter:
+    """A labeled monotonic counter."""
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def get(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> str:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} counter")
+        with self._lock:
+            if not self._values:
+                lines.append(f"{self.name} 0")
+            for key, value in sorted(self._values.items()):
+                if key:
+                    label_str = ",".join(f'{k}="{v}"' for k, v in key)
+                    lines.append(f"{self.name}{{{label_str}}} {value:g}")
+                else:
+                    lines.append(f"{self.name} {value:g}")
+        return "\n".join(lines)
+
+
+class MetricsRegistry:
+    """Registry of supervisor counters (reference counter set + replica ops)."""
+
+    def __init__(self) -> None:
+        self._counters: Dict[str, Counter] = {}
+        self.jobs_created = self.counter(
+            "tpujob_jobs_created_total", "TPUJobs accepted by the supervisor"
+        )
+        self.jobs_succeeded = self.counter(
+            "tpujob_jobs_succeeded_total", "TPUJobs that reached Succeeded"
+        )
+        self.jobs_failed = self.counter(
+            "tpujob_jobs_failed_total", "TPUJobs that reached Failed"
+        )
+        self.jobs_restarted = self.counter(
+            "tpujob_jobs_restarted_total", "Replica restarts across all TPUJobs"
+        )
+        self.replicas_created = self.counter(
+            "tpujob_replicas_created_total", "Replica processes launched"
+        )
+        self.replicas_deleted = self.counter(
+            "tpujob_replicas_deleted_total", "Replica processes terminated"
+        )
+        self.replicas_failed = self.counter(
+            "tpujob_replicas_failed_total", "Replica processes that exited nonzero"
+        )
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        if name not in self._counters:
+            self._counters[name] = Counter(name, help_text)
+        return self._counters[name]
+
+    def render_text(self) -> str:
+        return "\n".join(c.render() for c in self._counters.values()) + "\n"

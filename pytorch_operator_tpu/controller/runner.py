@@ -1,0 +1,335 @@
+"""Replica process runners — the pod-control analog.
+
+Reference: pod creation/deletion via ``podControl`` and the kubelet actually
+running containers (SURVEY.md §3.2–3.3). Locally a *replica* is an OS
+process. Two runners share one interface:
+
+- :class:`SubprocessRunner` — the real thing: ``subprocess.Popen`` with
+  injected env, per-replica log files, termination with escalation.
+- :class:`FakeRunner` — the fake-clientset analog (SURVEY.md §4): records
+  create/delete actions, and tests drive phases by hand
+  (``set_phase(name, FAILED, exit_code=137)``) — no processes involved.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..api.types import ProcessTemplate, ReplicaPhase, ReplicaType
+
+
+def replica_name(job_key: str, rtype: ReplicaType, index: int) -> str:
+    """Canonical replica name: ``<ns>/<job>-<type>-<index>`` (pod-name analog)."""
+    return f"{job_key}-{rtype.value.lower()}-{index}"
+
+
+def normalize_exit_code(code: Optional[int]) -> Optional[int]:
+    """Map Popen's signal encoding (-N) to the container convention (128+N)
+    the ExitCode restart policy is defined against — so SIGKILL surfaces as
+    137 (retryable), matching the reference's pod-level semantics."""
+    if code is not None and code < 0:
+        return 128 - code
+    return code
+
+
+@dataclass
+class ReplicaHandle:
+    """Tracking record for one replica process (pod-object analog)."""
+
+    name: str
+    job_key: str
+    replica_type: ReplicaType
+    index: int
+    phase: ReplicaPhase = ReplicaPhase.PENDING
+    exit_code: Optional[int] = None
+    pid: Optional[int] = None
+    created_at: float = 0.0
+    finished_at: Optional[float] = None
+    log_path: Optional[str] = None
+
+    def is_active(self) -> bool:
+        return self.phase in (ReplicaPhase.PENDING, ReplicaPhase.RUNNING)
+
+    def is_finished(self) -> bool:
+        return self.phase in (ReplicaPhase.SUCCEEDED, ReplicaPhase.FAILED)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "job_key": self.job_key,
+            "replica_type": self.replica_type.value,
+            "index": self.index,
+            "phase": self.phase.value,
+            "exit_code": self.exit_code,
+            "pid": self.pid,
+            "created_at": self.created_at,
+            "finished_at": self.finished_at,
+            "log_path": self.log_path,
+        }
+
+
+class ProcessRunner:
+    """Interface both runners implement."""
+
+    def create(
+        self,
+        job_key: str,
+        rtype: ReplicaType,
+        index: int,
+        template: ProcessTemplate,
+        env: Dict[str, str],
+    ) -> ReplicaHandle:
+        raise NotImplementedError
+
+    def delete(self, name: str, grace_seconds: float = 5.0) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        """Poll live processes and update phases (informer-refresh analog)."""
+
+    def list_for_job(self, job_key: str) -> List[ReplicaHandle]:
+        raise NotImplementedError
+
+    def get(self, name: str) -> Optional[ReplicaHandle]:
+        raise NotImplementedError
+
+    def remove_record(self, name: str) -> None:
+        """Forget a finished replica's record (pod object deletion analog)."""
+        raise NotImplementedError
+
+    def schedulable_slots(self) -> Optional[int]:
+        """Free scheduling slots, or None for unlimited (gang admission input)."""
+        return None
+
+
+class FakeRunner(ProcessRunner):
+    """In-memory runner for controller tests (fake clientset analog).
+
+    Created replicas start PENDING; tests move them with :meth:`set_phase`.
+    Every create/delete is appended to :attr:`actions` for assertions, and
+    the env each replica was created with is kept in :attr:`envs`.
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.handles: Dict[str, ReplicaHandle] = {}
+        self.envs: Dict[str, Dict[str, str]] = {}
+        self.templates: Dict[str, ProcessTemplate] = {}
+        self.actions: List[tuple] = []
+        self.capacity = capacity  # None = unlimited
+
+    def create(self, job_key, rtype, index, template, env):
+        name = replica_name(job_key, rtype, index)
+        if name in self.handles:
+            raise RuntimeError(f"duplicate create for {name}")
+        h = ReplicaHandle(
+            name=name,
+            job_key=job_key,
+            replica_type=rtype,
+            index=index,
+            phase=ReplicaPhase.PENDING,
+            created_at=time.time(),
+        )
+        self.handles[name] = h
+        self.envs[name] = dict(env)
+        self.templates[name] = template
+        self.actions.append(("create", name))
+        return h
+
+    def delete(self, name, grace_seconds: float = 5.0):
+        self.actions.append(("delete", name))
+        h = self.handles.pop(name, None)
+        if h is not None:
+            self.envs.pop(name, None)
+            self.templates.pop(name, None)
+
+    def sync(self):
+        pass
+
+    def list_for_job(self, job_key):
+        return [h for h in self.handles.values() if h.job_key == job_key]
+
+    def get(self, name):
+        return self.handles.get(name)
+
+    def remove_record(self, name):
+        self.handles.pop(name, None)
+
+    def schedulable_slots(self):
+        if self.capacity is None:
+            return None
+        used = sum(1 for h in self.handles.values() if h.is_active())
+        return max(0, self.capacity - used)
+
+    # --- test helpers ---
+
+    def set_phase(self, name: str, phase: ReplicaPhase, exit_code: Optional[int] = None):
+        h = self.handles[name]
+        h.phase = phase
+        if exit_code is not None:
+            h.exit_code = exit_code
+        if phase in (ReplicaPhase.SUCCEEDED, ReplicaPhase.FAILED):
+            h.finished_at = time.time()
+
+    def set_all_running(self, job_key: str):
+        for h in self.list_for_job(job_key):
+            if h.phase == ReplicaPhase.PENDING:
+                h.phase = ReplicaPhase.RUNNING
+
+
+class SubprocessRunner(ProcessRunner):
+    """Real runner: replicas are local OS processes.
+
+    stdout+stderr of each replica goes to
+    ``<state_dir>/logs/<ns>_<job>-<type>-<index>.log`` (kubectl-logs analog).
+    ``max_slots`` bounds concurrently active replicas — the "cluster
+    capacity" that gang admission checks against.
+    """
+
+    def __init__(self, state_dir: Path, max_slots: Optional[int] = None):
+        self.state_dir = Path(state_dir)
+        self.log_dir = self.state_dir / "logs"
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        self.max_slots = max_slots
+        self.handles: Dict[str, ReplicaHandle] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._log_files: Dict[str, object] = {}
+        self._lock = threading.RLock()
+
+    def _argv(self, template: ProcessTemplate) -> List[str]:
+        if template.command:
+            argv = list(template.command)
+        else:
+            argv = [sys.executable, "-m", template.module]
+        return argv + list(template.args)
+
+    def create(self, job_key, rtype, index, template, env):
+        name = replica_name(job_key, rtype, index)
+        with self._lock:
+            if name in self.handles and self.handles[name].is_active():
+                raise RuntimeError(f"duplicate create for live replica {name}")
+            log_path = self.log_dir / (name.replace("/", "_") + ".log")
+            full_env = dict(os.environ)
+            full_env.update(template.env)
+            full_env.update(env)
+            log_f = open(log_path, "ab")
+            try:
+                proc = subprocess.Popen(
+                    self._argv(template),
+                    env=full_env,
+                    cwd=template.working_dir or None,
+                    stdout=log_f,
+                    stderr=subprocess.STDOUT,
+                    start_new_session=True,  # isolate signals from supervisor
+                )
+            except OSError as e:
+                log_f.write(f"[tpujob] failed to launch: {e}\n".encode())
+                log_f.close()
+                h = ReplicaHandle(
+                    name=name,
+                    job_key=job_key,
+                    replica_type=rtype,
+                    index=index,
+                    phase=ReplicaPhase.FAILED,
+                    exit_code=127,
+                    created_at=time.time(),
+                    finished_at=time.time(),
+                    log_path=str(log_path),
+                )
+                self.handles[name] = h
+                return h
+            h = ReplicaHandle(
+                name=name,
+                job_key=job_key,
+                replica_type=rtype,
+                index=index,
+                phase=ReplicaPhase.RUNNING,
+                pid=proc.pid,
+                created_at=time.time(),
+                log_path=str(log_path),
+            )
+            self.handles[name] = h
+            self._procs[name] = proc
+            self._log_files[name] = log_f
+            return h
+
+    def sync(self):
+        with self._lock:
+            for name, proc in list(self._procs.items()):
+                code = proc.poll()
+                if code is None:
+                    continue
+                h = self.handles[name]
+                h.exit_code = normalize_exit_code(code)
+                h.phase = (
+                    ReplicaPhase.SUCCEEDED if code == 0 else ReplicaPhase.FAILED
+                )
+                h.finished_at = time.time()
+                self._procs.pop(name)
+                f = self._log_files.pop(name, None)
+                if f is not None:
+                    f.close()
+
+    def delete(self, name, grace_seconds: float = 5.0):
+        with self._lock:
+            proc = self._procs.get(name)
+            h = self.handles.get(name)
+        if proc is not None and proc.poll() is None:
+            # SIGTERM the whole process group, escalate to SIGKILL.
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                proc.wait(timeout=grace_seconds)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.wait()
+        with self._lock:
+            proc = self._procs.pop(name, None)
+            if proc is not None and h is not None:
+                h.exit_code = normalize_exit_code(proc.returncode)
+                h.phase = ReplicaPhase.FAILED if proc.returncode else ReplicaPhase.SUCCEEDED
+                h.finished_at = time.time()
+            f = self._log_files.pop(name, None)
+            if f is not None:
+                f.close()
+            self.handles.pop(name, None)
+
+    def list_for_job(self, job_key):
+        with self._lock:
+            return [h for h in self.handles.values() if h.job_key == job_key]
+
+    def get(self, name):
+        with self._lock:
+            return self.handles.get(name)
+
+    def remove_record(self, name):
+        with self._lock:
+            if name in self._procs:
+                raise RuntimeError(f"cannot remove record of live replica {name}")
+            self.handles.pop(name, None)
+
+    def schedulable_slots(self):
+        if self.max_slots is None:
+            return None
+        with self._lock:
+            used = sum(1 for h in self.handles.values() if h.is_active())
+        return max(0, self.max_slots - used)
+
+    def shutdown(self):
+        """Terminate everything (supervisor exit)."""
+        with self._lock:
+            names = list(self._procs.keys())
+        for name in names:
+            self.delete(name, grace_seconds=2.0)

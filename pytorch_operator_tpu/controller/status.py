@@ -1,0 +1,83 @@
+"""Status engine: replica phases → replica statuses → job conditions.
+
+Reference: ``UpdateJobStatus`` / ``updatePyTorchJobConditions`` in
+``pkg/controller.v1/pytorch/status.go`` (SURVEY.md §2 "Status engine"):
+
+- job Succeeded ⇔ Master replica Succeeded;
+- Failed per restart policy (Never, or ExitCode 1–127, or backoff/deadline);
+- Restarting while a retryable failure is being respawned;
+- k8s Events emitted on each transition (events handled by the reconciler).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+from ..api.types import (
+    RETRYABLE_EXIT_CODE_MIN,
+    ReplicaPhase,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+)
+from .runner import ReplicaHandle
+
+# Failure classification results.
+ACTION_NONE = "none"          # leave it (no restart, not a job failure)
+ACTION_RESTART = "restart"    # retryable: respawn the replica
+ACTION_FAIL_JOB = "fail_job"  # permanent: the job fails
+
+
+def classify_exit(policy: RestartPolicy, exit_code: Optional[int]) -> str:
+    """Classify a FAILED replica exit under a restart policy.
+
+    Reference semantics (SURVEY.md §2 "Restart policies"): ExitCode treats
+    1–127 as permanent, >=128 (signal deaths: 128+SIGN, e.g. preemption's
+    SIGKILL → 137) as retryable.
+    """
+    code = 1 if exit_code is None else exit_code
+    if policy == RestartPolicy.ALWAYS:
+        return ACTION_RESTART
+    if policy == RestartPolicy.ON_FAILURE:
+        return ACTION_RESTART if code != 0 else ACTION_NONE
+    if policy == RestartPolicy.NEVER:
+        return ACTION_FAIL_JOB
+    if policy == RestartPolicy.EXIT_CODE:
+        # Negative codes are raw Popen signal deaths a runner failed to
+        # normalize; signals are retryable by definition here.
+        if code >= RETRYABLE_EXIT_CODE_MIN or code < 0:
+            return ACTION_RESTART
+        return ACTION_FAIL_JOB
+    return ACTION_FAIL_JOB
+
+
+def compute_replica_statuses(
+    handles: Iterable[ReplicaHandle],
+) -> Dict[ReplicaType, ReplicaStatus]:
+    statuses: Dict[ReplicaType, ReplicaStatus] = {}
+    for h in handles:
+        rs = statuses.setdefault(h.replica_type, ReplicaStatus())
+        if h.phase in (ReplicaPhase.PENDING, ReplicaPhase.RUNNING):
+            rs.active += 1
+        elif h.phase == ReplicaPhase.SUCCEEDED:
+            rs.succeeded += 1
+        elif h.phase == ReplicaPhase.FAILED:
+            rs.failed += 1
+    return statuses
+
+
+def master_handle(handles: Iterable[ReplicaHandle]) -> Optional[ReplicaHandle]:
+    for h in handles:
+        if h.replica_type == ReplicaType.MASTER and h.index == 0:
+            return h
+    return None
+
+
+def update_replica_statuses(job: TPUJob, handles: Iterable[ReplicaHandle]) -> None:
+    statuses = compute_replica_statuses(handles)
+    # Keep zeroed entries for every declared replica type (reference shows
+    # all replica types in status).
+    for rtype in job.spec.replica_specs:
+        statuses.setdefault(rtype, ReplicaStatus())
+    job.status.replica_statuses = statuses

@@ -1,0 +1,135 @@
+"""In-process job store.
+
+Reference: the Kubernetes API server + informer caches (SURVEY.md §1 layers
+1–2) collapse locally into a thread-safe dict of TPUJob objects, optionally
+persisted as JSON files so the CLI can inspect state across processes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ..api.types import TPUJob
+
+
+def job_key(job: TPUJob) -> str:
+    return f"{job.metadata.namespace}/{job.metadata.name}"
+
+
+class JobStore:
+    def __init__(self, persist_dir: Optional[Path] = None):
+        self._jobs: Dict[str, TPUJob] = {}
+        self._lock = threading.RLock()
+        self.persist_dir = Path(persist_dir) if persist_dir else None
+        if self.persist_dir is not None:
+            self.persist_dir.mkdir(parents=True, exist_ok=True)
+            self._load_all()
+
+    # ---- persistence ----
+
+    def _path_for(self, key: str) -> Path:
+        return self.persist_dir / (key.replace("/", "_") + ".json")
+
+    def _load_all(self) -> None:
+        for p in sorted(self.persist_dir.glob("*.json")):
+            try:
+                job = TPUJob.from_dict(json.loads(p.read_text()))
+            except (ValueError, KeyError) as e:
+                # Corrupt state file: skip rather than brick the supervisor.
+                print(f"[tpujob] warning: skipping corrupt state file {p}: {e}")
+                continue
+            self._jobs[job_key(job)] = job
+
+    def _persist(self, key: str) -> None:
+        if self.persist_dir is None:
+            return
+        job = self._jobs.get(key)
+        path = self._path_for(key)
+        if job is None:
+            path.unlink(missing_ok=True)
+        else:
+            tmp = path.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(job.to_dict(), indent=2))
+            tmp.replace(path)
+
+    # ---- CRUD ----
+
+    def add(self, job: TPUJob, now: Optional[float] = None) -> str:
+        now = time.time() if now is None else now
+        key = job_key(job)
+        with self._lock:
+            if key in self._jobs:
+                raise ValueError(f"job {key} already exists")
+            if not job.metadata.uid:
+                job.metadata.uid = uuid.uuid4().hex
+            if job.metadata.creation_timestamp is None:
+                job.metadata.creation_timestamp = now
+            if job.status.submit_time is None:
+                job.status.submit_time = now
+            self._jobs[key] = job
+            self._persist(key)
+            return key
+
+    def get(self, key: str) -> Optional[TPUJob]:
+        with self._lock:
+            return self._jobs.get(key)
+
+    def update(self, job: TPUJob) -> None:
+        key = job_key(job)
+        with self._lock:
+            self._jobs[key] = job
+            self._persist(key)
+
+    def delete(self, key: str) -> Optional[TPUJob]:
+        with self._lock:
+            job = self._jobs.pop(key, None)
+            self._persist(key)
+            return job
+
+    def list(self) -> List[TPUJob]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._jobs.keys())
+
+    def rescan(self) -> List[str]:
+        """Pick up job files written by other processes (``tpujob submit``).
+
+        In-memory objects stay authoritative — this process writes them —
+        so only unknown keys are loaded. Returns newly discovered keys.
+        """
+        if self.persist_dir is None:
+            return []
+        new_keys: List[str] = []
+        with self._lock:
+            for p in sorted(self.persist_dir.glob("*.json")):
+                try:
+                    job = TPUJob.from_dict(json.loads(p.read_text()))
+                except (ValueError, KeyError):
+                    continue
+                key = job_key(job)
+                if key not in self._jobs:
+                    self._jobs[key] = job
+                    new_keys.append(key)
+        return new_keys
+
+    def deletion_markers(self) -> List[str]:
+        """Keys with a pending cross-process deletion request."""
+        if self.persist_dir is None:
+            return []
+        keys = []
+        for p in self.persist_dir.glob("*.delete"):
+            keys.append(p.stem.replace("_", "/", 1))
+        return keys
+
+    def clear_deletion_marker(self, key: str) -> None:
+        if self.persist_dir is None:
+            return
+        (self.persist_dir / (key.replace("/", "_") + ".delete")).unlink(missing_ok=True)

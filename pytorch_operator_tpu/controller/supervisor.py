@@ -1,0 +1,209 @@
+"""The job supervisor — operator main loop.
+
+Reference: ``cmd/pytorch-operator.v1`` + ``controller.Run(threadiness,
+stopCh)`` (SURVEY.md §3.1): wire stores/recorders/reconciler, then loop
+reconcile passes until jobs finish. Also owns TTL garbage collection and
+elastic resize (scale) requests.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from ..api.defaults import set_defaults
+from ..api.types import ConditionType, ReplicaType, TPUJob
+from ..api.validation import ValidationError, validate
+from .events import EventRecorder
+from .expectations import ControllerExpectations
+from .gang import GangScheduler
+from .metrics import MetricsRegistry
+from .reconciler import Reconciler
+from .runner import ProcessRunner, SubprocessRunner
+from .store import JobStore, job_key
+
+
+def default_state_dir() -> Path:
+    return Path(os.environ.get("TPUJOB_HOME", ".tpujob"))
+
+
+class Supervisor:
+    def __init__(
+        self,
+        state_dir: Optional[Path] = None,
+        runner: Optional[ProcessRunner] = None,
+        gang_enabled: bool = True,
+        max_slots: Optional[int] = None,
+        poll_interval: float = 0.1,
+        persist: bool = True,
+    ):
+        self.state_dir = Path(state_dir) if state_dir is not None else default_state_dir()
+        self.state_dir.mkdir(parents=True, exist_ok=True)
+        self.poll_interval = poll_interval
+        self.store = JobStore(
+            persist_dir=self.state_dir / "jobs" if persist else None
+        )
+        self.events = EventRecorder(sink_dir=self.state_dir / "events")
+        self.metrics = MetricsRegistry()
+        self.runner = runner if runner is not None else SubprocessRunner(
+            self.state_dir, max_slots=max_slots
+        )
+        self.gang = GangScheduler(enabled=gang_enabled)
+        self.expectations = ControllerExpectations()
+        self.reconciler = Reconciler(
+            store=self.store,
+            runner=self.runner,
+            events=self.events,
+            metrics=self.metrics,
+            gang=self.gang,
+            expectations=self.expectations,
+            status_root=self.state_dir / "status",
+        )
+        self._lock = threading.Lock()
+
+    # ---- API-server-ish surface ----
+
+    def submit(self, job: TPUJob) -> str:
+        """Accept a job: default, validate, store (kubectl-apply analog)."""
+        set_defaults(job)
+        validate(job)
+        key = self.store.add(job)
+        self.events.normal(key, "TPUJobSubmitted", f"TPUJob {key} accepted.")
+        return key
+
+    def get(self, key: str) -> Optional[TPUJob]:
+        return self.store.get(key)
+
+    def list_jobs(self) -> List[TPUJob]:
+        return self.store.list()
+
+    def delete_job(self, key: str) -> bool:
+        """Delete a job and terminate its replicas (kubectl delete analog)."""
+        job = self.store.get(key)
+        if job is None:
+            return False
+        for h in self.runner.list_for_job(key):
+            self.runner.delete(h.name)
+        self.gang.delete_group(key)
+        self.expectations.delete_expectations(key)
+        self.store.delete(key)
+        self.events.drop_job(key)
+        return True
+
+    def scale(self, key: str, worker_replicas: int) -> TPUJob:
+        """Elastic resize: change the Worker count and re-rendezvous the gang.
+
+        Requires an elastic_policy; the new count must lie within
+        [min_replicas, max_replicas] (reference: torchelastic min/max).
+        """
+        job = self.store.get(key)
+        if job is None:
+            raise KeyError(key)
+        ep = job.spec.elastic_policy
+        if ep is None:
+            raise ValidationError(["scale: job has no elastic_policy"])
+        if not (ep.min_replicas <= worker_replicas <= ep.max_replicas):
+            raise ValidationError(
+                [
+                    f"scale: worker_replicas={worker_replicas} outside "
+                    f"[{ep.min_replicas}, {ep.max_replicas}]"
+                ]
+            )
+        workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+        if workers is None:
+            raise ValidationError(["scale: job has no Worker replicas"])
+        if workers.replicas == worker_replicas:
+            return job
+        workers.replicas = worker_replicas
+        # Membership change → tear down the world; next sync re-creates it
+        # with the new WORLD_SIZE (elastic re-rendezvous).
+        handles = self.runner.list_for_job(key)
+        if handles and not job.is_finished():
+            for h in handles:
+                self.runner.delete(h.name)
+                self.metrics.replicas_deleted.inc()
+            job.status.restart_count += 1
+            self.metrics.jobs_restarted.inc()
+            msg = (
+                f"elastic resize to {worker_replicas} workers "
+                f"(restart #{job.status.restart_count})."
+            )
+            job.set_condition(ConditionType.RESTARTING, reason="TPUJobScaled", message=msg)
+            self.events.normal(key, "TPUJobScaled", msg)
+        self.store.update(job)
+        return job
+
+    # ---- reconcile loop ----
+
+    def sync_once(self, now: Optional[float] = None) -> bool:
+        """One pass over all jobs; returns True if any job still active."""
+        now = time.time() if now is None else now
+        any_active = False
+        for key in self.store.keys():
+            job = self.store.get(key)
+            if job is None:
+                continue
+            if job.is_finished():
+                self._gc_ttl(job, key, now)
+                continue
+            if self.reconciler.sync(key, now=now):
+                any_active = True
+        return any_active
+
+    def _gc_ttl(self, job: TPUJob, key: str, now: float) -> None:
+        """TTLSecondsAfterFinished → delete the job object (SURVEY.md §3.4)."""
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is None or job.status.completion_time is None:
+            return
+        if now - job.status.completion_time >= ttl:
+            self.delete_job(key)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> TPUJob:
+        """Reconcile THIS job until it finishes (or timeout); returns it.
+
+        Only the named job is synced — a foreground ``tpujob run`` must not
+        also reconcile jobs owned by a daemon sharing the state dir (two
+        supervisors spawning duplicate worlds for the same job).
+        """
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            self.reconciler.sync(key)
+            job = self.store.get(key)
+            if job is None:
+                raise KeyError(f"job {key} disappeared (TTL GC or deletion)")
+            if job.is_finished():
+                return job
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(f"job {key} did not finish within {timeout}s")
+            time.sleep(self.poll_interval)
+
+    def run(self, job: TPUJob, timeout: Optional[float] = None) -> TPUJob:
+        """Submit and reconcile to completion (foreground ``tpujob run``)."""
+        key = self.submit(job)
+        return self.wait(key, timeout=timeout)
+
+    def process_deletion_markers(self) -> None:
+        """Act on cross-process ``tpujob delete`` requests: this process owns
+        the replica processes, so it performs the kill + record removal."""
+        for key in self.store.deletion_markers():
+            self.delete_job(key)
+            self.store.clear_deletion_marker(key)
+
+    def write_metrics_file(self) -> None:
+        """Expose counters for ``tpujob metrics`` (monitoring-port analog)."""
+        (self.state_dir / "metrics.prom").write_text(self.metrics.render_text())
+
+    def shutdown(self) -> None:
+        if isinstance(self.runner, SubprocessRunner):
+            self.runner.shutdown()
+
+
+def schedule_to_first_step_latency(job: TPUJob) -> Optional[float]:
+    """The north-star latency metric (BASELINE.json:2): submit-accepted →
+    first training step executed."""
+    if job.status.submit_time is None or job.status.first_step_time is None:
+        return None
+    return job.status.first_step_time - job.status.submit_time

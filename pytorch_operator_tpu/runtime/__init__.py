@@ -1,0 +1,3 @@
+"""Runtime: cluster-spec env injection and rendezvous helpers."""
+
+from .env import build_cluster_env, replica_rank  # noqa: F401

@@ -1,0 +1,85 @@
+"""Cluster-spec environment injection.
+
+Reference: ``SetClusterSpec`` in ``pkg/controller.v1/pytorch/pod.go``
+(SURVEY.md §2 "Pod management"): inject MASTER_ADDR/MASTER_PORT/WORLD_SIZE/
+RANK/PYTHONUNBUFFERED so c10d's ``env://`` rendezvous works; rank 0 is the
+Master, worker i gets rank i+1.
+
+TPU-native replacement (BASELINE.json:5): the same topology is expressed for
+PJRT/jax.distributed — ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES`` plus a
+coordinator address for ``jax.distributed.initialize``. The legacy
+MASTER_ADDR set is injected too, for parity and for torch-based workloads.
+
+The init-container DNS gate of the reference (workers loop ``nslookup
+$MASTER_ADDR``) is replaced by jax.distributed's built-in
+connect-with-timeout retry (see runtime/rendezvous.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..api.types import ReplicaType, TPUJob
+
+
+def replica_rank(rtype: ReplicaType, index: int) -> int:
+    """Master → 0; Worker i → i+1 (reference rank assignment)."""
+    return 0 if rtype == ReplicaType.MASTER else index + 1
+
+
+def build_cluster_env(
+    job: TPUJob,
+    rtype: ReplicaType,
+    index: int,
+    *,
+    num_processes: Optional[int] = None,
+    coordinator_host: str = "127.0.0.1",
+    status_dir: Optional[str] = None,
+) -> Dict[str, str]:
+    """Build the injected environment for one replica process.
+
+    ``num_processes`` overrides the spec's total (elastic re-rendezvous with
+    a different world size); defaults to spec.total_replicas().
+    """
+    total = num_processes if num_processes is not None else job.spec.total_replicas()
+    rank = replica_rank(rtype, index)
+    port = job.spec.port or 23456
+    coordinator = f"{coordinator_host}:{port}"
+    key = f"{job.metadata.namespace}/{job.metadata.name}"
+
+    env: Dict[str, str] = {
+        # ---- reference-parity set (c10d env:// rendezvous) ----
+        "MASTER_ADDR": coordinator_host,
+        "MASTER_PORT": str(port),
+        "WORLD_SIZE": str(total),
+        "RANK": str(rank),
+        "PYTHONUNBUFFERED": "1",
+        # ---- TPU-native set (PJRT / jax.distributed) ----
+        "TPU_WORKER_ID": str(rank),
+        "TPU_WORKER_HOSTNAMES": ",".join([coordinator_host] * total),
+        "TPUJOB_COORDINATOR_ADDRESS": coordinator,
+        "TPUJOB_NUM_PROCESSES": str(total),
+        "TPUJOB_PROCESS_ID": str(rank),
+        # ---- job identity / bookkeeping ----
+        "TPUJOB_NAME": job.metadata.name,
+        "TPUJOB_NAMESPACE": job.metadata.namespace,
+        "TPUJOB_KEY": key,
+        "TPUJOB_REPLICA_TYPE": rtype.value,
+        "TPUJOB_REPLICA_INDEX": str(index),
+        "TPUJOB_RESTART_COUNT": str(job.status.restart_count),
+    }
+
+    resources = job.spec.replica_specs[rtype].template.resources
+    if resources.cpu_devices > 0:
+        # Test/CI backend: virtual CPU devices (SURVEY.md §4).
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={resources.cpu_devices}"
+        )
+    elif resources.tpu_chips > 0:
+        env["PJRT_DEVICE"] = "TPU"
+
+    if status_dir is not None:
+        env["TPUJOB_STATUS_DIR"] = status_dir
+
+    return env

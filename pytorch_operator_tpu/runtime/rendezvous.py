@@ -1,0 +1,123 @@
+"""Worker-side rendezvous and status reporting.
+
+Reference mapping (SURVEY.md §5 "Distributed communication backend"):
+
+- ``dist.init_process_group('nccl', init_method='env://')`` reading
+  MASTER_ADDR/PORT/RANK/WORLD_SIZE → :func:`initialize_from_env` reading the
+  TPUJOB_* env the supervisor injected and calling
+  ``jax.distributed.initialize(coordinator, num_processes, process_id)``.
+- The reference's worker initContainer DNS-gate (``until nslookup
+  $MASTER_ADDR``) → jax.distributed's built-in connect retry; we add an
+  outer retry loop for coordinator-not-yet-listening races.
+- DDP allreduce hooks over NCCL → XLA collectives over ICI/DCN, expressed
+  via jax.sharding / shard_map in the workload (parallel/).
+
+Workloads also report events (first step, per-step metrics) to
+``$TPUJOB_STATUS_DIR`` as JSONL; the supervisor folds these into job status
+(schedule-to-first-step latency, BASELINE.json:2).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+
+@dataclass
+class WorldInfo:
+    num_processes: int
+    process_id: int
+    coordinator: str
+    replica_type: str
+    replica_index: int
+    restart_count: int
+    job_key: str
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def world_from_env() -> WorldInfo:
+    """Read the supervisor-injected cluster spec (SetClusterSpec analog)."""
+    return WorldInfo(
+        num_processes=int(os.environ.get("TPUJOB_NUM_PROCESSES", "1")),
+        process_id=int(os.environ.get("TPUJOB_PROCESS_ID", "0")),
+        coordinator=os.environ.get("TPUJOB_COORDINATOR_ADDRESS", "127.0.0.1:23456"),
+        replica_type=os.environ.get("TPUJOB_REPLICA_TYPE", "Master"),
+        replica_index=int(os.environ.get("TPUJOB_REPLICA_INDEX", "0")),
+        restart_count=int(os.environ.get("TPUJOB_RESTART_COUNT", "0")),
+        job_key=os.environ.get("TPUJOB_KEY", "default/local"),
+    )
+
+
+def initialize_from_env(
+    timeout_s: float = 60.0, retry_interval_s: float = 1.0
+) -> WorldInfo:
+    """Join the jax.distributed world described by the environment.
+
+    Single-process worlds skip initialization entirely (single-process SPMD
+    across local devices). Multi-process worlds call
+    ``jax.distributed.initialize`` with retries — the connect-retry gate that
+    replaces the reference's initContainer DNS loop.
+    """
+    world = world_from_env()
+    if world.num_processes <= 1:
+        return world
+
+    import jax
+
+    deadline = time.time() + timeout_s
+    last_err: Optional[Exception] = None
+    while time.time() < deadline:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=world.coordinator,
+                num_processes=world.num_processes,
+                process_id=world.process_id,
+            )
+            return world
+        except Exception as e:  # pragma: no cover - env-dependent errors
+            last_err = e
+            time.sleep(retry_interval_s)
+    raise TimeoutError(
+        f"rendezvous with coordinator {world.coordinator} failed after "
+        f"{timeout_s}s: {last_err}"
+    )
+
+
+# ---- status reporting (workload → supervisor) ----
+
+
+def _status_path() -> Optional[Path]:
+    d = os.environ.get("TPUJOB_STATUS_DIR")
+    if not d:
+        return None
+    rtype = os.environ.get("TPUJOB_REPLICA_TYPE", "Master").lower()
+    idx = os.environ.get("TPUJOB_REPLICA_INDEX", "0")
+    return Path(d) / f"{rtype}-{idx}.jsonl"
+
+
+def report(event: str, **fields) -> None:
+    """Append a status record; no-op when not running under the supervisor."""
+    path = _status_path()
+    if path is None:
+        return
+    rec = {"event": event, "ts": time.time(), **fields}
+    try:
+        with path.open("a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
+def report_first_step(step: int = 0) -> None:
+    report("first_step", step=step)
+
+
+def report_metrics(step: int, **metrics) -> None:
+    report("metrics", step=step, **metrics)

@@ -1,0 +1,5 @@
+"""Runnable workload entrypoints launched by the supervisor.
+
+Mirror of the reference's ``examples/`` (SURVEY.md §1 layer 7) — but as
+first-class in-package modules run via ``python -m``.
+"""

@@ -1,0 +1,29 @@
+"""Test workload: exit with a given code, optionally only on first attempts.
+
+``--code N`` — exit code.
+``--until-restart K`` — exit with ``--code`` while TPUJOB_RESTART_COUNT < K,
+then exit 0 (models a crash that recovers after K restarts).
+``--sleep S`` — sleep first (keeps the replica Running for a while).
+"""
+
+import argparse
+import os
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--code", type=int, default=1)
+    p.add_argument("--until-restart", type=int, default=None)
+    p.add_argument("--sleep", type=float, default=0.0)
+    args = p.parse_args()
+    if args.sleep:
+        time.sleep(args.sleep)
+    restart = int(os.environ.get("TPUJOB_RESTART_COUNT", "0"))
+    if args.until_restart is not None and restart >= args.until_restart:
+        return 0
+    return args.code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,17 @@
+"""Trivial workload: report a first step and exit 0. Used by e2e tests."""
+
+from ..runtime import rendezvous
+
+
+def main() -> int:
+    world = rendezvous.world_from_env()
+    rendezvous.report_first_step()
+    print(
+        f"[noop] rank={world.process_id}/{world.num_processes} "
+        f"type={world.replica_type} idx={world.replica_index} done"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

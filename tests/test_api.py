@@ -260,3 +260,42 @@ spec:
         job.spec.run_policy.scheduling_policy.min_available = 10
         errs = validate_spec(job.spec)
         assert any("min_available" in e for e in errs)
+
+
+class TestNamespaceValidation:
+    def test_underscore_namespace_rejected(self):
+        job = new_job(name="ok")
+        job.metadata.namespace = "team_a"
+        with pytest.raises(ValidationError, match="metadata.namespace"):
+            validate(job)
+
+
+class TestTemplateParsing:
+    def test_scalar_command_rejected(self):
+        with pytest.raises(ValueError, match="list of argv strings"):
+            loads_job(
+                "metadata: {name: x}\nspec:\n  replica_specs:\n"
+                "    Master: {template: {command: 'python train.py'}}"
+            )
+
+    def test_bool_env_coerced_yaml_style(self):
+        job = loads_job(
+            "metadata: {name: x}\nspec:\n  replica_specs:\n"
+            "    Master: {template: {module: m, env: {DEBUG: true, N: 3}}}"
+        )
+        t = job.spec.replica_specs[ReplicaType.MASTER].template
+        assert t.env == {"DEBUG": "true", "N": "3"}
+
+    def test_structured_env_rejected(self):
+        with pytest.raises(ValueError, match="env values must be scalar"):
+            loads_job(
+                "metadata: {name: x}\nspec:\n  replica_specs:\n"
+                "    Master: {template: {module: m, env: {A: [1, 2]}}}"
+            )
+
+    def test_bad_port_string(self):
+        with pytest.raises(ValueError, match="spec.port: invalid integer"):
+            loads_job(
+                "metadata: {name: x}\nspec:\n  port: eighty\n  replica_specs:\n"
+                "    Master: {template: {module: m}}"
+            )

@@ -1,0 +1,96 @@
+"""CLI tests — the kubectl-surface analog, driven in-process via main()."""
+
+import pytest
+
+from pytorch_operator_tpu.client.cli import main
+
+
+@pytest.fixture
+def job_yaml(tmp_path):
+    p = tmp_path / "job.yaml"
+    p.write_text(
+        """
+metadata: {name: cli-job}
+spec:
+  replica_specs:
+    Master:
+      template: {module: pytorch_operator_tpu.workloads.noop}
+    Worker:
+      replicas: 1
+      template: {module: pytorch_operator_tpu.workloads.noop}
+"""
+    )
+    return p
+
+
+def run_cli(*argv) -> int:
+    return main([str(a) for a in argv])
+
+
+class TestCLI:
+    def test_run_get_describe_logs(self, tmp_path, job_yaml, capsys):
+        state = tmp_path / "state"
+        rc = run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "TPUJobSucceeded" in out
+        assert "schedule-to-first-step latency" in out
+
+        rc = run_cli("--state-dir", state, "get")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cli-job" in out and "Succeeded" in out
+
+        rc = run_cli("--state-dir", state, "describe", "cli-job")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "TPUJobCreated" in out  # events section
+        assert "Master: desired=1" in out
+
+        rc = run_cli("--state-dir", state, "logs", "cli-job")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[noop]" in out
+
+        rc = run_cli("--state-dir", state, "delete", "cli-job")
+        assert rc == 0
+        rc = run_cli("--state-dir", state, "get", "cli-job")
+        assert rc == 1
+
+    def test_run_invalid_spec(self, tmp_path, capsys):
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("metadata: {name: bad}\nspec: {replica_specs: {Worker: {template: {module: m}}}}\n")
+        rc = run_cli("--state-dir", tmp_path / "s", "run", bad)
+        err = capsys.readouterr().err
+        assert rc == 2
+        assert "Master" in err
+
+    def test_run_failing_job_exit_code(self, tmp_path, capsys):
+        y = tmp_path / "f.yaml"
+        y.write_text(
+            """
+metadata: {name: failer}
+spec:
+  replica_specs:
+    Master:
+      restart_policy: Never
+      template:
+        module: pytorch_operator_tpu.workloads.exit_with
+        args: ["--code", "5"]
+"""
+        )
+        rc = run_cli("--state-dir", tmp_path / "s", "run", y, "--timeout", "30")
+        assert rc == 1
+
+    def test_submit_then_get(self, tmp_path, job_yaml, capsys):
+        state = tmp_path / "state"
+        rc = run_cli("--state-dir", state, "submit", job_yaml)
+        assert rc == 0
+        rc = run_cli("--state-dir", state, "get")
+        out = capsys.readouterr().out
+        assert "cli-job" in out and "Pending" in out
+
+    def test_unknown_job_errors(self, tmp_path, capsys):
+        assert run_cli("--state-dir", tmp_path / "s", "describe", "ghost") == 1
+        assert run_cli("--state-dir", tmp_path / "s", "logs", "ghost") == 1
+        assert run_cli("--state-dir", tmp_path / "s", "delete", "ghost") == 1

@@ -1,0 +1,406 @@
+"""Reconciler tests against the FakeRunner — the fake-clientset pattern
+(SURVEY.md §4): build a job, run sync passes, assert on the runner's action
+log and the job's conditions. Replica "execution" is simulated by setting
+phases by hand and re-syncing; no processes, no TPU.
+"""
+
+from pytorch_operator_tpu.api import (
+    CleanPodPolicy,
+    ConditionType,
+    ElasticPolicy,
+    ReplicaPhase,
+    ReplicaType,
+    RestartPolicy,
+)
+from pytorch_operator_tpu.controller import (
+    EventRecorder,
+    FakeRunner,
+    GangScheduler,
+    JobStore,
+    MetricsRegistry,
+    Reconciler,
+    replica_name,
+)
+from tests.testutil import new_job
+
+
+def make_harness(capacity=None, gang_enabled=True):
+    store = JobStore()
+    runner = FakeRunner(capacity=capacity)
+    events = EventRecorder()
+    metrics = MetricsRegistry()
+    rec = Reconciler(
+        store=store,
+        runner=runner,
+        events=events,
+        metrics=metrics,
+        gang=GangScheduler(enabled=gang_enabled),
+    )
+    return store, runner, events, metrics, rec
+
+
+class TestCreation:
+    def test_creates_master_and_workers(self):
+        store, runner, events, metrics, rec = make_harness()
+        job = new_job(workers=2)
+        key = store.add(job)
+        rec.sync(key)
+        created = [a for a in runner.actions if a[0] == "create"]
+        assert len(created) == 3
+        assert runner.get(replica_name(key, ReplicaType.MASTER, 0)) is not None
+        assert runner.get(replica_name(key, ReplicaType.WORKER, 0)) is not None
+        assert runner.get(replica_name(key, ReplicaType.WORKER, 1)) is not None
+        assert metrics.replicas_created.get() == 3
+        assert metrics.jobs_created.get() == 1
+
+    def test_created_condition_and_event(self):
+        store, runner, events, _, rec = make_harness()
+        key = store.add(new_job())
+        rec.sync(key)
+        job = store.get(key)
+        assert job.has_condition(ConditionType.CREATED)
+        assert any(e.reason == "TPUJobCreated" for e in events.for_job(key))
+
+    def test_env_injection(self):
+        """The SetClusterSpec contract: rank/world-size + TPU-native vars."""
+        store, runner, _, _, rec = make_harness()
+        job = new_job(name="envjob", workers=2)
+        key = store.add(job)
+        rec.sync(key)
+        menv = runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
+        assert menv["RANK"] == "0"
+        assert menv["WORLD_SIZE"] == "3"
+        assert menv["MASTER_PORT"] == "23456"
+        assert menv["PYTHONUNBUFFERED"] == "1"
+        assert menv["TPU_WORKER_ID"] == "0"
+        assert menv["TPUJOB_NUM_PROCESSES"] == "3"
+        assert menv["TPUJOB_COORDINATOR_ADDRESS"].endswith(":23456")
+        w1 = runner.envs[replica_name(key, ReplicaType.WORKER, 1)]
+        assert w1["RANK"] == "2"  # worker i → rank i+1
+        assert w1["TPUJOB_PROCESS_ID"] == "2"
+        assert w1["TPUJOB_REPLICA_TYPE"] == "Worker"
+        assert w1["TPU_WORKER_HOSTNAMES"].count(",") == 2
+
+    def test_no_duplicate_creation_on_resync(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=2))
+        rec.sync(key)
+        rec.sync(key)
+        rec.sync(key)
+        created = [a for a in runner.actions if a[0] == "create"]
+        assert len(created) == 3
+
+    def test_recreates_missing_replica(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=1))
+        rec.sync(key)
+        # simulate lost record (no phase change): handle removed
+        runner.remove_record(replica_name(key, ReplicaType.WORKER, 0))
+        rec.sync(key)
+        assert runner.get(replica_name(key, ReplicaType.WORKER, 0)) is not None
+
+
+class TestRunningAndSuccess:
+    def test_running_condition_when_master_runs(self):
+        store, runner, events, _, rec = make_harness()
+        key = store.add(new_job(workers=1))
+        rec.sync(key)
+        runner.set_all_running(key)
+        rec.sync(key)
+        job = store.get(key)
+        assert job.has_condition(ConditionType.RUNNING)
+        assert job.status.start_time is not None
+        assert job.status.replica_statuses[ReplicaType.MASTER].active == 1
+        assert job.status.replica_statuses[ReplicaType.WORKER].active == 1
+
+    def test_master_success_means_job_success(self):
+        store, runner, events, metrics, rec = make_harness()
+        key = store.add(new_job(workers=1))
+        rec.sync(key)
+        runner.set_all_running(key)
+        rec.sync(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.MASTER, 0), ReplicaPhase.SUCCEEDED, 0
+        )
+        rec.sync(key)
+        job = store.get(key)
+        assert job.is_succeeded()
+        assert job.status.completion_time is not None
+        assert not job.has_condition(ConditionType.RUNNING)
+        assert metrics.jobs_succeeded.get() == 1
+
+    def test_worker_success_does_not_finish_job(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=1))
+        rec.sync(key)
+        runner.set_all_running(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.WORKER, 0), ReplicaPhase.SUCCEEDED, 0
+        )
+        rec.sync(key)
+        job = store.get(key)
+        assert not job.is_finished()
+        assert job.status.replica_statuses[ReplicaType.WORKER].succeeded == 1
+
+    def test_success_cleanup_running_policy_kills_workers(self):
+        store, runner, _, metrics, rec = make_harness()
+        key = store.add(new_job(workers=2, clean_pod_policy=CleanPodPolicy.RUNNING))
+        rec.sync(key)
+        runner.set_all_running(key)
+        rec.sync(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.MASTER, 0), ReplicaPhase.SUCCEEDED, 0
+        )
+        rec.sync(key)
+        # workers were Running → deleted; master finished → record kept
+        deleted = [a[1] for a in runner.actions if a[0] == "delete"]
+        assert replica_name(key, ReplicaType.WORKER, 0) in deleted
+        assert replica_name(key, ReplicaType.WORKER, 1) in deleted
+        assert replica_name(key, ReplicaType.MASTER, 0) not in deleted
+
+    def test_success_cleanup_none_policy_leaves_all(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=1, clean_pod_policy=CleanPodPolicy.NONE))
+        rec.sync(key)
+        runner.set_all_running(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.MASTER, 0), ReplicaPhase.SUCCEEDED, 0
+        )
+        rec.sync(key)
+        deleted = [a for a in runner.actions if a[0] == "delete"]
+        assert deleted == []
+
+    def test_success_cleanup_all_policy_removes_everything(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=1, clean_pod_policy=CleanPodPolicy.ALL))
+        rec.sync(key)
+        runner.set_all_running(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.MASTER, 0), ReplicaPhase.SUCCEEDED, 0
+        )
+        rec.sync(key)
+        deleted = [a[1] for a in runner.actions if a[0] == "delete"]
+        assert len(deleted) == 2  # master record + running worker
+
+
+class TestRestartPolicies:
+    def _fail_worker(self, runner, key, exit_code):
+        runner.set_phase(
+            replica_name(key, ReplicaType.WORKER, 0), ReplicaPhase.FAILED, exit_code
+        )
+
+    def test_on_failure_restarts(self):
+        store, runner, events, metrics, rec = make_harness()
+        key = store.add(new_job(workers=1, restart_policy=RestartPolicy.ON_FAILURE))
+        rec.sync(key)
+        runner.set_all_running(key)
+        rec.sync(key)
+        self._fail_worker(runner, key, 1)
+        rec.sync(key)
+        job = store.get(key)
+        assert job.has_condition(ConditionType.RESTARTING)
+        assert not job.has_condition(ConditionType.RUNNING)
+        assert job.status.restart_count == 1
+        # next sync recreates the worker
+        rec.sync(key)
+        assert runner.get(replica_name(key, ReplicaType.WORKER, 0)) is not None
+        assert metrics.jobs_restarted.get() == 1
+
+    def test_never_fails_job(self):
+        store, runner, _, metrics, rec = make_harness()
+        key = store.add(new_job(workers=1, restart_policy=RestartPolicy.NEVER))
+        rec.sync(key)
+        runner.set_all_running(key)
+        self._fail_worker(runner, key, 1)
+        rec.sync(key)
+        job = store.get(key)
+        assert job.is_failed()
+        assert metrics.jobs_failed.get() == 1
+
+    def test_exit_code_permanent(self):
+        """ExitCode policy: exit 1–127 = permanent failure."""
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=1, restart_policy=RestartPolicy.EXIT_CODE))
+        rec.sync(key)
+        runner.set_all_running(key)
+        self._fail_worker(runner, key, 1)
+        rec.sync(key)
+        assert store.get(key).is_failed()
+
+    def test_exit_code_retryable(self):
+        """ExitCode policy: exit >=128 (e.g. SIGKILL=137) = retryable."""
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=1, restart_policy=RestartPolicy.EXIT_CODE))
+        rec.sync(key)
+        runner.set_all_running(key)
+        self._fail_worker(runner, key, 137)
+        rec.sync(key)
+        job = store.get(key)
+        assert not job.is_finished()
+        assert job.has_condition(ConditionType.RESTARTING)
+        assert job.status.restart_count == 1
+
+    def test_always_restarts_succeeded_worker(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=1, restart_policy=RestartPolicy.ALWAYS))
+        rec.sync(key)
+        runner.set_all_running(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.WORKER, 0), ReplicaPhase.SUCCEEDED, 0
+        )
+        rec.sync(key)
+        job = store.get(key)
+        assert job.has_condition(ConditionType.RESTARTING)
+        rec.sync(key)
+        assert runner.get(replica_name(key, ReplicaType.WORKER, 0)) is not None
+
+    def test_master_failure_respects_policy(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=0, restart_policy=RestartPolicy.ON_FAILURE))
+        rec.sync(key)
+        runner.set_all_running(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.MASTER, 0), ReplicaPhase.FAILED, 1
+        )
+        rec.sync(key)
+        job = store.get(key)
+        assert not job.is_finished()
+        assert job.has_condition(ConditionType.RESTARTING)
+
+    def test_backoff_limit_exceeded(self):
+        store, runner, events, _, rec = make_harness()
+        key = store.add(
+            new_job(workers=1, restart_policy=RestartPolicy.ON_FAILURE, backoff_limit=2)
+        )
+        for i in range(3):
+            rec.sync(key)
+            runner.set_all_running(key)
+            self._fail_worker(runner, key, 1)
+            rec.sync(key)
+        job = store.get(key)
+        assert job.is_failed()
+        c = job.get_condition(ConditionType.FAILED)
+        assert c.reason == "BackoffLimitExceeded"
+        assert job.status.restart_count == 2
+
+    def test_restarting_back_to_running(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=1))
+        rec.sync(key)
+        runner.set_all_running(key)
+        rec.sync(key)
+        self._fail_worker(runner, key, 1)
+        rec.sync(key)  # restarting
+        rec.sync(key)  # recreate
+        runner.set_all_running(key)
+        rec.sync(key)
+        job = store.get(key)
+        assert job.has_condition(ConditionType.RUNNING)
+        assert not job.has_condition(ConditionType.RESTARTING)
+
+
+class TestGang:
+    def test_gang_blocks_partial_start(self):
+        """All-or-nothing: capacity 2 < gang of 3 → nothing starts."""
+        store, runner, events, _, rec = make_harness(capacity=2)
+        key = store.add(new_job(workers=2))
+        rec.sync(key)
+        assert runner.actions == []  # no partial gang
+        assert any(e.reason == "Unschedulable" for e in events.for_job(key))
+
+    def test_gang_starts_when_capacity_allows(self):
+        store, runner, _, _, rec = make_harness(capacity=3)
+        key = store.add(new_job(workers=2))
+        rec.sync(key)
+        assert len([a for a in runner.actions if a[0] == "create"]) == 3
+
+    def test_gang_admits_after_capacity_frees(self):
+        store, runner, events, _, rec = make_harness(capacity=2)
+        key = store.add(new_job(workers=2))
+        rec.sync(key)
+        assert runner.actions == []
+        runner.capacity = 4
+        rec.sync(key)
+        assert len([a for a in runner.actions if a[0] == "create"]) == 3
+
+    def test_non_gang_mode_starts_piecewise(self):
+        store, runner, _, _, rec = make_harness(capacity=2, gang_enabled=False)
+        key = store.add(new_job(workers=2))
+        rec.sync(key)
+        # non-gang: starts what fits (2 of 3)
+        assert len([a for a in runner.actions if a[0] == "create"]) >= 1
+
+    def test_group_deleted_on_finish(self):
+        store, runner, _, _, rec = make_harness(capacity=3)
+        key = store.add(new_job(workers=2))
+        rec.sync(key)
+        assert rec.gang.get_group(key) is not None
+        runner.set_all_running(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.MASTER, 0), ReplicaPhase.SUCCEEDED, 0
+        )
+        rec.sync(key)
+        assert rec.gang.get_group(key) is None
+
+
+class TestDeadline:
+    def test_active_deadline_fails_job(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(new_job(workers=1, active_deadline_seconds=10))
+        rec.sync(key, now=1000.0)
+        runner.set_all_running(key)
+        rec.sync(key, now=1001.0)  # sets start_time
+        rec.sync(key, now=1020.0)
+        job = store.get(key)
+        assert job.is_failed()
+        assert job.get_condition(ConditionType.FAILED).reason == "DeadlineExceeded"
+
+
+class TestElastic:
+    def test_worker_loss_triggers_gang_restart(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(
+            new_job(
+                workers=3,
+                restart_policy=RestartPolicy.EXIT_CODE,
+                elastic=ElasticPolicy(min_replicas=1, max_replicas=4, max_restarts=5),
+            )
+        )
+        rec.sync(key)
+        runner.set_all_running(key)
+        rec.sync(key)
+        # preemption: one worker SIGKILLed
+        runner.set_phase(
+            replica_name(key, ReplicaType.WORKER, 1), ReplicaPhase.FAILED, 137
+        )
+        rec.sync(key)
+        job = store.get(key)
+        assert job.has_condition(ConditionType.RESTARTING)
+        assert job.status.restart_count == 1
+        # the WHOLE gang was torn down (elastic re-rendezvous)
+        assert runner.list_for_job(key) == []
+        # next sync recreates all 4 with bumped restart count in env
+        rec.sync(key)
+        assert len(runner.list_for_job(key)) == 4
+        env = runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
+        assert env["TPUJOB_RESTART_COUNT"] == "1"
+
+    def test_elastic_max_restarts_exceeded(self):
+        store, runner, _, _, rec = make_harness()
+        key = store.add(
+            new_job(
+                workers=1,
+                restart_policy=RestartPolicy.EXIT_CODE,
+                elastic=ElasticPolicy(min_replicas=1, max_replicas=2, max_restarts=1),
+            )
+        )
+        for _ in range(2):
+            rec.sync(key)
+            runner.set_all_running(key)
+            runner.set_phase(
+                replica_name(key, ReplicaType.WORKER, 0), ReplicaPhase.FAILED, 137
+            )
+            rec.sync(key)
+        job = store.get(key)
+        assert job.is_failed()
+        assert job.get_condition(ConditionType.FAILED).reason == "MaxRestartsExceeded"

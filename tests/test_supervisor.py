@@ -1,0 +1,242 @@
+"""Supervisor tests: real SubprocessRunner end-to-end with trivial workloads,
+TTL GC, persistence, elastic scale, metrics rendering.
+"""
+
+import time
+
+import pytest
+
+from pytorch_operator_tpu.api import (
+    CleanPodPolicy,
+    ConditionType,
+    ElasticPolicy,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    ValidationError,
+)
+from pytorch_operator_tpu.controller import (
+    JobStore,
+    Supervisor,
+    schedule_to_first_step_latency,
+)
+from tests.testutil import new_job
+
+
+def make_supervisor(tmp_path, **kw):
+    return Supervisor(state_dir=tmp_path / "state", poll_interval=0.05, **kw)
+
+
+class TestSubprocessE2E:
+    def test_noop_job_succeeds(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="noop-e2e", workers=1)
+        done = sup.run(job, timeout=30)
+        assert done.is_succeeded()
+        assert done.status.completion_time is not None
+        # first-step report flowed back through the status dir
+        assert done.status.first_step_time is not None
+        lat = schedule_to_first_step_latency(done)
+        assert lat is not None and 0 <= lat < 30
+        sup.shutdown()
+
+    def test_failing_job_backoff(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(
+            name="perma-fail",
+            workers=0,
+            restart_policy=RestartPolicy.ON_FAILURE,
+            backoff_limit=1,
+        )
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            module="pytorch_operator_tpu.workloads.exit_with", args=["--code", "3"]
+        )
+        done = sup.run(job, timeout=30)
+        assert done.is_failed()
+        assert done.get_condition(ConditionType.FAILED).reason == "BackoffLimitExceeded"
+        assert done.status.restart_count == 1
+        sup.shutdown()
+
+    def test_exit_code_policy_permanent(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="exitcode-perm", workers=0, restart_policy=RestartPolicy.EXIT_CODE)
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            module="pytorch_operator_tpu.workloads.exit_with", args=["--code", "7"]
+        )
+        done = sup.run(job, timeout=30)
+        assert done.is_failed()
+        assert done.status.restart_count == 0  # 7 is permanent, no retry
+        sup.shutdown()
+
+    def test_crash_then_recover(self, tmp_path):
+        """Replica fails once with a retryable code, then succeeds."""
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="flaky", workers=0, restart_policy=RestartPolicy.EXIT_CODE)
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            module="pytorch_operator_tpu.workloads.exit_with",
+            args=["--code", "130", "--until-restart", "1"],
+        )
+        done = sup.run(job, timeout=30)
+        assert done.is_succeeded()
+        assert done.status.restart_count == 1
+        sup.shutdown()
+
+    def test_bad_command_fails(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="badcmd", workers=0, restart_policy=RestartPolicy.NEVER)
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            command=["/nonexistent/binary"]
+        )
+        done = sup.run(job, timeout=30)
+        assert done.is_failed()
+        sup.shutdown()
+
+    def test_logs_written(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="logjob", workers=0)
+        sup.run(job, timeout=30)
+        logs = list((tmp_path / "state" / "logs").glob("*logjob*"))
+        assert logs, "expected a replica log file"
+        assert "[noop]" in logs[0].read_text()
+        sup.shutdown()
+
+    def test_delete_running_job_kills_processes(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="longrun", workers=0)
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            module="pytorch_operator_tpu.workloads.exit_with",
+            args=["--sleep", "60", "--code", "0"],
+        )
+        key = sup.submit(job)
+        sup.sync_once()
+        handles = sup.runner.list_for_job(key)
+        assert len(handles) == 1 and handles[0].pid is not None
+        assert sup.delete_job(key)
+        assert sup.get(key) is None
+        assert sup.runner.list_for_job(key) == []
+        sup.shutdown()
+
+
+class TestTTLAndPersistence:
+    def test_ttl_gc(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="ttl-job", workers=0, ttl_seconds_after_finished=0)
+        key = sup.submit(job)
+        sup.wait(key, timeout=30)
+        # job finished; next sync pass GCs it (ttl=0)
+        sup.sync_once()
+        assert sup.get(key) is None
+        sup.shutdown()
+
+    def test_state_persisted_and_reloaded(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="persist-job", workers=0)
+        key = sup.submit(job)
+        done = sup.wait(key, timeout=30)
+        assert done.is_succeeded()
+        sup.shutdown()
+        # a fresh supervisor over the same state dir sees the finished job
+        sup2 = make_supervisor(tmp_path)
+        reloaded = sup2.get(key)
+        assert reloaded is not None
+        assert reloaded.is_succeeded()
+        assert reloaded.metadata.uid == done.metadata.uid
+        sup2.shutdown()
+
+    def test_corrupt_state_file_skipped(self, tmp_path):
+        d = tmp_path / "jobs"
+        d.mkdir(parents=True)
+        (d / "default_bad.json").write_text("{not json")
+        store = JobStore(persist_dir=d)
+        assert store.list() == []
+
+
+class TestScale:
+    def test_scale_requires_elastic(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        key = sup.submit(new_job(name="noelastic", workers=1))
+        with pytest.raises(ValidationError, match="elastic"):
+            sup.scale(key, 2)
+        sup.shutdown()
+
+    def test_scale_bounds_checked(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        key = sup.submit(
+            new_job(
+                name="el",
+                workers=2,
+                elastic=ElasticPolicy(min_replicas=1, max_replicas=3),
+            )
+        )
+        with pytest.raises(ValidationError, match="outside"):
+            sup.scale(key, 5)
+        sup.shutdown()
+
+    def test_scale_restarts_gang_with_new_world(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(
+            name="el2",
+            workers=2,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=3, max_restarts=5),
+        )
+        for rs in job.spec.replica_specs.values():
+            rs.template = ProcessTemplate(
+                module="pytorch_operator_tpu.workloads.exit_with",
+                args=["--sleep", "60", "--code", "0"],
+            )
+        key = sup.submit(job)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 3
+        sup.scale(key, 1)
+        sup.sync_once()
+        handles = sup.runner.list_for_job(key)
+        assert len(handles) == 2  # master + 1 worker
+        job2 = sup.get(key)
+        assert job2.status.restart_count == 1
+        # env reflects the new world size
+        sup.runner.sync()
+        sup.delete_job(key)
+        sup.shutdown()
+
+
+class TestMetricsRender:
+    def test_prometheus_text(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        sup.submit(new_job(name="m1", workers=0))
+        sup.sync_once()
+        text = sup.metrics.render_text()
+        assert "# TYPE tpujob_jobs_created_total counter" in text
+        assert "tpujob_jobs_created_total 1" in text
+        sup.shutdown()
+
+
+class TestSignalDeath:
+    def test_sigkill_is_retryable_under_exit_code_policy(self, tmp_path):
+        """Popen reports signal death as -N; the runner must normalize to
+        128+N so ExitCode policy treats preemption (SIGKILL) as retryable."""
+        import os
+        import signal as _signal
+
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="preempt", workers=0, restart_policy=RestartPolicy.EXIT_CODE)
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            module="pytorch_operator_tpu.workloads.exit_with",
+            args=["--sleep", "30", "--code", "0"],
+        )
+        key = sup.submit(job)
+        sup.sync_once()
+        h = sup.runner.list_for_job(key)[0]
+        os.kill(h.pid, _signal.SIGKILL)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            sup.sync_once()
+            j = sup.get(key)
+            if j.status.restart_count >= 1:
+                break
+            time.sleep(0.05)
+        j = sup.get(key)
+        assert not j.is_failed(), "SIGKILL must be retryable, not a permanent failure"
+        assert j.status.restart_count == 1
+        sup.delete_job(key)
+        sup.shutdown()
