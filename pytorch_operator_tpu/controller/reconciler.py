@@ -266,6 +266,17 @@ class Reconciler:
                 self.store.update(job)
                 return True
             self._unschedulable_warned.discard(key)
+            # Auto-port jobs get a freshly-probed coordinator port for each
+            # new world (first launch or gang restart): probing at spawn
+            # time keeps the free-probe → coordinator-bind window tiny, and
+            # a fresh port per gang restart dodges TIME_WAIT on the old one.
+            if (
+                job.metadata.annotations.get("tpujob.dev/auto-port") == "true"
+                and not handles
+            ):
+                from .supervisor import _find_free_port
+
+                job.spec.port = _find_free_port()
             status_dir = self._status_dir(key)
             num_processes = sum(
                 self._desired_replicas(job, rt) for rt in job.spec.replica_specs

@@ -219,6 +219,14 @@ class SubprocessRunner(ProcessRunner):
             full_env = dict(os.environ)
             full_env.update(template.env)
             full_env.update(env)
+            # Replicas must import this package regardless of cwd, and the
+            # inherited PYTHONPATH must be PRESERVED (site customizations —
+            # e.g. the TPU PJRT plugin registration — live there).
+            pkg_root = str(Path(__file__).resolve().parents[2])
+            parts = [p for p in full_env.get("PYTHONPATH", "").split(os.pathsep) if p]
+            if pkg_root not in parts:
+                parts.insert(0, pkg_root)
+            full_env["PYTHONPATH"] = os.pathsep.join(parts)
             log_f = open(log_path, "ab")
             try:
                 proc = subprocess.Popen(

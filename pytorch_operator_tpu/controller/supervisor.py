@@ -9,6 +9,7 @@ elastic resize (scale) requests.
 from __future__ import annotations
 
 import os
+import socket
 import threading
 import time
 from pathlib import Path
@@ -26,8 +27,17 @@ from .runner import ProcessRunner, SubprocessRunner
 from .store import JobStore, job_key
 
 
+AUTO_PORT_ANNOTATION = "tpujob.dev/auto-port"
+
+
 def default_state_dir() -> Path:
     return Path(os.environ.get("TPUJOB_HOME", ".tpujob"))
+
+
+def _find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 class Supervisor:
@@ -68,6 +78,14 @@ class Supervisor:
 
     def submit(self, job: TPUJob) -> str:
         """Accept a job: default, validate, store (kubectl-apply analog)."""
+        # All jobs share 127.0.0.1 locally (unlike pods with distinct IPs),
+        # so the reference's fixed default port would collide across
+        # concurrent jobs. An OMITTED port (checked before defaulting, so an
+        # explicit 23456 is honored) is marked auto: the reconciler probes a
+        # free port right before each world launch, keeping the
+        # probe-to-bind reuse window near zero.
+        if job.spec.port is None:
+            job.metadata.annotations[AUTO_PORT_ANNOTATION] = "true"
         set_defaults(job)
         validate(job)
         key = self.store.add(job)

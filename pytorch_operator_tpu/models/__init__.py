@@ -1,0 +1,6 @@
+"""Model zoo: JAX/flax workload models (MNIST CNN, ResNet, BERT, Llama).
+
+Mirror of the model code inside the reference's example containers
+(SURVEY.md §1 layer 7) plus the BASELINE.json:7-11 target workloads.
+Import is lazy per-model — the control plane never pulls in jax/flax.
+"""

@@ -1,0 +1,35 @@
+"""Small CNN for digit classification.
+
+Reference analog: the Net in ``examples/mnist/mnist.py`` (conv-conv-fc-fc;
+SURVEY.md §2 "Example: mnist") — re-designed as a flax module that is
+shape-agnostic (works on 8×8 sklearn digits and 28×28 MNIST alike) and
+bfloat16-friendly for the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DigitCNN(nn.Module):
+    """conv32-conv64-pool-dense128-dense10, NHWC."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32  # compute dtype; params stay f32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,24 @@
+"""Parallelism: device meshes, sharding rules, collectives.
+
+The TPU-native stand-in for the NCCL/c10d layer the reference wires up but
+does not implement (SURVEY.md §2 parallelism table): jax.sharding meshes +
+XLA collectives over ICI/DCN.
+"""
+
+from .mesh import (  # noqa: F401
+    MESH_AXIS_ORDER,
+    make_mesh,
+    mesh_from_env,
+    parse_mesh_spec,
+    resolve_axis_sizes,
+)
+from .sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    fsdp_shardings,
+    fsdp_spec,
+    logical_to_spec,
+    named_sharding,
+    replicated,
+    shard_tree,
+)
+from . import collectives  # noqa: F401

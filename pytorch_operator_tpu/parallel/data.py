@@ -1,0 +1,65 @@
+"""Per-process data feeding for data-parallel training.
+
+Reference mapping: DDP's per-rank DataLoader + DistributedSampler (inside the
+reference's example containers) → per-process host data assembled into
+*global* jax Arrays sharded over the ``dp`` mesh axis; XLA then sees one
+logical batch (SPMD), which is the TPU-native shape of input pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def global_batch(batch, mesh, axis: str = "dp"):
+    """Turn a host batch (every process holds identical data) into a global
+    Array sharded along ``axis`` over the mesh.
+
+    Single-process: a plain sharded device_put. Multi-process: each process
+    contributes the rows its addressable devices own via
+    ``jax.make_array_from_process_local_data``.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    batch = np.asarray(batch)
+    ndim = batch.ndim
+    spec = PartitionSpec(axis, *([None] * (ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    n = batch.shape[0]
+    pcount = jax.process_count()
+    pid = jax.process_index()
+    if n % pcount != 0:
+        raise ValueError(
+            f"global batch size {n} must divide evenly across {pcount} processes"
+        )
+    per = n // pcount
+    local = batch[pid * per : (pid + 1) * per]
+    return jax.make_array_from_process_local_data(sharding, local, batch.shape)
+
+
+def shard_batch_size(global_size: int, mesh, axis: str = "dp") -> int:
+    """Validate a global batch size divides the dp extent; return per-device."""
+    extent = mesh.shape[axis] if axis in mesh.axis_names else 1
+    if global_size % extent != 0:
+        raise ValueError(
+            f"global batch {global_size} must be divisible by {axis}={extent}"
+        )
+    return global_size // extent
+
+
+def epoch_batches(x, y, batch_size: int, *, seed: int, drop_last: bool = True):
+    """Deterministic shuffled minibatches — same permutation on every process
+    (all processes hold the same host dataset and the same seed)."""
+    import numpy as np
+
+    n = x.shape[0]
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    end = (n // batch_size) * batch_size if drop_last else n
+    for i in range(0, end, batch_size):
+        idx = perm[i : i + batch_size]
+        yield x[idx], y[idx]

@@ -1,0 +1,111 @@
+"""Device meshes — the TPU-native topology layer.
+
+Reference mapping: the reference has no mesh concept; its "topology" is the
+flat RANK/WORLD_SIZE numbering injected for c10d DDP (SURVEY.md §2
+"Parallelism strategies"). TPU-first, topology is a named
+:class:`jax.sharding.Mesh` over which pjit/shard_map place computation and
+XLA inserts collectives that ride ICI within a slice and DCN across slices.
+
+Canonical axis names (the scaling-book vocabulary):
+
+- ``dp``   — pure data parallel (replicated params, sharded batch)
+- ``fsdp`` — data parallel with parameter/optimizer sharding (ZeRO-3)
+- ``tp``   — tensor (model) parallel
+- ``sp``   — sequence/context parallel (ring attention)
+- ``pp``   — pipeline stages
+- ``ep``   — expert parallel (MoE)
+
+A mesh spec like ``{"fsdp": 4, "tp": 2}`` or the string ``"fsdp=4,tp=2"``
+(with at most one ``-1`` wildcard) is resolved against the available device
+count.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Sequence, Union
+
+MESH_AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+# tp innermost: tensor-parallel collectives are the most latency-sensitive,
+# and innermost mesh dims map to physically-adjacent devices on TPU slices.
+
+
+def parse_mesh_spec(spec: Union[str, Mapping[str, int]]) -> Dict[str, int]:
+    """Parse ``"dp=2,tp=4"`` (or a mapping) into an ordered axis dict."""
+    if isinstance(spec, str):
+        out: Dict[str, int] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"mesh spec {spec!r}: expected axis=size, got {part!r}")
+            name, _, size = part.partition("=")
+            out[name.strip()] = int(size)
+    else:
+        out = dict(spec)
+    for name, size in out.items():
+        if name not in MESH_AXIS_ORDER:
+            raise ValueError(
+                f"unknown mesh axis {name!r} (valid: {', '.join(MESH_AXIS_ORDER)})"
+            )
+        if size != -1 and size < 1:
+            raise ValueError(f"mesh axis {name}: size must be >= 1 or -1, got {size}")
+    if sum(1 for s in out.values() if s == -1) > 1:
+        raise ValueError("mesh spec may contain at most one -1 wildcard")
+    return out
+
+
+def resolve_axis_sizes(
+    spec: Union[str, Mapping[str, int]], n_devices: int
+) -> Dict[str, int]:
+    """Resolve a mesh spec against a device count (fills the -1 wildcard,
+    checks the product divides the device count exactly)."""
+    axes = parse_mesh_spec(spec)
+    if not axes:
+        axes = {"dp": -1}
+    known = 1
+    wildcard = None
+    for name, size in axes.items():
+        if size == -1:
+            wildcard = name
+        else:
+            known *= size
+    if wildcard is not None:
+        if n_devices % known != 0:
+            raise ValueError(
+                f"mesh spec {axes}: known axis product {known} does not divide "
+                f"device count {n_devices}"
+            )
+        axes[wildcard] = n_devices // known
+        known *= axes[wildcard]
+    if known != n_devices:
+        raise ValueError(
+            f"mesh spec {axes}: axis product {known} != device count {n_devices}"
+        )
+    # Canonical order keeps collective locality sane (tp innermost).
+    return {k: axes[k] for k in MESH_AXIS_ORDER if k in axes}
+
+
+def make_mesh(
+    spec: Union[str, Mapping[str, int], None] = None,
+    devices: Optional[Sequence] = None,
+):
+    """Build a named Mesh from a spec (default: all devices on ``dp``)."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    axes = resolve_axis_sizes(spec if spec is not None else {"dp": -1}, len(devices))
+    import numpy as np
+
+    from jax.sharding import Mesh
+
+    dev_array = np.asarray(devices).reshape(tuple(axes.values()))
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def mesh_from_env(default: str = "dp=-1"):
+    """Build the mesh from ``TPUJOB_MESH`` (supervisor-injected or user-set)."""
+    import os
+
+    return make_mesh(os.environ.get("TPUJOB_MESH", default))

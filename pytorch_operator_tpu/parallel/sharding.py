@@ -1,0 +1,122 @@
+"""Sharding rules: logical axes → mesh axes → NamedSharding.
+
+TPU-native replacement for the reference's delegation to DDP/NCCL
+(SURVEY.md §2 "Parallelism strategies..."): parameters and activations are
+annotated with *logical* axis names; a rule table maps logical axes onto
+mesh axes; XLA then inserts the collectives. This is the t5x/flax
+"logical axis rules" pattern, kept dependency-light.
+
+Also provides generic FSDP/ZeRO-3 parameter sharding that needs no
+per-model annotations: shard each large parameter's largest
+evenly-divisible dimension over the ``fsdp`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+LogicalRules = Sequence[Tuple[str, Optional[str]]]
+
+# Default rule table, mirroring common transformer layouts. Entries earlier
+# in the table win. None = replicate.
+DEFAULT_RULES: LogicalRules = (
+    ("batch", "dp"),
+    ("batch_fsdp", "fsdp"),
+    ("seq", "sp"),
+    ("embed", "fsdp"),      # fsdp shards the embed dim of params
+    ("mlp", "tp"),
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("vocab", "tp"),
+    ("expert", "ep"),
+    ("stage", "pp"),
+    ("head_dim", None),
+    ("norm", None),
+)
+
+
+def logical_to_spec(logical_axes: Sequence[Optional[str]], rules: LogicalRules = DEFAULT_RULES, mesh=None):
+    """Map a tuple of logical axis names to a PartitionSpec.
+
+    Axes whose mesh axis is absent from the mesh (or has size 1) fall back
+    to replication, so the same annotations serve every mesh shape.
+    """
+    from jax.sharding import PartitionSpec
+
+    table = dict(rules)
+    mesh_axes = set(mesh.axis_names) if mesh is not None else None
+    out = []
+    for ax in logical_axes:
+        mesh_ax = table.get(ax) if ax is not None else None
+        if mesh_ax is not None and mesh_axes is not None and mesh_ax not in mesh_axes:
+            mesh_ax = None
+        out.append(mesh_ax)
+    # Trim trailing Nones (canonical PartitionSpec form).
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def named_sharding(mesh, *logical_axes: Optional[str], rules: LogicalRules = DEFAULT_RULES):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules, mesh))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+# ---- generic FSDP (ZeRO-3) parameter sharding ----
+
+
+def fsdp_spec(shape: Sequence[int], mesh, axis: str = "fsdp", min_elements: int = 2**16):
+    """PartitionSpec sharding the largest evenly-divisible dim over ``axis``.
+
+    Small params (below ``min_elements``) replicate — sharding tiny tensors
+    costs more in collective latency than it saves in HBM.
+    """
+    from jax.sharding import PartitionSpec
+
+    if axis not in mesh.axis_names:
+        return PartitionSpec()
+    size = mesh.shape[axis]
+    n = 1
+    for d in shape:
+        n *= d
+    if size <= 1 or n < min_elements:
+        return PartitionSpec()
+    # Largest dim divisible by the axis size wins; ties → earliest dim.
+    best = None
+    for i, d in enumerate(shape):
+        if d % size == 0:
+            if best is None or d > shape[best]:
+                best = i
+    if best is None:
+        return PartitionSpec()
+    spec = [None] * len(shape)
+    spec[best] = axis
+    while spec and spec[-1] is None:
+        spec.pop()
+    return PartitionSpec(*spec)
+
+
+def fsdp_shardings(params: Any, mesh, axis: str = "fsdp", min_elements: int = 2**16):
+    """Tree of NamedShardings implementing ZeRO-3 over ``axis`` for any
+    parameter pytree."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree.map(
+        lambda p: NamedSharding(mesh, fsdp_spec(p.shape, mesh, axis, min_elements)),
+        params,
+    )
+
+
+def shard_tree(tree: Any, shardings: Any):
+    """device_put a pytree onto a matching tree of shardings."""
+    import jax
+
+    return jax.device_put(tree, shardings)

@@ -1,0 +1,34 @@
+"""Backend/platform selection — the clean seam between the single-process
+TPU path and the multi-process CPU (test) path (SURVEY.md §7 "Hard parts").
+
+Why this exists: a plain ``JAX_PLATFORMS`` env var is not reliable in every
+deployment (site customizations may pre-import jax and pin a platform — the
+axon TPU plugin in this environment does exactly that), so the supervisor
+injects ``TPUJOB_PLATFORM`` and workloads call :func:`setup_backend` which
+applies the platform via ``jax.config.update`` — the route that always wins
+as long as no backend has been instantiated yet.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def setup_backend(platform: Optional[str] = None) -> str:
+    """Force the JAX platform and (for CPU) enable cross-process collectives.
+
+    Must be called before any JAX computation/device query. Returns the
+    selected platform string ("tpu", "cpu", or "" for default).
+    """
+    import jax
+
+    platform = platform or os.environ.get("TPUJOB_PLATFORM", "")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if platform == "cpu":
+        # Gloo gives the CPU backend real inter-process collectives — the
+        # stand-in for ICI/DCN when testing multi-host topologies locally
+        # (SURVEY.md §4: multi-host without a pod).
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    return platform

@@ -71,7 +71,11 @@ def build_cluster_env(
 
     resources = job.spec.replica_specs[rtype].template.resources
     if resources.cpu_devices > 0:
-        # Test/CI backend: virtual CPU devices (SURVEY.md §4).
+        # Test/CI backend: virtual CPU devices (SURVEY.md §4). TPUJOB_PLATFORM
+        # is applied by workloads via runtime.backend.setup_backend — a plain
+        # JAX_PLATFORMS env var can be overridden by site customizations that
+        # pre-import jax (the axon plugin here does).
+        env["TPUJOB_PLATFORM"] = "cpu"
         env["JAX_PLATFORMS"] = "cpu"
         env["XLA_FLAGS"] = (
             f"--xla_force_host_platform_device_count={resources.cpu_devices}"

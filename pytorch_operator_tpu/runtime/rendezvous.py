@@ -65,6 +65,9 @@ def initialize_from_env(
     ``jax.distributed.initialize`` with retries — the connect-retry gate that
     replaces the reference's initContainer DNS loop.
     """
+    from .backend import setup_backend
+
+    setup_backend()
     world = world_from_env()
     if world.num_processes <= 1:
         return world

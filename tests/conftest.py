@@ -1,22 +1,25 @@
 """Test configuration.
 
-Forces the JAX CPU backend with 8 virtual host devices BEFORE any jax import,
-so sharding/mesh tests exercise real multi-device code paths without TPU
-hardware (SURVEY.md §4 "Rebuild translation"). Control-plane tests never
-import jax at all.
+Sets env so tests (and the subprocess workloads they launch) use the JAX CPU
+backend with 8 virtual host devices, exercising real multi-device code paths
+without TPU hardware (SURVEY.md §4 "Rebuild translation").
+
+jax itself is NOT imported here — control-plane tests stay jax-free. Test
+modules that use jax in-process must ``import tests.jaxenv`` first, which
+forces the platform via jax.config (the env var alone is overridden by this
+environment's site customization; XLA_FLAGS via env IS honored because it is
+read at client creation).
 """
 
 import os
 
-# Must happen before jax is imported anywhere in the test process.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Read at CPU client creation — must be set before any backend is built.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Keep XLA compilation single-threaded-ish on the 1-core CI box.
-os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "false")
+os.environ["TPUJOB_PLATFORM"] = "cpu"
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,11 @@
+"""Import this FIRST in any test module that uses jax in-process.
+
+Applies the CPU platform + gloo collectives via jax.config (the env var
+alone is overridden by this environment's site customization — see
+runtime/backend.py). Kept out of conftest so pure control-plane test runs
+never pay the jax import.
+"""
+
+from pytorch_operator_tpu.runtime.backend import setup_backend
+
+setup_backend("cpu")

@@ -1,0 +1,29 @@
+"""The minimum end-to-end slice (SURVEY.md §7 build-order step 3): submit →
+supervise → train real digits → accuracy gate → Succeeded, with
+schedule-to-first-step latency recorded.
+"""
+
+import pytest
+
+from pytorch_operator_tpu.api import ProcessTemplate, ReplicaType, Resources
+from pytorch_operator_tpu.controller import Supervisor, schedule_to_first_step_latency
+from tests.testutil import new_job
+
+
+@pytest.mark.slow
+def test_mnist_trains_end_to_end(tmp_path):
+    sup = Supervisor(state_dir=tmp_path / "state", poll_interval=0.1)
+    job = new_job(name="mnist-e2e", workers=0)
+    job.spec.port = None  # auto-allocate
+    job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+        module="pytorch_operator_tpu.workloads.mnist_train",
+        args=["--epochs", "3", "--target-acc", "0.90"],
+        resources=Resources(cpu_devices=1),
+    )
+    done = sup.run(job, timeout=240)
+    log = (tmp_path / "state" / "logs" / "default_mnist-e2e-master-0.log").read_text()
+    assert done.is_succeeded(), f"log:\n{log}"
+    assert "test_accuracy=" in log
+    lat = schedule_to_first_step_latency(done)
+    assert lat is not None and lat > 0
+    sup.shutdown()

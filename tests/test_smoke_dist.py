@@ -1,0 +1,33 @@
+"""The distributed canary through the full stack: supervisor gang-launches
+N processes, they rendezvous via jax.distributed (gloo CPU collectives) and
+run real cross-process collectives. Reference analog: examples/smoke-dist
+as the e2e wiring proof (SURVEY.md §4).
+
+Marked slow: each process pays jax import + gloo setup on one CPU core.
+"""
+
+import pytest
+
+from pytorch_operator_tpu.api import ProcessTemplate, ReplicaType, Resources
+from pytorch_operator_tpu.controller import Supervisor
+from tests.testutil import new_job
+
+
+@pytest.mark.slow
+def test_smoke_dist_two_process(tmp_path):
+    sup = Supervisor(state_dir=tmp_path / "state", poll_interval=0.1)
+    job = new_job(name="smoke-dist", workers=1)
+    job.spec.port = None  # auto-allocate: avoid TIME_WAIT across test runs
+    for rs in job.spec.replica_specs.values():
+        rs.template = ProcessTemplate(
+            module="pytorch_operator_tpu.workloads.smoke_dist",
+            resources=Resources(cpu_devices=1),
+        )
+    done = sup.run(job, timeout=240)
+    master_log = (tmp_path / "state" / "logs" / "default_smoke-dist-master-0.log").read_text()
+    worker_log = (tmp_path / "state" / "logs" / "default_smoke-dist-worker-0.log").read_text()
+    assert done.is_succeeded(), f"master log:\n{master_log}\nworker log:\n{worker_log}"
+    assert "rank 0: OK" in master_log
+    assert "rank 1: OK" in worker_log
+    assert "2 processes, 2 global devices" in master_log
+    sup.shutdown()

@@ -240,3 +240,27 @@ class TestSignalDeath:
         assert j.status.restart_count == 1
         sup.delete_job(key)
         sup.shutdown()
+
+
+class TestAutoPort:
+    def test_omitted_port_is_auto_allocated(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="auto-port", workers=0)
+        assert job.spec.port == 23456  # defaulted by fixture
+        job.spec.port = None  # user omitted it
+        key = sup.submit(job)
+        sup.sync_once()
+        j = sup.get(key)
+        assert j.spec.port != 23456 and 1024 < j.spec.port <= 65535
+        sup.delete_job(key)
+        sup.shutdown()
+
+    def test_explicit_default_port_honored(self, tmp_path):
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="explicit-port", workers=0)
+        job.spec.port = 23456  # explicitly set by user
+        key = sup.submit(job)
+        sup.sync_once()
+        assert sup.get(key).spec.port == 23456
+        sup.delete_job(key)
+        sup.shutdown()
