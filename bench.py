@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip.
+
+The north-star metric (BASELINE.json:2). The reference published no numbers
+(BASELINE.md), so the baseline is the value established on this hardware in
+round 1; ``vs_baseline`` is measured against it.
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Diagnostics go to stderr.
+
+Usage:
+    python bench.py            # full run on the real device (TPU)
+    python bench.py --smoke    # tiny CPU run (CI/tests)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# Round-1 established baseline on one TPU v5 lite chip with THIS script's
+# default config (ResNet-50, global batch 128, 224px, bf16, real train step):
+# 2667.0 images/sec/chip (BASELINE.md "Established numbers").
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 2667.0
+
+
+def run(argv=None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true", help="tiny CPU run")
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--warmup", type=int, default=None)
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        from pytorch_operator_tpu.runtime.backend import setup_backend
+
+        setup_backend("cpu")
+        cfg = dict(depth=18, batch_size=8, image_size=64, classes=100)
+        steps, warmup = args.steps or 3, args.warmup or 1
+    else:
+        cfg = dict(
+            depth=50, batch_size=args.batch_size or 128, image_size=224, classes=1000
+        )
+        steps, warmup = args.steps or 30, args.warmup or 5
+
+    from pytorch_operator_tpu.workloads.resnet_bench import run_benchmark
+
+    result = run_benchmark(
+        steps=steps,
+        warmup=warmup,
+        log=lambda msg: print(msg, file=sys.stderr, flush=True),
+        **cfg,
+    )
+    return {
+        "metric": result["metric"],
+        "value": result["value"],
+        "unit": result["unit"],
+        "vs_baseline": round(result["value"] / BASELINE_IMAGES_PER_SEC_PER_CHIP, 4),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))

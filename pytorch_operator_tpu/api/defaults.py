@@ -25,12 +25,20 @@ from .types import (
     TPUJob,
 )
 
+# Jobs that omitted spec.port carry this annotation: local supervisors
+# re-probe a free coordinator port per world launch (all jobs share
+# 127.0.0.1, unlike pods with distinct IPs). Set here — the one place every
+# submission path funnels through — so CLI-queued and API-submitted jobs
+# behave identically.
+AUTO_PORT_ANNOTATION = "tpujob.dev/auto-port"
+
 
 def set_defaults(job: TPUJob) -> TPUJob:
     """Fill defaulted fields in place (idempotent); returns the job."""
     spec = job.spec
 
     if spec.port is None:
+        job.metadata.annotations[AUTO_PORT_ANNOTATION] = "true"
         spec.port = DEFAULT_PORT
 
     for rs in spec.replica_specs.values():

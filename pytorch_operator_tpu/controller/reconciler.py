@@ -18,7 +18,7 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
-from ..api.defaults import set_defaults
+from ..api.defaults import AUTO_PORT_ANNOTATION, set_defaults
 from ..api.types import (
     CleanPodPolicy,
     ConditionType,
@@ -271,7 +271,7 @@ class Reconciler:
             # time keeps the free-probe → coordinator-bind window tiny, and
             # a fresh port per gang restart dodges TIME_WAIT on the old one.
             if (
-                job.metadata.annotations.get("tpujob.dev/auto-port") == "true"
+                job.metadata.annotations.get(AUTO_PORT_ANNOTATION) == "true"
                 and not handles
             ):
                 from .supervisor import _find_free_port

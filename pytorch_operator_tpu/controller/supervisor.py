@@ -27,9 +27,6 @@ from .runner import ProcessRunner, SubprocessRunner
 from .store import JobStore, job_key
 
 
-AUTO_PORT_ANNOTATION = "tpujob.dev/auto-port"
-
-
 def default_state_dir() -> Path:
     return Path(os.environ.get("TPUJOB_HOME", ".tpujob"))
 
@@ -77,15 +74,11 @@ class Supervisor:
     # ---- API-server-ish surface ----
 
     def submit(self, job: TPUJob) -> str:
-        """Accept a job: default, validate, store (kubectl-apply analog)."""
-        # All jobs share 127.0.0.1 locally (unlike pods with distinct IPs),
-        # so the reference's fixed default port would collide across
-        # concurrent jobs. An OMITTED port (checked before defaulting, so an
-        # explicit 23456 is honored) is marked auto: the reconciler probes a
-        # free port right before each world launch, keeping the
-        # probe-to-bind reuse window near zero.
-        if job.spec.port is None:
-            job.metadata.annotations[AUTO_PORT_ANNOTATION] = "true"
+        """Accept a job: default, validate, store (kubectl-apply analog).
+
+        Omitted ports are marked auto by set_defaults; the reconciler probes
+        a free port right before each world launch.
+        """
         set_defaults(job)
         validate(job)
         key = self.store.add(job)

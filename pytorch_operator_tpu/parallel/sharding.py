@@ -43,7 +43,9 @@ def logical_to_spec(logical_axes: Sequence[Optional[str]], rules: LogicalRules =
     """
     from jax.sharding import PartitionSpec
 
-    table = dict(rules)
+    table = {}
+    for name, mesh_ax in rules:  # earlier entries win, as documented
+        table.setdefault(name, mesh_ax)
     mesh_axes = set(mesh.axis_names) if mesh is not None else None
     out = []
     for ax in logical_axes:

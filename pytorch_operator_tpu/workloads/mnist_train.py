@@ -38,7 +38,6 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax.sharding import NamedSharding, PartitionSpec
 
     from ..models.mnist import DigitCNN
     from ..parallel import make_mesh, replicated
@@ -55,9 +54,17 @@ def main(argv=None) -> int:
 
     x_train, y_train = digits("train")
     x_test, y_test = digits("test")
-    # Pad the global batch to divide the dp extent evenly.
+    # Global batch must divide the dp extent evenly and fit the dataset
+    # (a batch larger than the training set would yield zero steps/epoch).
     dp = mesh.shape["dp"]
-    batch = (args.batch_size // dp) * dp or dp
+    batch = (min(args.batch_size, len(x_train)) // dp) * dp
+    if batch == 0:
+        print(
+            f"[mnist] error: training set ({len(x_train)}) smaller than the "
+            f"dp extent ({dp}); cannot form a global batch",
+            flush=True,
+        )
+        return 1
 
     model = DigitCNN(dtype=jnp.bfloat16)
     params = model.init(jax.random.key(args.seed), jnp.zeros((1, 8, 8, 1)))
@@ -83,12 +90,12 @@ def main(argv=None) -> int:
         return params, opt_state, loss
 
     @jax.jit
-    def eval_step(params, bx, by):
+    def eval_step(params, bx, by, mask):
         logits = model.apply(params, bx)
-        return jnp.sum(jnp.argmax(logits, -1) == by)
+        return jnp.sum((jnp.argmax(logits, -1) == by) * mask)
 
     step = 0
-    first_reported = False
+    loss = None
     for epoch in range(args.epochs):
         for bx, by in epoch_batches(
             x_train, y_train, batch, seed=args.seed + epoch
@@ -96,24 +103,33 @@ def main(argv=None) -> int:
             gx = global_batch(bx, mesh)
             gy = global_batch(by, mesh)
             params, opt_state, loss = train_step(params, opt_state, gx, gy)
-            if not first_reported:
-                jax.block_until_ready(loss)
+            if step == 0:
+                float(jax.device_get(loss))  # real fence (not block_until_ready)
                 rendezvous.report_first_step(step)
-                first_reported = True
                 print(
                     f"[mnist] first step done at +{time.time() - t0:.2f}s",
                     flush=True,
                 )
             step += 1
-        rendezvous.report_metrics(step, epoch=epoch, loss=float(loss))
+        if loss is not None:
+            rendezvous.report_metrics(step, epoch=epoch, loss=float(loss))
 
-    # Evaluate on the (small, replicated) test set.
-    n_eval = (len(x_test) // dp) * dp
-    correct = 0
-    for i in range(0, n_eval, dp):
-        gx = global_batch(x_test[i : i + dp], mesh)
-        gy = global_batch(y_test[i : i + dp], mesh)
-        correct += int(eval_step(params, gx, gy))
+    # Evaluate the whole test set as ONE padded global batch: per-dispatch
+    # latency (remote PJRT tunnels especially) makes hundreds of tiny eval
+    # dispatches pure overhead.
+    n_eval = len(x_test)
+    pad = (-n_eval) % dp
+    xp = np.concatenate([x_test, np.zeros((pad,) + x_test.shape[1:], x_test.dtype)])
+    yp = np.concatenate([y_test, np.zeros((pad,), y_test.dtype)])
+    mask = np.concatenate([np.ones(n_eval, np.float32), np.zeros(pad, np.float32)])
+    correct = int(
+        eval_step(
+            params,
+            global_batch(xp, mesh),
+            global_batch(yp, mesh),
+            global_batch(mask, mesh),
+        )
+    )
     acc = correct / n_eval
     rendezvous.report_metrics(step, test_accuracy=acc)
     print(

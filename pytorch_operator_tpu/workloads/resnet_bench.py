@@ -1,0 +1,207 @@
+"""ResNet-50 throughput benchmark + training workload.
+
+The north-star metric (BASELINE.json:2): images/sec/chip on ResNet-50,
+measured with synthetic data to isolate compute from input pipelines
+(BASELINE.md "Measurement notes"). Runs as a supervisor workload or
+standalone (``python -m ... --steps 30``).
+
+The train step is the real thing — SGD+momentum, batch-norm statistic
+updates, label-smoothed cross-entropy, bf16 compute — not a forward-only
+proxy; dp-sharded batch over every device in the world.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from ..runtime import rendezvous
+
+
+def build_train_state(model, mesh, *, lr: float, momentum: float, seed: int, image_size: int):
+    """Init replicated params/BN-state/opt-state for the dp mesh."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..parallel import replicated
+
+    from functools import partial as _partial
+
+    variables = jax.jit(_partial(model.init, train=False))(
+        jax.random.key(seed), jnp.zeros((1, image_size, image_size, 3))
+    )
+    params = variables["params"]
+    batch_stats = variables["batch_stats"]
+    tx = optax.sgd(lr, momentum=momentum, nesterov=True)
+    opt_state = tx.init(params)
+    rep = replicated(mesh)
+    return (
+        jax.device_put(params, rep),
+        jax.device_put(batch_stats, rep),
+        jax.device_put(opt_state, rep),
+        tx,
+    )
+
+
+def make_train_step(model, tx, label_smoothing: float = 0.1):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch_stats, bx, by):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            bx,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        labels = optax.smooth_labels(
+            jax.nn.one_hot(by, logits.shape[-1]), label_smoothing
+        )
+        loss = optax.softmax_cross_entropy(logits, labels).mean()
+        return loss, updates["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, bx, by):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch_stats, bx, by
+        )
+        updates, opt_state = tx.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    return train_step
+
+
+def run_benchmark(
+    *,
+    depth: int = 50,
+    batch_size: int = 128,
+    image_size: int = 224,
+    classes: int = 1000,
+    steps: int = 30,
+    warmup: int = 5,
+    lr: float = 0.1,
+    momentum: float = 0.9,
+    log=print,
+) -> dict:
+    """The ONE benchmark harness (bench.py and the workload both use it).
+
+    Timing fence: a real host transfer (device_get), NOT block_until_ready —
+    on remote-tunnel PJRT backends the latter can resolve before the
+    dispatch queue drains, inflating throughput by orders of magnitude.
+    """
+    import jax
+
+    from ..models import resnet as resnet_lib
+    from ..parallel import make_mesh
+    from ..parallel.data import global_batch
+    from .datasets import synthetic_images
+
+    warmup = max(warmup, 1)  # the first (compile) step can never be timed
+    model_cls = {
+        18: resnet_lib.ResNet18,
+        34: resnet_lib.ResNet34,
+        50: resnet_lib.ResNet50,
+        101: resnet_lib.ResNet101,
+        152: resnet_lib.ResNet152,
+    }[depth]
+    model = model_cls(num_classes=classes)
+
+    n_dev = jax.device_count()
+    mesh = make_mesh({"dp": n_dev})
+    batch = max(batch_size // n_dev, 1) * n_dev
+    log(
+        f"[resnet] ResNet-{depth} on {n_dev} device(s) "
+        f"({jax.devices()[0].platform}), global batch {batch}, {image_size}px"
+    )
+
+    params, batch_stats, opt_state, tx = build_train_state(
+        model, mesh, lr=lr, momentum=momentum, seed=0, image_size=image_size
+    )
+    train_step = make_train_step(model, tx)
+    hx, hy = synthetic_images(batch, image_size, image_size, classes)
+    gx, gy = global_batch(hx, mesh), global_batch(hy, mesh)
+
+    t_start = time.time()
+    for i in range(warmup):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, gx, gy
+        )
+        if i == 0:
+            float(jax.device_get(loss))
+            rendezvous.report_first_step(0)
+            log(f"[resnet] first step (compile) +{time.time() - t_start:.1f}s")
+    float(jax.device_get(loss))
+
+    t0 = time.time()
+    for _ in range(steps):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, gx, gy
+        )
+    final_loss = float(jax.device_get(loss))
+    dt = time.time() - t0
+
+    images_per_sec = batch * steps / dt
+    per_chip = images_per_sec / n_dev
+    step_ms = 1000.0 * dt / steps
+    rendezvous.report_metrics(
+        steps, images_per_sec=images_per_sec, images_per_sec_per_chip=per_chip
+    )
+    log(
+        f"[resnet] {steps} steps in {dt:.2f}s: "
+        f"{images_per_sec:.1f} images/sec total, {per_chip:.1f} images/sec/chip, "
+        f"{step_ms:.1f} ms/step, loss={final_loss:.3f}"
+    )
+    return {
+        "metric": f"resnet{depth}_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "images_per_sec_total": round(images_per_sec, 2),
+        "step_time_ms": round(step_ms, 2),
+        "global_batch": batch,
+        "devices": n_dev,
+        "final_loss": round(final_loss, 4),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128, help="global batch")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--steps", type=int, default=30, help="timed steps")
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--depth", type=int, default=50, choices=[18, 34, 50, 101, 152])
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--json", action="store_true", help="print a JSON result line")
+    args = p.parse_args(argv)
+
+    world = rendezvous.initialize_from_env()
+    result = run_benchmark(
+        depth=args.depth,
+        batch_size=args.batch_size,
+        image_size=args.image_size,
+        classes=args.classes,
+        steps=args.steps,
+        warmup=args.warmup,
+        lr=args.lr,
+        momentum=args.momentum,
+        log=lambda msg: print(
+            f"[rank {world.process_id}/{world.num_processes}] {msg}"
+            if world.num_processes > 1
+            else msg,
+            flush=True,
+        ),
+    )
+    if args.json and world.process_id == 0:
+        print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,7 +70,8 @@ class TestCreation:
         menv = runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
         assert menv["RANK"] == "0"
         assert menv["WORLD_SIZE"] == "3"
-        assert menv["MASTER_PORT"] == "23456"
+        # fixture omitted the port → auto-allocated; env must match the spec
+        assert menv["MASTER_PORT"] == str(store.get(key).spec.port)
         assert menv["PYTHONUNBUFFERED"] == "1"
         assert menv["TPU_WORKER_ID"] == "0"
         assert menv["TPUJOB_NUM_PROCESSES"] == "3"

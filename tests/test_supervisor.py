@@ -257,7 +257,9 @@ class TestAutoPort:
 
     def test_explicit_default_port_honored(self, tmp_path):
         sup = make_supervisor(tmp_path)
-        job = new_job(name="explicit-port", workers=0)
+        # Build undefaulted so the explicit port is set BEFORE defaulting
+        # (defaulting is what distinguishes omitted from explicit).
+        job = new_job(name="explicit-port", workers=0, defaulted=False)
         job.spec.port = 23456  # explicitly set by user
         key = sup.submit(job)
         sup.sync_once()
