@@ -75,7 +75,9 @@ class TestCreation:
         assert menv["PYTHONUNBUFFERED"] == "1"
         assert menv["TPU_WORKER_ID"] == "0"
         assert menv["TPUJOB_NUM_PROCESSES"] == "3"
-        assert menv["TPUJOB_COORDINATOR_ADDRESS"].endswith(":23456")
+        assert menv["TPUJOB_COORDINATOR_ADDRESS"].endswith(
+            f":{store.get(key).spec.port}"
+        )
         w1 = runner.envs[replica_name(key, ReplicaType.WORKER, 1)]
         assert w1["RANK"] == "2"  # worker i → rank i+1
         assert w1["TPUJOB_PROCESS_ID"] == "2"
