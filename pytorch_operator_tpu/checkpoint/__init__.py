@@ -1,0 +1,10 @@
+"""Checkpoint/resume layer (orbax-backed).
+
+Reference: none in the operator (SURVEY.md §5 — resume is "restart the pod,
+user script reloads its checkpoint"); this package supplies the workload half
+the reference left to user containers.
+"""
+
+from .manager import CheckpointManager, job_checkpoint_dir
+
+__all__ = ["CheckpointManager", "job_checkpoint_dir"]

@@ -270,6 +270,13 @@ def cmd_delete(args) -> int:
     marker = state / "jobs" / (key.replace("/", "_") + ".delete")
     marker.write_text("")
     store.delete(key)
+    if args.purge:
+        import shutil
+
+        for root in ("checkpoints", "status"):
+            d = state / root / key.replace("/", "_")
+            if d.exists():
+                shutil.rmtree(d, ignore_errors=True)
     print(f"tpujob {key} deleted")
     return 0
 
@@ -328,6 +335,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("delete", help="delete a job")
     sp.add_argument("name")
+    sp.add_argument(
+        "--purge",
+        action="store_true",
+        help="also remove the job's checkpoint/status artifacts",
+    )
     add_ns(sp)
     sp.set_defaults(func=cmd_delete)
 

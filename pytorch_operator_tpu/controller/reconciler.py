@@ -53,6 +53,7 @@ class Reconciler:
         gang: Optional[GangScheduler] = None,
         expectations: Optional[ControllerExpectations] = None,
         status_root: Optional[Path] = None,
+        checkpoint_root: Optional[Path] = None,
         coordinator_host: str = "127.0.0.1",
     ):
         self.store = store
@@ -62,6 +63,7 @@ class Reconciler:
         self.gang = gang or GangScheduler(enabled=True)
         self.expectations = expectations or ControllerExpectations()
         self.status_root = Path(status_root) if status_root else None
+        self.checkpoint_root = Path(checkpoint_root) if checkpoint_root else None
         self.coordinator_host = coordinator_host
         self._unschedulable_warned = set()
         # Per-file byte offsets for incremental status-report scanning.
@@ -69,12 +71,25 @@ class Reconciler:
 
     # ---- helpers ----
 
-    def _status_dir(self, key: str) -> Optional[str]:
-        if self.status_root is None:
+    @staticmethod
+    def job_subdir(root: Optional[Path], key: str) -> Optional[str]:
+        """``root/<ns>_<name>``, created. Safe: names are DNS-1123-validated,
+        so the ``/``→``_`` flattening cannot collide."""
+        if root is None:
             return None
-        d = self.status_root / key.replace("/", "_")
+        d = root / key.replace("/", "_")
         d.mkdir(parents=True, exist_ok=True)
         return str(d)
+
+    def _status_dir(self, key: str) -> Optional[str]:
+        return self.job_subdir(self.status_root, key)
+
+    def _checkpoint_dir(self, key: str) -> Optional[str]:
+        """Per-job checkpoint dir. Deliberately survives restarts AND job
+        deletion/resubmission — job-level resume is "rerun the spec against
+        the existing checkpoint dir" (SURVEY.md §5 "Checkpoint / resume");
+        ``delete_job(purge_artifacts=True)`` reclaims it."""
+        return self.job_subdir(self.checkpoint_root, key)
 
     def _fail_job(self, job: TPUJob, key: str, reason: str, message: str, now: float):
         job.set_condition(
@@ -278,6 +293,7 @@ class Reconciler:
 
                 job.spec.port = _find_free_port()
             status_dir = self._status_dir(key)
+            checkpoint_dir = self._checkpoint_dir(key)
             num_processes = sum(
                 self._desired_replicas(job, rt) for rt in job.spec.replica_specs
             )
@@ -288,6 +304,7 @@ class Reconciler:
                     num_processes=num_processes,
                     coordinator_host=self.coordinator_host,
                     status_dir=status_dir,
+                    checkpoint_dir=checkpoint_dir,
                 )
                 self.runner.create(
                     key, rtype, index, job.spec.replica_specs[rtype].template, env

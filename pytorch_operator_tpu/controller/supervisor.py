@@ -68,6 +68,7 @@ class Supervisor:
             gang=self.gang,
             expectations=self.expectations,
             status_root=self.state_dir / "status",
+            checkpoint_root=self.state_dir / "checkpoints",
         )
         self._lock = threading.Lock()
 
@@ -91,8 +92,12 @@ class Supervisor:
     def list_jobs(self) -> List[TPUJob]:
         return self.store.list()
 
-    def delete_job(self, key: str) -> bool:
-        """Delete a job and terminate its replicas (kubectl delete analog)."""
+    def delete_job(self, key: str, purge_artifacts: bool = False) -> bool:
+        """Delete a job and terminate its replicas (kubectl delete analog).
+
+        Checkpoints/status artifacts survive by default (job-level resume,
+        SURVEY.md §5); ``purge_artifacts=True`` reclaims them.
+        """
         job = self.store.get(key)
         if job is None:
             return False
@@ -102,6 +107,13 @@ class Supervisor:
         self.expectations.delete_expectations(key)
         self.store.delete(key)
         self.events.drop_job(key)
+        if purge_artifacts:
+            import shutil
+
+            for root in (self.state_dir / "checkpoints", self.state_dir / "status"):
+                d = root / key.replace("/", "_")
+                if d.exists():
+                    shutil.rmtree(d, ignore_errors=True)
         return True
 
     def scale(self, key: str, worker_replicas: int) -> TPUJob:

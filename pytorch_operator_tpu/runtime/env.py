@@ -35,6 +35,7 @@ def build_cluster_env(
     num_processes: Optional[int] = None,
     coordinator_host: str = "127.0.0.1",
     status_dir: Optional[str] = None,
+    checkpoint_dir: Optional[str] = None,
 ) -> Dict[str, str]:
     """Build the injected environment for one replica process.
 
@@ -85,5 +86,7 @@ def build_cluster_env(
 
     if status_dir is not None:
         env["TPUJOB_STATUS_DIR"] = status_dir
+    if checkpoint_dir is not None:
+        env["TPUJOB_CHECKPOINT_DIR"] = checkpoint_dir
 
     return env
