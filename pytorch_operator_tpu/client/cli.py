@@ -33,7 +33,7 @@ from ..api import (
     set_defaults,
     validate,
 )
-from ..controller.store import JobStore, job_key
+from ..controller.store import JobStore, job_key, purge_job_artifacts
 from ..controller.supervisor import (
     Supervisor,
     default_state_dir,
@@ -268,15 +268,14 @@ def cmd_delete(args) -> int:
     # (it owns the replica processes); also remove the stored object so the
     # job disappears from get/describe immediately.
     marker = state / "jobs" / (key.replace("/", "_") + ".delete")
-    marker.write_text("")
+    # The marker carries the purge request: a running supervisor purges
+    # AFTER killing the replicas (else a live workload's next checkpoint
+    # save would re-create the dir behind the purge). The immediate purge
+    # below covers the daemon-less case (no replicas running).
+    marker.write_text("purge" if args.purge else "")
     store.delete(key)
     if args.purge:
-        import shutil
-
-        for root in ("checkpoints", "status"):
-            d = state / root / key.replace("/", "_")
-            if d.exists():
-                shutil.rmtree(d, ignore_errors=True)
+        purge_job_artifacts(state, key)
     print(f"tpujob {key} deleted")
     return 0
 

@@ -129,7 +129,32 @@ class JobStore:
             keys.append(p.stem.replace("_", "/", 1))
         return keys
 
+    def marker_requests_purge(self, key: str) -> bool:
+        """Whether the pending deletion marker asks for an artifact purge."""
+        if self.persist_dir is None:
+            return False
+        p = self.persist_dir / (key.replace("/", "_") + ".delete")
+        try:
+            return "purge" in p.read_text()
+        except OSError:
+            return False
+
     def clear_deletion_marker(self, key: str) -> None:
         if self.persist_dir is None:
             return
         (self.persist_dir / (key.replace("/", "_") + ".delete")).unlink(missing_ok=True)
+
+
+# Artifact roots under the supervisor state dir that outlive the job object
+# (deliberately — job-level resume, SURVEY.md §5) until an explicit purge.
+ARTIFACT_ROOTS = ("checkpoints", "status")
+
+
+def purge_job_artifacts(state_dir: Path, key: str) -> None:
+    """Remove a job's checkpoint/status artifacts (``delete --purge``)."""
+    import shutil
+
+    for root in ARTIFACT_ROOTS:
+        d = Path(state_dir) / root / key.replace("/", "_")
+        if d.exists():
+            shutil.rmtree(d, ignore_errors=True)

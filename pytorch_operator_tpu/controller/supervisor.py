@@ -24,7 +24,7 @@ from .gang import GangScheduler
 from .metrics import MetricsRegistry
 from .reconciler import Reconciler
 from .runner import ProcessRunner, SubprocessRunner
-from .store import JobStore, job_key
+from .store import JobStore, job_key, purge_job_artifacts
 
 
 def default_state_dir() -> Path:
@@ -108,12 +108,7 @@ class Supervisor:
         self.store.delete(key)
         self.events.drop_job(key)
         if purge_artifacts:
-            import shutil
-
-            for root in (self.state_dir / "checkpoints", self.state_dir / "status"):
-                d = root / key.replace("/", "_")
-                if d.exists():
-                    shutil.rmtree(d, ignore_errors=True)
+            purge_job_artifacts(self.state_dir, key)
         return True
 
     def scale(self, key: str, worker_replicas: int) -> TPUJob:
@@ -212,7 +207,11 @@ class Supervisor:
         """Act on cross-process ``tpujob delete`` requests: this process owns
         the replica processes, so it performs the kill + record removal."""
         for key in self.store.deletion_markers():
-            self.delete_job(key)
+            # Read the purge request BEFORE acting; purge happens after the
+            # replicas are dead, so a running workload can't re-create the
+            # checkpoint dir behind the purge.
+            purge = self.store.marker_requests_purge(key)
+            self.delete_job(key, purge_artifacts=purge)
             self.store.clear_deletion_marker(key)
 
     def write_metrics_file(self) -> None:

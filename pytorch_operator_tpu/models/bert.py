@@ -1,0 +1,233 @@
+"""BERT-style bidirectional encoder, TPU-first.
+
+Reference analog: the BERT-base FSDP fine-tune PyTorchJob config
+(BASELINE.json:9) — as with every model here, the reference keeps the model
+in user containers; this is a from-scratch flax implementation of the
+original BERT architecture (learned positions, post-LayerNorm, GELU MLP,
+pooler over [CLS]) with a classification head for fine-tuning and an MLM
+head for pretraining-style objectives.
+
+TPU-first choices mirror models/llama.py: logical-axis-annotated params
+(fsdp/tp portable across meshes), scan over layers, bf16 compute / f32
+params, static shapes. The padding mask is an input, not dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30_522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_len: int = 512
+    type_vocab: int = 2
+    ln_eps: float = 1e-12
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def bert_base(**over) -> BertConfig:
+    return BertConfig(**over)
+
+
+def bert_tiny(**over) -> BertConfig:
+    base = dict(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+        max_len=64, dtype=jnp.float32,
+    )
+    base.update(over)
+    return BertConfig(**base)
+
+
+def _dense(cfg, features, axes, name):
+    return nn.DenseGeneral(
+        features,
+        axis=-1,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=0.02), axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), axes[1:]
+        ),
+        name=name,
+    )
+
+
+class SelfAttention(nn.Module):
+    """Bidirectional multi-head attention with a padding mask."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        H, D = cfg.n_heads, cfg.head_dim
+        qkv_axes = ("embed", "heads", "head_dim")
+        q = _dense(cfg, (H, D), qkv_axes, "q_proj")(x)
+        k = _dense(cfg, (H, D), qkv_axes, "k_proj")(x)
+        v = _dense(cfg, (H, D), qkv_axes, "v_proj")(x)
+
+        scores = jnp.einsum(
+            "bshd,bthd->bhst", q, k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(D).astype(jnp.float32)
+        if pad_mask is not None:
+            # pad_mask [B,S]: True = real token. Mask out attending TO pads.
+            scores = jnp.where(
+                pad_mask[:, None, None, :], scores, jnp.finfo(jnp.float32).min
+            )
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, H * D)
+        out = nn.with_logical_constraint(out, ("batch", "seq", None))
+        return nn.DenseGeneral(
+            cfg.d_model, axis=-1, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("heads", "embed")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("embed",)
+            ),
+            name="o_proj",
+        )(out)
+
+
+class EncoderLayer(nn.Module):
+    """Post-LN transformer encoder layer (original BERT residual order)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, pad_mask = carry
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=cfg.ln_eps, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+            scale_init=nn.with_logical_partitioning(
+                nn.initializers.ones_init(), ("norm",)
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("norm",)
+            ),
+            name=name,
+        )
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        x = ln("attn_ln")(x + SelfAttention(cfg, name="attn")(x, pad_mask))
+        x = x.astype(cfg.dtype)
+        h = _dense(cfg, cfg.d_ff, ("embed", "mlp"), "mlp_up")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.with_logical_constraint(h, ("batch", "seq", "mlp"))
+        h = _dense(cfg, cfg.d_model, ("mlp", "embed"), "mlp_down")(h)
+        x = ln("mlp_ln")(x + h).astype(cfg.dtype)
+        return (x, pad_mask), None
+
+
+class Bert(nn.Module):
+    """Encoder backbone: tokens [B,S] (+ optional type ids, padding mask)
+    → (sequence_output [B,S,d], pooled [B,d])."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, type_ids=None, pad_mask=None):
+        cfg = self.cfg
+        B, S = tokens.shape
+        emb = lambda n, v, axes, name: nn.Embed(  # noqa: E731
+            n, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), axes
+            ),
+            name=name,
+        )(v)
+        x = emb(cfg.vocab_size, tokens, ("vocab", "embed"), "word_embed")
+        x = x + emb(
+            cfg.max_len,
+            jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S)),
+            (None, "embed"),
+            "pos_embed",
+        )
+        if type_ids is not None:
+            x = x + emb(cfg.type_vocab, type_ids, (None, "embed"), "type_embed")
+        x = nn.LayerNorm(
+            epsilon=cfg.ln_eps, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+            name="embed_ln",
+        )(x).astype(cfg.dtype)
+
+        layer = EncoderLayer
+        if cfg.remat:
+            layer = nn.remat(EncoderLayer, prevent_cse=False)
+        ScanLayers = nn.scan(
+            layer,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        (x, _), _ = ScanLayers(cfg, name="layers")((x, pad_mask), None)
+
+        # Square kernels annotate only the input dim — a repeated "embed"
+        # would map both dims onto the same mesh axis (invalid PartitionSpec).
+        pooled = nn.tanh(
+            _dense(cfg, cfg.d_model, ("embed", None), "pooler")(x[:, 0])
+        )
+        return x, pooled
+
+
+class BertClassifier(nn.Module):
+    """Backbone + classification head — the fine-tune surface
+    (BASELINE.json:9 workload)."""
+
+    cfg: BertConfig
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, tokens, type_ids=None, pad_mask=None):
+        _, pooled = Bert(self.cfg, name="bert")(tokens, type_ids, pad_mask)
+        return nn.DenseGeneral(
+            self.num_classes, dtype=jnp.float32, param_dtype=self.cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", None)
+            ),
+            name="classifier",
+        )(pooled)
+
+
+class BertMLM(nn.Module):
+    """Backbone + masked-LM head (tied-free, like the untied Llama head)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, type_ids=None, pad_mask=None):
+        seq, _ = Bert(self.cfg, name="bert")(tokens, type_ids, pad_mask)
+        h = _dense(self.cfg, self.cfg.d_model, ("embed", None), "mlm_transform")(seq)
+        h = nn.gelu(h, approximate=True)
+        h = nn.LayerNorm(
+            epsilon=self.cfg.ln_eps, dtype=jnp.float32,
+            param_dtype=self.cfg.param_dtype, name="mlm_ln",
+        )(h)
+        return nn.DenseGeneral(
+            self.cfg.vocab_size, dtype=jnp.float32, param_dtype=self.cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), ("embed", "vocab")
+            ),
+            name="mlm_head",
+        )(h)

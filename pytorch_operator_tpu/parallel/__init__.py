@@ -21,4 +21,10 @@ from .sharding import (  # noqa: F401
     replicated,
     shard_tree,
 )
+from .logical import (  # noqa: F401
+    activation_rules,
+    init_sharded,
+    logical_shardings,
+    rules_for_mesh,
+)
 from . import collectives  # noqa: F401

@@ -1,0 +1,67 @@
+"""Logical-axis → mesh plumbing for annotated flax models.
+
+The transformer models (models/llama.py, models/bert.py) tag every parameter
+with logical axis names via ``nn.with_logical_partitioning``. This module
+turns those tags into concrete ``NamedSharding``s for a given mesh (missing
+mesh axes degrade to replication, so one set of annotations serves every
+mesh shape) and runs a sharded init — parameters are *born* on their target
+devices/shards; no host-side init + scatter round trip.
+
+Reference analog: none — the reference delegates all of this to DDP/NCCL
+inside user containers (SURVEY.md §2 "Parallelism strategies"); this is the
+XLA-collectives-over-ICI replacement.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from .sharding import DEFAULT_RULES, LogicalRules, filter_axis_for_mesh
+
+
+def rules_for_mesh(mesh, rules: LogicalRules = DEFAULT_RULES) -> LogicalRules:
+    """Filter a rule table down to axes the mesh actually has.
+
+    flax's ``logical_to_mesh_sharding`` (and ``with_logical_constraint``)
+    require every referenced mesh axis to exist; dropping absent axes here is
+    what makes annotations portable across mesh shapes.
+    """
+    names = set(mesh.axis_names)
+    return tuple(
+        (logical, filter_axis_for_mesh(ax, names)) for logical, ax in rules
+    )
+
+
+def logical_shardings(abstract_tree: Any, mesh, rules: LogicalRules = DEFAULT_RULES):
+    """NamedShardings for a (possibly abstract) tree of flax ``Partitioned``
+    leaves — pass ``jax.eval_shape(model.init, ...)`` output."""
+    import flax.linen as nn
+
+    specs = nn.get_partition_spec(abstract_tree)
+    return nn.logical_to_mesh_sharding(specs, mesh, rules_for_mesh(mesh, rules))
+
+
+def init_sharded(
+    init_fn: Callable, mesh, *init_args, rules: LogicalRules = DEFAULT_RULES
+):
+    """jit ``init_fn`` with out_shardings derived from logical annotations.
+
+    Returns ``(variables, shardings)`` with metadata boxes removed —
+    variables are plain arrays already laid out on the mesh.
+    """
+    import flax.linen as nn
+    import jax
+
+    abstract = jax.eval_shape(init_fn, *init_args)
+    shardings = logical_shardings(abstract, mesh, rules)
+    variables = jax.jit(init_fn, out_shardings=shardings)(*init_args)
+    return nn.meta.unbox(variables), nn.meta.unbox(shardings)
+
+
+def activation_rules(mesh, rules: LogicalRules = DEFAULT_RULES):
+    """Context manager making ``nn.with_logical_constraint`` inside model
+    code bind to this mesh's axes: run apply/train steps under
+    ``with mesh, activation_rules(mesh): ...``."""
+    import flax.linen as nn
+
+    return nn.logical_axis_rules(rules_for_mesh(mesh, rules))

@@ -13,15 +13,17 @@ evenly-divisible dimension over the ``fsdp`` axis.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple, Union
 
-LogicalRules = Sequence[Tuple[str, Optional[str]]]
+MeshAxes = Union[str, Tuple[str, ...], None]
+LogicalRules = Sequence[Tuple[str, MeshAxes]]
 
 # Default rule table, mirroring common transformer layouts. Entries earlier
-# in the table win. None = replicate.
+# in the table win. None = replicate. A tuple means "shard over these mesh
+# axes jointly" (e.g. the global batch over BOTH dp and fsdp — fsdp is a
+# data axis too in ZeRO-style sharding).
 DEFAULT_RULES: LogicalRules = (
-    ("batch", "dp"),
-    ("batch_fsdp", "fsdp"),
+    ("batch", ("dp", "fsdp")),
     ("seq", "sp"),
     ("embed", "fsdp"),      # fsdp shards the embed dim of params
     ("mlp", "tp"),
@@ -32,14 +34,31 @@ DEFAULT_RULES: LogicalRules = (
     ("stage", "pp"),
     ("head_dim", None),
     ("norm", None),
+    ("layers", None),       # scan-over-layers axis stays unsharded (pp later)
 )
+
+
+def filter_axis_for_mesh(mesh_ax: MeshAxes, mesh_axes: Optional[set]) -> MeshAxes:
+    """Drop mesh axes absent from ``mesh_axes`` (None = keep everything);
+    tuple entries are filtered member-wise and collapse to a bare string
+    (one member) or None (empty). The ONE place this policy lives — both
+    logical_to_spec and the flax-rules path (logical.rules_for_mesh) use it."""
+    if mesh_ax is None or mesh_axes is None:
+        return mesh_ax
+    if isinstance(mesh_ax, tuple):
+        kept = tuple(a for a in mesh_ax if a in mesh_axes)
+        if not kept:
+            return None
+        return kept[0] if len(kept) == 1 else kept
+    return mesh_ax if mesh_ax in mesh_axes else None
 
 
 def logical_to_spec(logical_axes: Sequence[Optional[str]], rules: LogicalRules = DEFAULT_RULES, mesh=None):
     """Map a tuple of logical axis names to a PartitionSpec.
 
-    Axes whose mesh axis is absent from the mesh (or has size 1) fall back
-    to replication, so the same annotations serve every mesh shape.
+    Axes whose mesh axis is absent from the mesh fall back to replication
+    (tuple entries are filtered member-wise), so the same annotations serve
+    every mesh shape.
     """
     from jax.sharding import PartitionSpec
 
@@ -47,12 +66,11 @@ def logical_to_spec(logical_axes: Sequence[Optional[str]], rules: LogicalRules =
     for name, mesh_ax in rules:  # earlier entries win, as documented
         table.setdefault(name, mesh_ax)
     mesh_axes = set(mesh.axis_names) if mesh is not None else None
-    out = []
-    for ax in logical_axes:
-        mesh_ax = table.get(ax) if ax is not None else None
-        if mesh_ax is not None and mesh_axes is not None and mesh_ax not in mesh_axes:
-            mesh_ax = None
-        out.append(mesh_ax)
+
+    out = [
+        filter_axis_for_mesh(table.get(ax), mesh_axes) if ax is not None else None
+        for ax in logical_axes
+    ]
     # Trim trailing Nones (canonical PartitionSpec form).
     while out and out[-1] is None:
         out.pop()

@@ -1,0 +1,108 @@
+"""Shared training plumbing for the transformer workloads.
+
+The key idiom: the WHOLE train state (params + optimizer state) is built
+inside one jitted init whose out_shardings come from the model's logical
+axis annotations — optax's tree_map over flax ``Partitioned`` params
+propagates the metadata into Adam's mu/nu, so ZeRO-style sharding of the
+optimizer state falls out for free (params are born sharded; nothing is
+ever materialized replicated).
+
+Reference analog: none — DDP keeps optimizer state replicated per rank and
+the reference never touches it (SURVEY.md §2 parallelism table); this is
+the fsdp-axis design BASELINE.json:9 asks for.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Optional
+
+
+def init_sharded_train_state(model_init: Callable, tx, mesh):
+    """Returns ``(state, shardings)`` where state = {"params", "opt_state"},
+    both sharded per the model's logical annotations (mu/nu like params,
+    scalars replicated)."""
+    from ..parallel import init_sharded
+
+    def init_state(key):
+        variables = model_init(key)
+        params = variables["params"]  # still metadata-boxed
+        return {"params": params, "opt_state": tx.init(params)}
+
+    import jax
+
+    return init_sharded(init_state, mesh, jax.random.key(int(os.environ.get("TPUJOB_SEED", "0"))))
+
+
+def make_lm_train_step(model, tx, mesh):
+    """Next-token cross-entropy train step, jitted with donated state."""
+    import jax
+    import optax
+
+    from ..parallel import activation_rules
+
+    def loss_fn(params, tokens):
+        with activation_rules(mesh):
+            logits = model.apply({"params": params}, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tokens[:, 1:]
+        ).mean()
+
+    @jax.jit
+    def train_step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
+        updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt_state": opt_state}, loss
+
+    return train_step
+
+
+def throughput_loop(
+    train_step,
+    state,
+    batches: Callable[[int], Any],
+    *,
+    steps: int,
+    warmup: int,
+    device_get,
+    on_first_step: Optional[Callable[[], None]] = None,
+    checkpoint_every: int = 0,
+    save: Optional[Callable[[int, Any], None]] = None,
+    start_step: int = 0,
+    log=print,
+):
+    """Run warmup + timed steps; returns (state, final_loss, steps_per_sec,
+    end_step).
+
+    ``device_get`` must be a real host transfer (block_until_ready alone
+    under-synchronizes on tunneled PJRT backends — BASELINE.md notes).
+    Checkpoint-save time is excluded from the throughput window (the
+    BASELINE.md synthetic-benchmark methodology isolates compute).
+    """
+    step = start_step
+    t0 = time.time()
+    for i in range(max(warmup, 1)):
+        state, loss = train_step(state, batches(step))
+        step += 1
+        if i == 0:
+            device_get(loss)
+            if on_first_step is not None:
+                on_first_step()
+            log(f"first step (compile) +{time.time() - t0:.1f}s")
+    device_get(loss)
+
+    t0 = time.time()
+    t_saving = 0.0
+    for _ in range(steps):
+        state, loss = train_step(state, batches(step))
+        step += 1
+        if checkpoint_every and save is not None and step % checkpoint_every == 0:
+            device_get(loss)  # fence before leaving the hot loop
+            t_save = time.time()
+            save(step, state)
+            t_saving += time.time() - t_save
+    final_loss = float(device_get(loss))
+    dt = time.time() - t0 - t_saving
+    return state, final_loss, steps / dt, step

@@ -79,4 +79,4 @@ class TestGraftEntry:
 
         g.dryrun_multichip(8)
         out = capsys.readouterr().out
-        assert "step ok" in out and "fsdp-sharded" in out
+        assert "[dryrun] ok" in out and "dp=2,fsdp=2,tp=2" in out

@@ -117,6 +117,29 @@ class TestSubprocessE2E:
         assert sup.runner.list_for_job(key) == []
         sup.shutdown()
 
+    def test_purge_marker_removes_artifacts_after_kill(self, tmp_path):
+        """`tpujob delete --purge` from another process: the supervisor must
+        purge AFTER terminating replicas, so a live workload can't re-create
+        the checkpoint dir behind the purge."""
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="purgeme", workers=0)
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            module="pytorch_operator_tpu.workloads.exit_with",
+            args=["--sleep", "60", "--code", "0"],
+        )
+        key = sup.submit(job)
+        sup.sync_once()
+        ckpt_dir = sup.state_dir / "checkpoints" / key.replace("/", "_")
+        assert ckpt_dir.exists()  # injected at launch
+        # Cross-process purge request (what cmd_delete --purge writes).
+        marker = sup.state_dir / "jobs" / (key.replace("/", "_") + ".delete")
+        marker.write_text("purge")
+        sup.process_deletion_markers()
+        assert sup.runner.list_for_job(key) == []
+        assert not ckpt_dir.exists()
+        assert not marker.exists()
+        sup.shutdown()
+
 
 class TestTTLAndPersistence:
     def test_ttl_gc(self, tmp_path):

@@ -1,0 +1,84 @@
+"""LM workload tests: BERT-FSDP fine-tune and Llama train, in-process on
+the 8-device CPU mesh — learning actually happens, optimizer state is
+really ZeRO-sharded, and checkpoint resume continues rather than restarts.
+"""
+
+import os
+
+import pytest
+
+import tests.jaxenv  # noqa: F401
+
+from pytorch_operator_tpu.workloads import bert_fsdp, llama_train
+
+
+def test_bert_fsdp_learns_and_shards_opt_state():
+    import jax
+    import numpy as np
+    import optax
+
+    from pytorch_operator_tpu.models.bert import BertClassifier, bert_tiny
+    from pytorch_operator_tpu.parallel import make_mesh
+    from pytorch_operator_tpu.workloads.trainer import init_sharded_train_state
+
+    # The ZeRO claim, asserted directly: Adam mu/nu leaves carry the fsdp
+    # sharding of their params.
+    mesh = make_mesh({"fsdp": 8})
+    model = BertClassifier(bert_tiny(), num_classes=2)
+    tx = optax.adamw(1e-4)
+    state, _ = init_sharded_train_state(
+        lambda k: model.init(k, np.zeros((1, 16), np.int32)), tx, mesh
+    )
+    mu = state["opt_state"][0].mu
+    q_mu = mu["bert"]["layers"]["attn"]["q_proj"]["kernel"]
+    q_p = state["params"]["bert"]["layers"]["attn"]["q_proj"]["kernel"]
+    assert q_mu.sharding == q_p.sharding
+    assert "fsdp" in tuple(q_mu.sharding.spec)
+
+    result = bert_fsdp.run(
+        mesh_spec="fsdp=8", batch_size=32, seq_len=32, steps=40, warmup=1,
+        lr=3e-4, log=lambda *_: None,
+    )
+    assert result["final_accuracy"] >= 0.9, result
+    assert result["final_loss"] < 0.5, result
+
+
+def test_llama_train_loss_decreases():
+    result = llama_train.run(
+        config="tiny", mesh_spec="dp=2,fsdp=2,tp=2", batch_size=8, seq_len=32,
+        steps=25, warmup=1, lr=1e-3, log=lambda *_: None,
+    )
+    # ln(256) ≈ 5.55 is chance level on the synthetic bigram stream.
+    assert result["final_loss"] < 5.0, result
+
+
+def test_llama_checkpoint_resume(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUJOB_CHECKPOINT_DIR", str(tmp_path / "ck"))
+    r1 = llama_train.run(
+        config="tiny", mesh_spec="fsdp=8", batch_size=8, seq_len=32,
+        steps=4, warmup=1, checkpoint_every=3, log=lambda *_: None,
+    )
+    logs = []
+    r2 = llama_train.run(
+        config="tiny", mesh_spec="fsdp=8", batch_size=8, seq_len=32,
+        steps=4, warmup=1, checkpoint_every=3, log=logs.append,
+    )
+    assert any("resumed from checkpoint" in m for m in logs), logs
+    assert r2["end_step"] == r1["end_step"] + 5  # warmup(1) + steps(4)
+
+
+def test_llama_max_steps_caps_work(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUJOB_CHECKPOINT_DIR", str(tmp_path / "ck"))
+    r1 = llama_train.run(
+        config="tiny", mesh_spec="fsdp=8", batch_size=8, seq_len=32,
+        steps=10, warmup=1, checkpoint_every=4, max_steps=6,
+        log=lambda *_: None,
+    )
+    assert r1["end_step"] == 6
+    # resumed run respects the cap: only the remainder is run
+    r2 = llama_train.run(
+        config="tiny", mesh_spec="fsdp=8", batch_size=8, seq_len=32,
+        steps=10, warmup=1, checkpoint_every=4, max_steps=8,
+        log=lambda *_: None,
+    )
+    assert r2["end_step"] == 8
