@@ -47,6 +47,10 @@ class LlamaConfig:
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     remat: bool = False  # checkpoint each block (jax.checkpoint under scan)
+    # Attention implementation: "dense" (materialized S×S scores), "ring"
+    # (sequence-parallel ring attention over the mesh's ``sp`` axis —
+    # parallel/ring.py; requires passing the mesh to the model).
+    attn_impl: str = "dense"
 
     @property
     def q_per_kv(self) -> int:
@@ -104,9 +108,15 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 class Attention(nn.Module):
-    """Grouped-query attention with RoPE and a causal mask."""
+    """Grouped-query attention with RoPE and a causal mask.
+
+    ``mesh`` is only consulted by the ring implementation (attn_impl="ring"),
+    which shards the sequence over the mesh's ``sp`` axis and rotates K/V
+    around the ring (parallel/ring.py).
+    """
 
     cfg: LlamaConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -139,13 +149,22 @@ class Attention(nn.Module):
         # GQA: group q heads over their kv head: [B,S,K,G,D] against [B,S,K,D].
         G = cfg.q_per_kv
         q = q.reshape(B, S, K, G, D)
-        scores = jnp.einsum(
-            "bskgd,btkd->bkgst", q, k, preferred_element_type=jnp.float32
-        ) / jnp.sqrt(D).astype(jnp.float32)
-        causal = jnp.tril(jnp.ones((S, S), dtype=bool))
-        scores = jnp.where(causal, scores, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+        if cfg.attn_impl == "ring":
+            if self.mesh is None:
+                raise ValueError(
+                    "attn_impl='ring' needs the mesh: Llama(cfg, mesh=mesh)"
+                )
+            from ..parallel.ring import ring_self_attention
+
+            out = ring_self_attention(q, k, v, positions, self.mesh)
+        else:
+            scores = jnp.einsum(
+                "bskgd,btkd->bkgst", q, k, preferred_element_type=jnp.float32
+            ) / jnp.sqrt(D).astype(jnp.float32)
+            causal = jnp.tril(jnp.ones((S, S), dtype=bool))
+            scores = jnp.where(causal, scores, jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
         out = out.reshape(B, S, H * D)
         out = nn.with_logical_constraint(out, ("batch", "seq", None))
 
@@ -189,12 +208,13 @@ class Block(nn.Module):
     """Pre-norm decoder block; carries (hidden, positions) through scan."""
 
     cfg: LlamaConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, carry, _):
         x, positions = carry
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
-        x = x + Attention(self.cfg, name="attn")(
+        x = x + Attention(self.cfg, self.mesh, name="attn")(
             RMSNorm(self.cfg.rms_eps, name="attn_norm")(x), positions
         )
         x = x + MLP(self.cfg, name="mlp")(
@@ -208,6 +228,7 @@ class Llama(nn.Module):
     """Decoder-only LM: tokens [B,S] int32 → logits [B,S,vocab]."""
 
     cfg: LlamaConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -239,7 +260,7 @@ class Llama(nn.Module):
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (x, _), _ = ScanBlocks(cfg, name="layers")((x, positions), None)
+        (x, _), _ = ScanBlocks(cfg, self.mesh, name="layers")((x, positions), None)
 
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
         logits = nn.DenseGeneral(

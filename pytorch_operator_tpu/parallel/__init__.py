@@ -27,4 +27,8 @@ from .logical import (  # noqa: F401
     logical_shardings,
     rules_for_mesh,
 )
+from .ring import (  # noqa: F401
+    ring_attention_shard,
+    ring_self_attention,
+)
 from . import collectives  # noqa: F401

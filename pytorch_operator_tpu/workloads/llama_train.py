@@ -55,6 +55,7 @@ def run(
     checkpoint_every: int = 0,
     max_steps: int | None = None,
     remat: bool | None = None,
+    attn_impl: str | None = None,
     log=print,
 ) -> dict:
     import jax
@@ -66,20 +67,25 @@ def run(
     from ..parallel import make_mesh, named_sharding
     from .trainer import init_sharded_train_state, make_lm_train_step, throughput_loop
 
-    cfg = getattr(llama_lib, CONFIGS[config])(
-        **({} if remat is None else {"remat": remat})
-    )
-    model = llama_lib.Llama(cfg)
+    over = {}
+    if remat is not None:
+        over["remat"] = remat
+    if attn_impl is not None:
+        over["attn_impl"] = attn_impl
+    cfg = getattr(llama_lib, CONFIGS[config])(**over)
 
     n_dev = jax.device_count()
     import os
 
     mesh = make_mesh(mesh_spec or os.environ.get("TPUJOB_MESH", "fsdp=-1"))
+    # The model only consults the mesh for sequence-parallel (ring) attention.
+    model = llama_lib.Llama(cfg, mesh=mesh)
     batch = max(batch_size // n_dev, 1) * n_dev if batch_size % n_dev else batch_size
     log(
         f"[llama] config={config} d_model={cfg.d_model} layers={cfg.n_layers} "
         f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} "
-        f"batch={batch} seq={seq_len} ({jax.devices()[0].platform})"
+        f"attn={cfg.attn_impl} batch={batch} seq={seq_len} "
+        f"({jax.devices()[0].platform})"
     )
 
     tx = optax.adamw(lr, weight_decay=0.1)
@@ -171,6 +177,10 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument("--remat", action="store_true")
+    p.add_argument(
+        "--attn-impl", choices=("dense", "ring"), default=None,
+        help="attention implementation (ring = sequence-parallel over sp)",
+    )
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -186,6 +196,7 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every,
         max_steps=args.max_steps,
         remat=True if args.remat else None,
+        attn_impl=args.attn_impl,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"
             if world.num_processes > 1

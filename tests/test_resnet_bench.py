@@ -79,4 +79,5 @@ class TestGraftEntry:
 
         g.dryrun_multichip(8)
         out = capsys.readouterr().out
-        assert "[dryrun] ok" in out and "dp=2,fsdp=2,tp=2" in out
+        assert "[dryrun] ok" in out and "dp=1,fsdp=2,sp=2,tp=2" in out
+        assert "attn=ring" in out
