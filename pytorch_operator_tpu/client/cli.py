@@ -152,6 +152,7 @@ def cmd_supervisor(args) -> int:
         while True:
             sup.store.rescan()
             sup.process_deletion_markers()
+            sup.process_scale_markers()
             sup.sync_once()
             sup.write_metrics_file()
             time.sleep(args.interval)
@@ -267,16 +268,41 @@ def cmd_delete(args) -> int:
     # Cross-process delete: leave a marker a running supervisor will act on
     # (it owns the replica processes); also remove the stored object so the
     # job disappears from get/describe immediately.
-    marker = state / "jobs" / (key.replace("/", "_") + ".delete")
     # The marker carries the purge request: a running supervisor purges
     # AFTER killing the replicas (else a live workload's next checkpoint
     # save would re-create the dir behind the purge). The immediate purge
     # below covers the daemon-less case (no replicas running).
-    marker.write_text("purge" if args.purge else "")
+    store.mark_deletion(key, purge=args.purge)
     store.delete(key)
     if args.purge:
         purge_job_artifacts(state, key)
     print(f"tpujob {key} deleted")
+    return 0
+
+
+def cmd_scale(args) -> int:
+    """Elastic resize: validate against the stored spec, then leave a marker
+    for the owning supervisor (it must re-rendezvous the live gang)."""
+    state = _state_dir(args)
+    key = _resolve_key(args)
+    store = JobStore(persist_dir=state / "jobs")
+    job = store.get(key)
+    if job is None:
+        print(f"error: tpujob {key} not found", file=sys.stderr)
+        return 1
+    ep = job.spec.elastic_policy
+    if ep is None:
+        print(f"error: tpujob {key} has no elastic_policy", file=sys.stderr)
+        return 2
+    if not (ep.min_replicas <= args.workers <= ep.max_replicas):
+        print(
+            f"error: workers={args.workers} outside "
+            f"[{ep.min_replicas}, {ep.max_replicas}]",
+            file=sys.stderr,
+        )
+        return 2
+    store.mark_scale(key, args.workers)
+    print(f"tpujob {key} scale to {args.workers} workers requested")
     return 0
 
 
@@ -341,6 +367,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     add_ns(sp)
     sp.set_defaults(func=cmd_delete)
+
+    sp = sub.add_parser("scale", help="elastic resize of a job's workers")
+    sp.add_argument("name")
+    sp.add_argument("--workers", type=int, required=True)
+    add_ns(sp)
+    sp.set_defaults(func=cmd_scale)
 
     sp = sub.add_parser("metrics", help="print supervisor metrics")
     sp.set_defaults(func=cmd_metrics)

@@ -120,6 +120,13 @@ class JobStore:
                     new_keys.append(key)
         return new_keys
 
+    def _marker_path(self, key: str, kind: str) -> Path:
+        return self.persist_dir / (key.replace("/", "_") + "." + kind)
+
+    def mark_deletion(self, key: str, purge: bool = False) -> None:
+        """Leave a cross-process deletion request for the owning supervisor."""
+        self._marker_path(key, "delete").write_text("purge" if purge else "")
+
     def deletion_markers(self) -> List[str]:
         """Keys with a pending cross-process deletion request."""
         if self.persist_dir is None:
@@ -142,7 +149,39 @@ class JobStore:
     def clear_deletion_marker(self, key: str) -> None:
         if self.persist_dir is None:
             return
-        (self.persist_dir / (key.replace("/", "_") + ".delete")).unlink(missing_ok=True)
+        self._marker_path(key, "delete").unlink(missing_ok=True)
+
+    def mark_scale(self, key: str, workers: int) -> None:
+        """Leave a cross-process elastic resize request."""
+        self._marker_path(key, "scale").write_text(str(workers))
+
+    def scale_markers(self) -> List[tuple]:
+        """Pending cross-process elastic resize requests: (key, workers)."""
+        if self.persist_dir is None:
+            return []
+        out = []
+        for p in self.persist_dir.glob("*.scale"):
+            try:
+                workers = int(p.read_text().strip())
+            except (OSError, ValueError):
+                continue
+            out.append((p.stem.replace("_", "/", 1), workers))
+        return out
+
+    def clear_scale_marker(self, key: str, if_value: Optional[int] = None) -> None:
+        """Clear a scale marker. With ``if_value``, clear only if the marker
+        still holds that value — a request written after the supervisor read
+        the marker (scale is not idempotent) must survive to the next poll."""
+        if self.persist_dir is None:
+            return
+        p = self._marker_path(key, "scale")
+        if if_value is not None:
+            try:
+                if int(p.read_text().strip()) != if_value:
+                    return
+            except (OSError, ValueError):
+                pass  # gone or unreadable — fall through to the unlink
+        p.unlink(missing_ok=True)
 
 
 # Artifact roots under the supervisor state dir that outlive the job object

@@ -27,6 +27,12 @@ from .logical import (  # noqa: F401
     logical_shardings,
     rules_for_mesh,
 )
+from .data import (  # noqa: F401
+    epoch_batches,
+    global_batch,
+    put_global,
+    shard_batch_size,
+)
 from .ring import (  # noqa: F401
     ring_attention_shard,
     ring_self_attention,

@@ -41,6 +41,24 @@ def global_batch(batch, mesh, axis: str = "dp"):
     return jax.make_array_from_process_local_data(sharding, local, batch.shape)
 
 
+def put_global(batch, sharding):
+    """Place a host array (identical on every process) onto an arbitrary
+    global sharding — works single- and multi-process.
+
+    ``jax.device_put`` alone cannot target shardings spanning other
+    processes' devices; ``make_array_from_callback`` lets each process
+    contribute exactly the shards its devices own, sliced from the full
+    host copy.
+    """
+    import jax
+    import numpy as np
+
+    batch = np.asarray(batch)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return jax.make_array_from_callback(batch.shape, sharding, lambda idx: batch[idx])
+
+
 def shard_batch_size(global_size: int, mesh, axis: str = "dp") -> int:
     """Validate a global batch size divides the dp extent; return per-device."""
     extent = mesh.shape[axis] if axis in mesh.axis_names else 1

@@ -51,7 +51,7 @@ def run(
     import optax
 
     from ..models import bert as bert_lib
-    from ..parallel import activation_rules, make_mesh, named_sharding
+    from ..parallel import activation_rules, make_mesh, named_sharding, put_global
     from .trainer import init_sharded_train_state, throughput_loop
 
     cfg = bert_lib.bert_base() if bert_base else bert_lib.bert_tiny()
@@ -101,8 +101,8 @@ def run(
             batch, seq_len, cfg.vocab_size, step, num_classes
         )
         return (
-            jax.device_put(toks, tok_sharding),
-            jax.device_put(labels, lbl_sharding),
+            put_global(toks, tok_sharding),
+            put_global(labels, lbl_sharding),
         )
 
     with mesh:

@@ -56,6 +56,7 @@ def run(
     max_steps: int | None = None,
     remat: bool | None = None,
     attn_impl: str | None = None,
+    preempt_at: int | None = None,
     log=print,
 ) -> dict:
     import jax
@@ -64,7 +65,7 @@ def run(
 
     from ..checkpoint import CheckpointManager, job_checkpoint_dir
     from ..models import llama as llama_lib
-    from ..parallel import make_mesh, named_sharding
+    from ..parallel import make_mesh, named_sharding, put_global
     from .trainer import init_sharded_train_state, make_lm_train_step, throughput_loop
 
     over = {}
@@ -99,8 +100,20 @@ def run(
     train_step = make_lm_train_step(model, tx, mesh)
     batch_sharding = named_sharding(mesh, "batch", "seq")
 
+    # Fault injection (SURVEY.md §5 "fault injection = kill a worker
+    # process in tests"): simulate a TPU preemption on the FIRST life of
+    # this replica by dying with a retryable code (138 = 128+SIGUSR1)
+    # mid-run; the supervisor's ExitCode policy gang-restarts and the
+    # restarted life resumes from checkpoint.
+    restart_count = int(os.environ.get("TPUJOB_RESTART_COUNT", "0"))
+
     def batches(step: int):
-        return jax.device_put(
+        if preempt_at is not None and restart_count == 0 and step >= preempt_at:
+            log(f"[llama] injected preemption at step {step} (exit 138)")
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(138)
+        return put_global(
             synthetic_bigram_batch(batch, seq_len, cfg.vocab_size, step),
             batch_sharding,
         )
@@ -181,6 +194,11 @@ def main(argv=None) -> int:
         "--attn-impl", choices=("dense", "ring"), default=None,
         help="attention implementation (ring = sequence-parallel over sp)",
     )
+    p.add_argument(
+        "--preempt-at", type=int, default=None,
+        help="fault injection: die with a retryable exit code at this step "
+        "on the replica's first life (simulated TPU preemption)",
+    )
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -197,6 +215,7 @@ def main(argv=None) -> int:
         max_steps=args.max_steps,
         remat=True if args.remat else None,
         attn_impl=args.attn_impl,
+        preempt_at=args.preempt_at,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"
             if world.num_processes > 1

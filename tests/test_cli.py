@@ -94,3 +94,35 @@ spec:
         assert run_cli("--state-dir", tmp_path / "s", "describe", "ghost") == 1
         assert run_cli("--state-dir", tmp_path / "s", "logs", "ghost") == 1
         assert run_cli("--state-dir", tmp_path / "s", "delete", "ghost") == 1
+        assert (
+            run_cli("--state-dir", tmp_path / "s", "scale", "ghost", "--workers", "2")
+            == 1
+        )
+
+    def test_scale_writes_marker_and_validates(self, tmp_path, capsys):
+        y = tmp_path / "e.yaml"
+        y.write_text(
+            """
+metadata: {name: el}
+spec:
+  replica_specs:
+    Master:
+      template: {module: pytorch_operator_tpu.workloads.noop}
+    Worker:
+      replicas: 1
+      template: {module: pytorch_operator_tpu.workloads.noop}
+  elastic_policy: {min_replicas: 1, max_replicas: 3}
+"""
+        )
+        state = tmp_path / "state"
+        assert run_cli("--state-dir", state, "submit", y) == 0
+        # out of bounds → rejected client-side
+        assert run_cli("--state-dir", state, "scale", "el", "--workers", "9") == 2
+        assert run_cli("--state-dir", state, "scale", "el", "--workers", "2") == 0
+        marker = state / "jobs" / "default_el.scale"
+        assert marker.read_text() == "2"
+
+    def test_scale_requires_elastic_policy(self, tmp_path, job_yaml, capsys):
+        state = tmp_path / "state"
+        assert run_cli("--state-dir", state, "submit", job_yaml) == 0
+        assert run_cli("--state-dir", state, "scale", "cli-job", "--workers", "2") == 2

@@ -1,0 +1,75 @@
+"""Elastic preemption-recovery end-to-end: REAL subprocess gang, real
+jax.distributed world, real orbax checkpoints, deterministic fault
+injection.
+
+This is the BASELINE.md "Elastic job: preemption → in-place restart" row:
+a Worker dies mid-training with a retryable exit code; the supervisor
+gang-restarts the world (elastic re-rendezvous) and the restarted gang
+RESUMES from the latest checkpoint rather than restarting from step 0.
+Reference analog: pod preemption → operator respawn → user script reloads
+its checkpoint (SURVEY.md §5 "Failure detection / elastic recovery").
+"""
+
+import pathlib
+
+from pytorch_operator_tpu.api import (
+    ElasticPolicy,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    Resources,
+    RestartPolicy,
+)
+from pytorch_operator_tpu.controller import Supervisor
+from tests.testutil import new_job
+
+LLAMA_ARGS = [
+    "--config", "tiny", "--seq-len", "32", "--batch-size", "4",
+    "--steps", "500", "--max-steps", "30", "--checkpoint-every", "3",
+    "--warmup", "1",
+]
+
+
+def _llama_template(extra_args=()):
+    return ProcessTemplate(
+        module="pytorch_operator_tpu.workloads.llama_train",
+        args=LLAMA_ARGS + list(extra_args),
+        resources=Resources(cpu_devices=1),
+    )
+
+
+def test_preemption_gang_restart_resumes_from_checkpoint(tmp_path):
+    sup = Supervisor(state_dir=tmp_path / "state", poll_interval=0.05)
+    job = new_job(
+        name="elastic-e2e",
+        workers=1,
+        restart_policy=RestartPolicy.EXIT_CODE,
+        backoff_limit=4,
+        elastic=ElasticPolicy(min_replicas=1, max_replicas=2, max_restarts=4),
+    )
+    job.spec.replica_specs[ReplicaType.MASTER].template = _llama_template()
+    # The Worker preempts itself at step 12 of its FIRST life (restart
+    # count 0): checkpoints exist at steps 3..12 by then, so the restarted
+    # gang must resume from step >= 9, not from 0.
+    job.spec.replica_specs[ReplicaType.WORKER] = ReplicaSpec(
+        replicas=1,
+        restart_policy=RestartPolicy.EXIT_CODE,
+        template=_llama_template(["--preempt-at", "12"]),
+    )
+    try:
+        done = sup.run(job, timeout=420)
+        assert done.is_succeeded(), [c.to_dict() for c in done.status.conditions]
+        assert done.status.restart_count == 1
+
+        logs = sorted((tmp_path / "state" / "logs").glob("*elastic-e2e*"))
+        text = "\n".join(p.read_text() for p in logs)
+        assert "injected preemption at step" in text
+        # The resumed life picked up a checkpoint at a nonzero step.
+        resumed = [
+            ln for ln in text.splitlines() if "resumed from checkpoint" in ln
+        ]
+        assert resumed, text[-2000:]
+        steps = [int(ln.rsplit("step", 1)[1]) for ln in resumed]
+        assert all(s >= 3 for s in steps), resumed
+    finally:
+        sup.shutdown()

@@ -196,6 +196,34 @@ class TestScale:
             sup.scale(key, 5)
         sup.shutdown()
 
+    def test_scale_marker_processed(self, tmp_path):
+        """Cross-process `tpujob scale` marker → supervisor resizes the job."""
+        sup = make_supervisor(tmp_path)
+        job = new_job(
+            name="el3",
+            workers=1,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=3, max_restarts=5),
+        )
+        key = sup.submit(job)
+        marker = sup.state_dir / "jobs" / (key.replace("/", "_") + ".scale")
+        marker.write_text("3")
+        sup.process_scale_markers()
+        assert not marker.exists()
+        assert sup.get(key).spec.replica_specs[ReplicaType.WORKER].replicas == 3
+        # invalid request: cleared and recorded, not raised
+        marker.write_text("9")
+        sup.process_scale_markers()
+        assert not marker.exists()
+        assert sup.get(key).spec.replica_specs[ReplicaType.WORKER].replicas == 3
+        # a request written AFTER the supervisor read the marker must
+        # survive the conditional clear (scale is not idempotent)
+        marker.write_text("2")
+        sup.store.clear_scale_marker(key, if_value=3)
+        assert marker.read_text() == "2"
+        sup.store.clear_scale_marker(key, if_value=2)
+        assert not marker.exists()
+        sup.shutdown()
+
     def test_scale_restarts_gang_with_new_world(self, tmp_path):
         sup = make_supervisor(tmp_path)
         job = new_job(
