@@ -125,6 +125,8 @@ class JobStore:
 
     def mark_deletion(self, key: str, purge: bool = False) -> None:
         """Leave a cross-process deletion request for the owning supervisor."""
+        if self.persist_dir is None:
+            return
         self._marker_path(key, "delete").write_text("purge" if purge else "")
 
     def deletion_markers(self) -> List[str]:
@@ -153,35 +155,35 @@ class JobStore:
 
     def mark_scale(self, key: str, workers: int) -> None:
         """Leave a cross-process elastic resize request."""
+        if self.persist_dir is None:
+            return
         self._marker_path(key, "scale").write_text(str(workers))
 
-    def scale_markers(self) -> List[tuple]:
-        """Pending cross-process elastic resize requests: (key, workers)."""
+    def take_scale_markers(self) -> List[tuple]:
+        """Atomically claim pending elastic resize requests: (key, workers).
+
+        Claim-by-rename: a request written concurrently with the claim lands
+        at the original marker path (a fresh file) and survives to the next
+        poll — scale is not idempotent, so losing one would silently leave
+        the job at the wrong size. The claimed file is consumed either way.
+        """
         if self.persist_dir is None:
             return []
         out = []
-        for p in self.persist_dir.glob("*.scale"):
+        for p in sorted(self.persist_dir.glob("*.scale")):
+            claimed = p.with_name(p.name + "-claimed")
             try:
-                workers = int(p.read_text().strip())
+                p.rename(claimed)
+            except OSError:
+                continue  # another supervisor claimed it first
+            try:
+                workers = int(claimed.read_text().strip())
             except (OSError, ValueError):
-                continue
-            out.append((p.stem.replace("_", "/", 1), workers))
+                workers = None
+            claimed.unlink(missing_ok=True)
+            if workers is not None:
+                out.append((p.stem.replace("_", "/", 1), workers))
         return out
-
-    def clear_scale_marker(self, key: str, if_value: Optional[int] = None) -> None:
-        """Clear a scale marker. With ``if_value``, clear only if the marker
-        still holds that value — a request written after the supervisor read
-        the marker (scale is not idempotent) must survive to the next poll."""
-        if self.persist_dir is None:
-            return
-        p = self._marker_path(key, "scale")
-        if if_value is not None:
-            try:
-                if int(p.read_text().strip()) != if_value:
-                    return
-            except (OSError, ValueError):
-                pass  # gone or unreadable — fall through to the unlink
-        p.unlink(missing_ok=True)
 
 
 # Artifact roots under the supervisor state dir that outlive the job object

@@ -216,14 +216,13 @@ class Supervisor:
 
     def process_scale_markers(self) -> None:
         """Act on cross-process ``tpujob scale`` requests (elastic resize)."""
-        for key, workers in self.store.scale_markers():
+        for key, workers in self.store.take_scale_markers():
             try:
                 self.scale(key, workers)
             except (KeyError, ValidationError) as e:
                 self.events.warning(
                     key, "TPUJobScaleRejected", f"scale to {workers} rejected: {e}"
                 )
-            self.store.clear_scale_marker(key, if_value=workers)
 
     def write_metrics_file(self) -> None:
         """Expose counters for ``tpujob metrics`` (monitoring-port analog)."""

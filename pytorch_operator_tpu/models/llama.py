@@ -47,8 +47,9 @@ class LlamaConfig:
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     remat: bool = False  # checkpoint each block (jax.checkpoint under scan)
-    # Attention implementation: "dense" (materialized S×S scores), "ring"
-    # (sequence-parallel ring attention over the mesh's ``sp`` axis —
+    # Attention implementation: "dense" (materialized S×S scores), "flash"
+    # (pallas blockwise kernel, O(S·D) HBM traffic — ops/flash_attention.py),
+    # "ring" (sequence-parallel ring attention over the mesh's ``sp`` axis —
     # parallel/ring.py; requires passing the mesh to the model).
     attn_impl: str = "dense"
 
@@ -157,6 +158,14 @@ class Attention(nn.Module):
             from ..parallel.ring import ring_self_attention
 
             out = ring_self_attention(q, k, v, positions, self.mesh)
+        elif cfg.attn_impl == "flash":
+            # Blockwise pallas kernel; assumes the standard causal layout
+            # (positions = arange), which Llama.__call__ defaults to.
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q.reshape(B, S, H, D), k, v, causal=True, mesh=self.mesh
+            ).reshape(B, S, K, G, D)
         else:
             scores = jnp.einsum(
                 "bskgd,btkd->bkgst", q, k, preferred_element_type=jnp.float32

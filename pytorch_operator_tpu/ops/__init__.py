@@ -1,0 +1,10 @@
+"""Hand-written TPU kernels (pallas) for the hot ops.
+
+The reference ships no kernels — its numerical layer is whatever PyTorch
+the user containers bring (SURVEY.md §2: "no C++/Rust/CUDA components in
+the reference"). The rebuild's compute path is JAX/XLA; these pallas
+kernels cover the few spots where fusing beyond XLA pays: attention's
+O(S^2) score materialization.
+"""
+
+from .flash_attention import flash_attention  # noqa: F401

@@ -191,8 +191,9 @@ def main(argv=None) -> int:
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument("--remat", action="store_true")
     p.add_argument(
-        "--attn-impl", choices=("dense", "ring"), default=None,
-        help="attention implementation (ring = sequence-parallel over sp)",
+        "--attn-impl", choices=("dense", "flash", "ring"), default=None,
+        help="attention implementation (flash = pallas blockwise kernel; "
+        "ring = sequence-parallel over sp)",
     )
     p.add_argument(
         "--preempt-at", type=int, default=None,

@@ -89,6 +89,39 @@ class TestLlama:
             b = jax.jit(remat_model.apply)(variables, tokens)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
+    def test_flash_matches_dense(self, setup):
+        """attn_impl='flash' (pallas kernel, sharded via shard_map over the
+        dp/fsdp/tp mesh) reproduces the dense path's logits and grads."""
+        cfg, model, tokens, mesh, variables = setup
+        flash_model = Llama(llama_tiny(attn_impl="flash"), mesh=mesh)
+        with mesh, activation_rules(mesh):
+            a = jax.jit(model.apply)(variables, tokens)
+            b = jax.jit(flash_model.apply)(variables, tokens)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+        def loss(m):
+            def f(params):
+                import optax
+
+                with activation_rules(mesh):
+                    logits = m.apply({"params": params}, tokens)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :-1], tokens[:, 1:]
+                ).mean()
+
+            return f
+
+        with mesh:
+            g_dense = jax.jit(jax.grad(loss(model)))(variables["params"])
+            g_flash = jax.jit(jax.grad(loss(flash_model)))(variables["params"])
+        for (path, gd), (_, gf) in zip(
+            jax.tree_util.tree_leaves_with_path(g_dense),
+            jax.tree_util.tree_leaves_with_path(g_flash),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(gd), np.asarray(gf), atol=5e-4, err_msg=str(path)
+            )
+
 
 class TestBert:
     def test_pad_mask_and_sharding(self, mesh):

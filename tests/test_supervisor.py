@@ -215,13 +215,12 @@ class TestScale:
         sup.process_scale_markers()
         assert not marker.exists()
         assert sup.get(key).spec.replica_specs[ReplicaType.WORKER].replicas == 3
-        # a request written AFTER the supervisor read the marker must
-        # survive the conditional clear (scale is not idempotent)
+        # claim-by-rename consumes the marker; a fresh request written at
+        # the marker path afterwards is a new file and is NOT lost
         marker.write_text("2")
-        sup.store.clear_scale_marker(key, if_value=3)
-        assert marker.read_text() == "2"
-        sup.store.clear_scale_marker(key, if_value=2)
+        assert sup.store.take_scale_markers() == [(key, 2)]
         assert not marker.exists()
+        assert sup.store.take_scale_markers() == []
         sup.shutdown()
 
     def test_scale_restarts_gang_with_new_world(self, tmp_path):
