@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import time
 
@@ -46,9 +47,10 @@ def build_train_state(model, mesh, *, lr: float, momentum: float, seed: int, ima
     )
 
 
-def make_train_step(model, tx, label_smoothing: float = 0.1):
+def _train_step_fn(model, tx, label_smoothing: float = 0.1):
+    """The pure (unjitted) train-step body, shared by the per-step and
+    chunked runners."""
     import jax
-    import jax.numpy as jnp
     import optax
 
     def loss_fn(params, batch_stats, bx, by):
@@ -64,7 +66,6 @@ def make_train_step(model, tx, label_smoothing: float = 0.1):
         loss = optax.softmax_cross_entropy(logits, labels).mean()
         return loss, updates["batch_stats"]
 
-    @jax.jit
     def train_step(params, batch_stats, opt_state, bx, by):
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, batch_stats, bx, by
@@ -74,6 +75,43 @@ def make_train_step(model, tx, label_smoothing: float = 0.1):
         return params, new_stats, opt_state, loss
 
     return train_step
+
+
+def make_train_step(model, tx, label_smoothing: float = 0.1):
+    import jax
+
+    return jax.jit(_train_step_fn(model, tx, label_smoothing))
+
+
+def make_train_chunk(model, tx, chunk: int, label_smoothing: float = 0.1):
+    """``chunk`` train steps fused into ONE dispatch via ``lax.fori_loop``,
+    with the train state donated.
+
+    Why: on a tunneled PJRT backend each dispatch costs ~9 ms of round-trip
+    latency (measured; BASELINE.md notes), which a per-step host loop pays
+    every step. One dispatch per chunk amortizes it to noise, and donation
+    lets XLA update params/opt-state in place instead of double-buffering
+    the whole train state in HBM.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    step = _train_step_fn(model, tx, label_smoothing)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_chunk(params, batch_stats, opt_state, bx, by):
+        def body(_, s):
+            params, batch_stats, opt_state, _loss = s
+            return step(params, batch_stats, opt_state, bx, by)
+
+        return jax.lax.fori_loop(
+            0, chunk, body,
+            (params, batch_stats, opt_state, jnp.zeros((), jnp.float32)),
+        )
+
+    return train_chunk
 
 
 def run_benchmark(
@@ -122,24 +160,37 @@ def run_benchmark(
     params, batch_stats, opt_state, tx = build_train_state(
         model, mesh, lr=lr, momentum=momentum, seed=0, image_size=image_size
     )
-    train_step = make_train_step(model, tx)
+    # Fuse steps into chunked dispatches (see make_train_chunk). One chunk
+    # size → one compile; timed steps round UP to a chunk multiple so a run
+    # never executes fewer steps than asked for.
+    chunk = min(10, max(steps, 1))
+    steps = math.ceil(max(steps, 1) / chunk) * chunk
+    warm_chunks = max(1, round(warmup / chunk))
+    train_chunk = make_train_chunk(model, tx, chunk)
     hx, hy = synthetic_images(batch, image_size, image_size, classes)
-    gx, gy = global_batch(hx, mesh), global_batch(hy, mesh)
+    # Feed bf16 pixels: the model's first op casts anyway, and a bf16 batch
+    # halves the per-step HBM read of the largest activation tensor.
+    import jax.numpy as jnp
+
+    gx, gy = global_batch(hx.astype(jnp.bfloat16), mesh), global_batch(hy, mesh)
 
     t_start = time.time()
-    for i in range(warmup):
-        params, batch_stats, opt_state, loss = train_step(
+    for i in range(warm_chunks):
+        params, batch_stats, opt_state, loss = train_chunk(
             params, batch_stats, opt_state, gx, gy
         )
         if i == 0:
             float(jax.device_get(loss))
             rendezvous.report_first_step(0)
-            log(f"[resnet] first step (compile) +{time.time() - t_start:.1f}s")
+            log(
+                f"[resnet] first chunk ({chunk} steps, compile) "
+                f"+{time.time() - t_start:.1f}s"
+            )
     float(jax.device_get(loss))
 
     t0 = time.time()
-    for _ in range(steps):
-        params, batch_stats, opt_state, loss = train_step(
+    for _ in range(steps // chunk):
+        params, batch_stats, opt_state, loss = train_chunk(
             params, batch_stats, opt_state, gx, gy
         )
     final_loss = float(jax.device_get(loss))
