@@ -145,10 +145,55 @@ def cmd_supervisor(args) -> int:
         state_dir=_state_dir(args),
         gang_enabled=not args.no_gang,
         max_slots=args.max_slots,
+        leader_elect=not args.no_leader_elect,
     )
-    print(f"tpujob supervisor: state dir {sup.state_dir}, "
-          f"gang={'on' if not args.no_gang else 'off'}")
+    # Monitoring comes up BEFORE the lease wait: a standby must answer
+    # /healthz while blocked (it reports is_leader=false), or liveness
+    # probes would kill the hot spare.
+    monitoring = None
+
+    def start_monitoring() -> bool:
+        nonlocal monitoring
+        from ..controller.monitoring import MonitoringServer, supervisor_health
+
+        monitoring = MonitoringServer(
+            render_metrics=sup.metrics.render_text,
+            health=lambda: supervisor_health(sup),
+            port=args.monitoring_port,
+        )
+        try:
+            print(f"tpujob supervisor: monitoring on 127.0.0.1:{monitoring.start()}")
+            return True
+        except OSError as e:
+            monitoring = None
+            print(
+                f"warning: cannot bind monitoring port {args.monitoring_port}: {e}",
+                file=sys.stderr,
+            )
+            return False
+
+    if args.monitoring_port is not None and not start_monitoring():
+        # A fixed port is typically held by the current leader on this
+        # host. A standby must still reach the lease wait (the hot-spare
+        # property), so only a non-HA daemon treats this as fatal.
+        if sup.lease is None:
+            sup.shutdown()
+            return 2
+        print("tpujob supervisor: will retry monitoring bind after lease", flush=True)
     try:
+        if sup.lease is not None and not sup.lease.acquire(blocking=False):
+            holder = sup.lease.holder()
+            print(
+                f"tpujob supervisor: standby — lease held by {holder}; waiting",
+                flush=True,
+            )
+            sup.lease.acquire()  # blocks until the leader exits or crashes
+            print("tpujob supervisor: acquired leader lease", flush=True)
+        if args.monitoring_port is not None and monitoring is None:
+            # The dead leader's exit freed its port; best effort rebind.
+            start_monitoring()
+        print(f"tpujob supervisor: state dir {sup.state_dir}, "
+              f"gang={'on' if not args.no_gang else 'off'}")
         while True:
             sup.store.rescan()
             sup.process_deletion_markers()
@@ -158,8 +203,11 @@ def cmd_supervisor(args) -> int:
             time.sleep(args.interval)
     except KeyboardInterrupt:
         print("supervisor: shutting down")
-        sup.shutdown()
         return 0
+    finally:
+        if monitoring is not None:
+            monitoring.stop()
+        sup.shutdown()
 
 
 def cmd_get(args) -> int:
@@ -340,6 +388,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--interval", type=float, default=0.2)
     sp.add_argument("--no-gang", action="store_true")
     sp.add_argument("--max-slots", type=int, default=None)
+    sp.add_argument(
+        "--monitoring-port",
+        type=int,
+        default=None,
+        help="serve /metrics and /healthz on this port (0 = auto)",
+    )
+    sp.add_argument(
+        "--no-leader-elect",
+        action="store_true",
+        help="skip the leader lease (single-daemon setups)",
+    )
     sp.set_defaults(func=cmd_supervisor)
 
     sp = sub.add_parser("get", help="list jobs")

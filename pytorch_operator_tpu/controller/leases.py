@@ -1,0 +1,106 @@
+"""Leader election for supervisors sharing one state dir.
+
+Reference: the operator runs ``leaderelection.RunOrDie`` so that replicated
+operator Deployments have exactly one active reconciler (SURVEY.md §2
+"Entrypoint/CLI", §3.1 startup stack). The failure mode it prevents maps
+1:1 here: two ``tpujob supervisor`` daemons pointed at the same state dir
+would both claim jobs and double-spawn replica worlds.
+
+Rebuild: an ``fcntl.flock`` lease on ``<state-dir>/leader.lock``. The OS
+releases the lock when the holder dies (crash included), which gives the
+standby automatic fail-over — the same property the k8s lease renewal loop
+provides, minus the clock-skew caveats, since this is a single-host lock.
+"""
+
+from __future__ import annotations
+
+import errno
+import fcntl
+import json
+import os
+import socket
+import time
+from pathlib import Path
+from typing import Optional
+
+
+class LeaderLease:
+    """An exclusive, crash-released lease on a state directory."""
+
+    def __init__(self, state_dir: Path, identity: Optional[str] = None):
+        self.path = Path(state_dir) / "leader.lock"
+        self.identity = identity or f"{socket.gethostname()}_{os.getpid()}"
+        self._fd: Optional[int] = None
+
+    def acquire(self, blocking: bool = True, timeout: Optional[float] = None) -> bool:
+        """Take the lease. Returns False iff non-blocking/timed-out and held
+        elsewhere. Re-acquiring a held lease is a no-op returning True."""
+        if self._fd is not None:
+            return True
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            try:
+                flags = fcntl.LOCK_EX
+                if not blocking or deadline is not None:
+                    flags |= fcntl.LOCK_NB
+                fcntl.flock(fd, flags)
+                break
+            except OSError as e:
+                if e.errno not in (errno.EWOULDBLOCK, errno.EAGAIN):
+                    # Not contention — e.g. flock unsupported on this fs.
+                    os.close(fd)
+                    raise
+                if not blocking or (deadline is not None and time.time() >= deadline):
+                    os.close(fd)
+                    return False
+                time.sleep(0.05)
+        # Record the holder for observability (healthz, error messages).
+        os.ftruncate(fd, 0)
+        os.pwrite(
+            fd,
+            json.dumps(
+                {"holder": self.identity, "pid": os.getpid(), "acquired": time.time()}
+            ).encode(),
+            0,
+        )
+        self._fd = fd
+        return True
+
+    def release(self) -> None:
+        if self._fd is None:
+            return
+        fcntl.flock(self._fd, fcntl.LOCK_UN)
+        os.close(self._fd)
+        self._fd = None
+
+    def is_held(self) -> bool:
+        return self._fd is not None
+
+    def holder(self) -> Optional[str]:
+        """Best-effort identity of the current holder (None if unheld)."""
+        if self._fd is not None:
+            return self.identity
+        if not self.path.exists():
+            return None
+        probe = os.open(self.path, os.O_RDWR)
+        try:
+            fcntl.flock(probe, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            # We got the lock, so nobody holds the lease.
+            fcntl.flock(probe, fcntl.LOCK_UN)
+            return None
+        except OSError:
+            try:
+                return json.loads(self.path.read_text() or "{}").get("holder")
+            except ValueError:
+                return "<unknown>"
+        finally:
+            os.close(probe)
+
+    def __enter__(self) -> "LeaderLease":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()

@@ -1,0 +1,112 @@
+"""Monitoring HTTP endpoint for the supervisor daemon.
+
+Reference: the operator serves Prometheus counters over HTTP on
+``--monitoring-port`` (SURVEY.md §2 "Metrics", §2 "Entrypoint/CLI"; upstream
+wires promhttp into the server started by ``app.Run``). Rebuild: a stdlib
+``ThreadingHTTPServer`` on a daemon thread serving
+
+- ``GET /metrics``  — Prometheus text exposition of the supervisor's
+  :class:`~pytorch_operator_tpu.controller.metrics.MetricsRegistry`;
+- ``GET /healthz``  — JSON liveness document (job phase counts, leader
+  identity when leader election is on) — the health/readiness probe the
+  reference's Deployment manifest points at.
+
+The server binds loopback by default and is off unless ``--monitoring-port``
+is passed (a fixed well-known default would collide across the many
+supervisors the test suite spins up; port 0 picks a free port).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+
+class MonitoringServer:
+    """Serves /metrics and /healthz for one supervisor.
+
+    ``render_metrics`` returns the Prometheus text body; ``health`` returns a
+    JSON-serializable dict. Both are called per request on the server thread,
+    so they must be thread-safe (MetricsRegistry counters are locked; the
+    health callback reads the job store which is lock-guarded).
+    """
+
+    def __init__(
+        self,
+        render_metrics: Callable[[], str],
+        health: Callable[[], Dict],
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        self._render_metrics = render_metrics
+        self._health = health
+        self._host = host
+        self._requested_port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        """The bound port (resolves port 0 → the kernel-assigned port)."""
+        if self._httpd is None:
+            raise RuntimeError("monitoring server not started")
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # keep the daemon's stdout clean
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = outer._render_metrics().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/healthz":
+                    body = json.dumps(outer._health()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tpujob-monitoring", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def supervisor_health(supervisor) -> Dict:
+    """The /healthz document: live job phase counts + identity."""
+    phases: Dict[str, int] = {}
+    for job in supervisor.list_jobs():
+        phase = "Succeeded" if job.is_succeeded() else (
+            "Failed" if job.is_failed() else "Active"
+        )
+        phases[phase] = phases.get(phase, 0) + 1
+    doc = {"status": "ok", "jobs": phases}
+    lease = getattr(supervisor, "lease", None)
+    if lease is not None:
+        doc["leader"] = lease.holder()  # the actual holder, not necessarily us
+        doc["is_leader"] = lease.is_held()
+    return doc

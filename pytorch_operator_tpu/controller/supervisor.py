@@ -21,6 +21,7 @@ from ..api.validation import ValidationError, validate
 from .events import EventRecorder
 from .expectations import ControllerExpectations
 from .gang import GangScheduler
+from .leases import LeaderLease
 from .metrics import MetricsRegistry
 from .reconciler import Reconciler
 from .runner import ProcessRunner, SubprocessRunner
@@ -46,9 +47,14 @@ class Supervisor:
         max_slots: Optional[int] = None,
         poll_interval: float = 0.1,
         persist: bool = True,
+        leader_elect: bool = False,
     ):
         self.state_dir = Path(state_dir) if state_dir is not None else default_state_dir()
         self.state_dir.mkdir(parents=True, exist_ok=True)
+        # Leader election (reference: leaderelection.RunOrDie, SURVEY.md §3.1).
+        # The lease is created here but acquired by the daemon entrypoint, so
+        # library users (tests, foreground run) aren't serialized by default.
+        self.lease = LeaderLease(self.state_dir) if leader_elect else None
         self.poll_interval = poll_interval
         self.store = JobStore(
             persist_dir=self.state_dir / "jobs" if persist else None
@@ -231,6 +237,8 @@ class Supervisor:
     def shutdown(self) -> None:
         if isinstance(self.runner, SubprocessRunner):
             self.runner.shutdown()
+        if self.lease is not None:
+            self.lease.release()
 
 
 def schedule_to_first_step_latency(job: TPUJob) -> Optional[float]:

@@ -1,0 +1,144 @@
+"""Monitoring endpoint + leader election tests.
+
+Reference analogs: promhttp on ``--monitoring-port`` and
+``leaderelection.RunOrDie`` (SURVEY.md §2 "Metrics", "Entrypoint/CLI").
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+from pytorch_operator_tpu.controller.leases import LeaderLease
+from pytorch_operator_tpu.controller.monitoring import (
+    MonitoringServer,
+    supervisor_health,
+)
+from pytorch_operator_tpu.controller.supervisor import Supervisor
+
+from tests.testutil import new_job
+
+
+def _get(port: int, path: str):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read().decode()
+
+
+class TestMonitoringServer:
+    def test_serves_metrics_and_healthz(self, tmp_path):
+        sup = Supervisor(state_dir=tmp_path, persist=False)
+        srv = MonitoringServer(
+            render_metrics=sup.metrics.render_text,
+            health=lambda: supervisor_health(sup),
+            port=0,
+        )
+        port = srv.start()
+        try:
+            sup.run(new_job(name="mon-ok", workers=0), timeout=60)
+
+            status, ctype, body = _get(port, "/metrics")
+            assert status == 200
+            assert ctype.startswith("text/plain")
+            assert "tpujob_jobs_created_total 1" in body
+            assert "tpujob_jobs_succeeded_total 1" in body
+
+            status, ctype, body = _get(port, "/healthz")
+            assert status == 200
+            assert ctype == "application/json"
+            doc = json.loads(body)
+            assert doc["status"] == "ok"
+            assert doc["jobs"] == {"Succeeded": 1}
+            # No lease configured → no leader fields.
+            assert "leader" not in doc
+        finally:
+            srv.stop()
+            sup.shutdown()
+
+    def test_unknown_path_404(self, tmp_path):
+        sup = Supervisor(state_dir=tmp_path, persist=False)
+        srv = MonitoringServer(
+            render_metrics=sup.metrics.render_text,
+            health=lambda: supervisor_health(sup),
+        )
+        port = srv.start()
+        try:
+            try:
+                _get(port, "/nope")
+                raise AssertionError("expected HTTP 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.stop()
+            sup.shutdown()
+
+    def test_healthz_reports_leader(self, tmp_path):
+        sup = Supervisor(state_dir=tmp_path, persist=False, leader_elect=True)
+        assert sup.lease.acquire(blocking=False)
+        doc = supervisor_health(sup)
+        assert doc["is_leader"] is True
+        assert doc["leader"] == sup.lease.identity
+        sup.shutdown()
+
+
+class TestLeaderLease:
+    def test_exclusive_between_fds(self, tmp_path):
+        a = LeaderLease(tmp_path, identity="a")
+        b = LeaderLease(tmp_path, identity="b")
+        assert a.acquire(blocking=False)
+        # flock locks attach to the open file description, so a second
+        # open() conflicts even within one process.
+        assert not b.acquire(blocking=False)
+        assert b.holder() == "a"
+        a.release()
+        assert b.acquire(blocking=False)
+        assert a.holder() == "b"
+        b.release()
+        assert a.holder() is None
+
+    def test_reacquire_is_noop(self, tmp_path):
+        a = LeaderLease(tmp_path, identity="a")
+        assert a.acquire()
+        assert a.acquire(blocking=False)
+        a.release()
+
+    def test_blocking_acquire_times_out(self, tmp_path):
+        a = LeaderLease(tmp_path, identity="a")
+        b = LeaderLease(tmp_path, identity="b")
+        a.acquire()
+        t0 = time.time()
+        assert not b.acquire(timeout=0.3)
+        assert time.time() - t0 >= 0.3
+        a.release()
+
+    def test_crash_releases_lease(self, tmp_path):
+        """OS-level release on holder death — the fail-over property."""
+        repo_root = str(Path(__file__).resolve().parents[1])
+        holder = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "import sys, time; sys.path.insert(0, %r); "
+                "from pytorch_operator_tpu.controller.leases import LeaderLease; "
+                "l = LeaderLease(%r, identity='crashy'); l.acquire(); "
+                "print('held', flush=True); time.sleep(60)"
+                % (repo_root, str(tmp_path)),
+            ],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            assert holder.stdout.readline().strip() == "held"
+            standby = LeaderLease(tmp_path, identity="standby")
+            assert not standby.acquire(blocking=False)
+            holder.kill()
+            holder.wait(timeout=10)
+            assert standby.acquire(timeout=5)
+            standby.release()
+        finally:
+            if holder.poll() is None:
+                holder.kill()
