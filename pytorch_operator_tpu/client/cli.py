@@ -37,6 +37,7 @@ from ..controller.store import JobStore, job_key, purge_job_artifacts
 from ..controller.supervisor import (
     Supervisor,
     default_state_dir,
+    job_timeline,
     schedule_to_first_step_latency,
 )
 
@@ -254,6 +255,11 @@ def cmd_describe(args) -> int:
     lat = schedule_to_first_step_latency(job)
     if lat is not None:
         print(f"Schedule-to-first-step: {lat:.3f}s")
+    spans = job_timeline(job)
+    if spans:
+        print("Timeline:")
+        for name, seconds in spans:
+            print(f"  {name:<28} {seconds:.3f}s")
     print("Replicas:")
     for rtype, rs in job.spec.replica_specs.items():
         status = job.status.replica_statuses.get(rtype)

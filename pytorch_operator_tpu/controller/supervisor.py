@@ -247,3 +247,21 @@ def schedule_to_first_step_latency(job: TPUJob) -> Optional[float]:
     if job.status.submit_time is None or job.status.first_step_time is None:
         return None
     return job.status.first_step_time - job.status.submit_time
+
+
+def job_timeline(job: TPUJob):
+    """Lifecycle spans for ``tpujob describe`` (SURVEY.md §5 tracing:
+    supervisor timing spans). Derived from status timestamps, so it costs
+    nothing to record: submit → gang launch → first step → finish."""
+    s = job.status
+    spans = []
+
+    def span(name, t0, t1):
+        if t0 is not None and t1 is not None and t1 >= t0:
+            spans.append((name, t1 - t0))
+
+    span("submit -> replicas launched", s.submit_time, s.start_time)
+    span("launch -> first step", s.start_time, s.first_step_time)
+    span("first step -> finished", s.first_step_time, s.completion_time)
+    span("total (submit -> finished)", s.submit_time, s.completion_time)
+    return spans

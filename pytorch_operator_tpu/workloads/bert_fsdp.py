@@ -44,6 +44,7 @@ def run(
     warmup: int = 2,
     lr: float = 1e-4,
     num_classes: int = 2,
+    profile_dir: str | None = None,
     log=print,
 ) -> dict:
     import jax
@@ -107,7 +108,7 @@ def run(
 
     with mesh:
         state, (final_loss, final_acc), steps_per_sec, end_step = _loop(
-            train_step, state, batches, steps, warmup, log
+            train_step, state, batches, steps, warmup, log, profile_dir
         )
 
     seqs_per_sec = steps_per_sec * batch
@@ -135,7 +136,7 @@ def run(
     }
 
 
-def _loop(train_step, state, batches, steps, warmup, log):
+def _loop(train_step, state, batches, steps, warmup, log, profile_dir=None):
     """throughput_loop variant for (loss, acc) tuples."""
     import jax
 
@@ -155,6 +156,7 @@ def _loop(train_step, state, batches, steps, warmup, log):
         device_get=jax.device_get,
         on_first_step=lambda: rendezvous.report_first_step(0),
         log=lambda m: log(f"[bert] {m}"),
+        profile_dir=profile_dir,
     )
     loss, acc = jax.device_get(wrapped_step.last)
     return state, (loss, acc), steps_per_sec, end_step
@@ -169,6 +171,10 @@ def main(argv=None) -> int:
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="write a jax.profiler trace of the timed window here",
+    )
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -181,6 +187,7 @@ def main(argv=None) -> int:
         steps=args.steps,
         warmup=args.warmup,
         lr=args.lr,
+        profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"
             if world.num_processes > 1

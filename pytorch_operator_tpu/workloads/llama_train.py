@@ -57,6 +57,7 @@ def run(
     remat: bool | None = None,
     attn_impl: str | None = None,
     preempt_at: int | None = None,
+    profile_dir: str | None = None,
     log=print,
 ) -> dict:
     import jax
@@ -148,6 +149,7 @@ def run(
             save=(lambda s, st: mgr.save(s, st)) if mgr is not None else None,
             start_step=start_step,
             log=lambda m: log(f"[llama] {m}"),
+            profile_dir=profile_dir,
         )
     if mgr is not None:
         if mgr.latest_step() != end_step:
@@ -200,6 +202,10 @@ def main(argv=None) -> int:
         help="fault injection: die with a retryable exit code at this step "
         "on the replica's first life (simulated TPU preemption)",
     )
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="write a jax.profiler trace of the timed window here",
+    )
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -217,6 +223,7 @@ def main(argv=None) -> int:
         remat=True if args.remat else None,
         attn_impl=args.attn_impl,
         preempt_at=args.preempt_at,
+        profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"
             if world.num_processes > 1

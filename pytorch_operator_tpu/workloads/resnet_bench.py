@@ -124,6 +124,7 @@ def run_benchmark(
     warmup: int = 5,
     lr: float = 0.1,
     momentum: float = 0.9,
+    profile_dir: str | None = None,
     log=print,
 ) -> dict:
     """The ONE benchmark harness (bench.py and the workload both use it).
@@ -188,13 +189,17 @@ def run_benchmark(
             )
     float(jax.device_get(loss))
 
-    t0 = time.time()
-    for _ in range(steps // chunk):
-        params, batch_stats, opt_state, loss = train_chunk(
-            params, batch_stats, opt_state, gx, gy
-        )
-    final_loss = float(jax.device_get(loss))
-    dt = time.time() - t0
+    from .trainer import maybe_profile
+
+    with maybe_profile(profile_dir, lambda m: log(f"[resnet] {m}")):
+        t0 = time.time()
+        for _ in range(steps // chunk):
+            params, batch_stats, opt_state, loss = train_chunk(
+                params, batch_stats, opt_state, gx, gy
+            )
+        final_loss = float(jax.device_get(loss))
+        # dt is taken here, before stop_trace() flushes the trace to disk.
+        dt = time.time() - t0
 
     images_per_sec = batch * steps / dt
     per_chip = images_per_sec / n_dev
@@ -229,6 +234,10 @@ def main(argv=None) -> int:
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--depth", type=int, default=50, choices=[18, 34, 50, 101, 152])
     p.add_argument("--classes", type=int, default=1000)
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="write a jax.profiler trace of the timed window here",
+    )
     p.add_argument("--json", action="store_true", help="print a JSON result line")
     args = p.parse_args(argv)
 
@@ -242,6 +251,7 @@ def main(argv=None) -> int:
         warmup=args.warmup,
         lr=args.lr,
         momentum=args.momentum,
+        profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"
             if world.num_processes > 1

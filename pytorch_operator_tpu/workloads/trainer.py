@@ -14,9 +14,29 @@ the fsdp-axis design BASELINE.json:9 asks for.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from typing import Any, Callable, Optional
+
+
+@contextlib.contextmanager
+def maybe_profile(profile_dir: Optional[str], log=print):
+    """Wrap a block in a ``jax.profiler`` trace when ``profile_dir`` is set
+    (SURVEY.md §5 tracing: workload-side profiling is jax.profiler's job).
+    Callers must take timing measurements INSIDE the block — stop_trace()
+    serializes the trace to disk and would otherwise pollute them."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(profile_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log(f"profile trace written to {profile_dir}")
 
 
 def init_sharded_train_state(model_init: Callable, tx, mesh):
@@ -72,6 +92,7 @@ def throughput_loop(
     save: Optional[Callable[[int, Any], None]] = None,
     start_step: int = 0,
     log=print,
+    profile_dir: Optional[str] = None,
 ):
     """Run warmup + timed steps; returns (state, final_loss, steps_per_sec,
     end_step).
@@ -80,6 +101,9 @@ def throughput_loop(
     under-synchronizes on tunneled PJRT backends — BASELINE.md notes).
     Checkpoint-save time is excluded from the throughput window (the
     BASELINE.md synthetic-benchmark methodology isolates compute).
+    ``profile_dir`` wraps the timed window in a ``jax.profiler`` trace
+    (SURVEY.md §5 tracing: workload-side profiling is jax.profiler's job),
+    viewable with tensorboard/xprof.
     """
     step = start_step
     t0 = time.time()
@@ -93,16 +117,18 @@ def throughput_loop(
             log(f"first step (compile) +{time.time() - t0:.1f}s")
     device_get(loss)
 
-    t0 = time.time()
     t_saving = 0.0
-    for _ in range(steps):
-        state, loss = train_step(state, batches(step))
-        step += 1
-        if checkpoint_every and save is not None and step % checkpoint_every == 0:
-            device_get(loss)  # fence before leaving the hot loop
-            t_save = time.time()
-            save(step, state)
-            t_saving += time.time() - t_save
-    final_loss = float(device_get(loss))
-    dt = time.time() - t0 - t_saving
+    with maybe_profile(profile_dir, log):
+        t0 = time.time()
+        for _ in range(steps):
+            state, loss = train_step(state, batches(step))
+            step += 1
+            if checkpoint_every and save is not None and step % checkpoint_every == 0:
+                device_get(loss)  # fence before leaving the hot loop
+                t_save = time.time()
+                save(step, state)
+                t_saving += time.time() - t_save
+        final_loss = float(device_get(loss))
+        # dt is taken here, before stop_trace() flushes the trace to disk.
+        dt = time.time() - t0 - t_saving
     return state, final_loss, steps / dt, step

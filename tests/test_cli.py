@@ -46,6 +46,8 @@ class TestCLI:
         assert rc == 0
         assert "TPUJobCreated" in out  # events section
         assert "Master: desired=1" in out
+        assert "Timeline:" in out  # lifecycle spans (SURVEY.md §5 tracing)
+        assert "total (submit -> finished)" in out
 
         rc = run_cli("--state-dir", state, "logs", "cli-job")
         out = capsys.readouterr().out

@@ -64,6 +64,52 @@ class TestBench:
         assert result["unit"] == "images/sec/chip"
 
 
+class TestProfileTrace:
+    def test_profile_dir_writes_trace(self, tmp_path):
+        from pytorch_operator_tpu.workloads.resnet_bench import run_benchmark
+
+        prof = tmp_path / "trace"
+        run_benchmark(
+            depth=18,
+            batch_size=8,
+            image_size=32,
+            classes=10,
+            steps=2,
+            warmup=1,
+            profile_dir=str(prof),
+            log=lambda *_: None,
+        )
+        # jax.profiler writes <dir>/plugins/profile/<ts>/*.xplane.pb
+        assert list(prof.rglob("*.xplane.pb")), "no profiler trace written"
+
+
+class TestTimeline:
+    def test_job_timeline_spans(self):
+        from pytorch_operator_tpu.api.types import TPUJob
+        from pytorch_operator_tpu.controller.supervisor import job_timeline
+
+        job = TPUJob.from_dict({"metadata": {"name": "t"}})
+        job.status.submit_time = 100.0
+        job.status.start_time = 101.0
+        job.status.first_step_time = 105.0
+        job.status.completion_time = 110.0
+        spans = dict(job_timeline(job))
+        assert spans["submit -> replicas launched"] == pytest.approx(1.0)
+        assert spans["launch -> first step"] == pytest.approx(4.0)
+        assert spans["first step -> finished"] == pytest.approx(5.0)
+        assert spans["total (submit -> finished)"] == pytest.approx(10.0)
+
+    def test_job_timeline_partial(self):
+        from pytorch_operator_tpu.api.types import TPUJob
+        from pytorch_operator_tpu.controller.supervisor import job_timeline
+
+        job = TPUJob.from_dict({"metadata": {"name": "t"}})
+        assert job_timeline(job) == []
+        job.status.submit_time = 1.0
+        job.status.start_time = 2.0
+        assert [n for n, _ in job_timeline(job)] == ["submit -> replicas launched"]
+
+
 class TestGraftEntry:
     def test_entry_traces(self):
         import jax
