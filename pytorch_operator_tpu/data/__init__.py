@@ -1,0 +1,23 @@
+"""File-backed dataset layer with a native prefetching loader.
+
+Reference analog: the operator repo itself has no input pipeline — examples
+lean on torch's DataLoader, whose prefetch workers are PyTorch's native C++
+layer inside the user container (SURVEY.md §2, component-inventory preamble).
+This package is the TPU-native equivalent: a packed record file format
+(:mod:`array_file`) plus a C++ background-prefetch loader
+(:mod:`native_loader`, ``native/loader.cc``) that keeps host-side gather off
+the training loop's critical path.
+"""
+
+from .array_file import ArrayFileMeta, pack_arrays, read_meta
+from .native_loader import LoaderUnavailable, NativeLoader, PyLoader, open_loader
+
+__all__ = [
+    "ArrayFileMeta",
+    "pack_arrays",
+    "read_meta",
+    "LoaderUnavailable",
+    "NativeLoader",
+    "PyLoader",
+    "open_loader",
+]

@@ -1,0 +1,130 @@
+"""Packed fixed-record array files (the native loader's on-disk format).
+
+One file = N records; one record = the concatenated bytes of one example
+across all fields (e.g. image then label). Fixed record size is what lets
+the C++ loader mmap + random-gather without any per-record framing, and a
+JSON sidecar (``<file>.meta.json``) carries shapes/dtypes so Python can
+reconstruct typed arrays from raw slot bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class FieldMeta:
+    name: str
+    shape: Tuple[int, ...]  # per-record shape (no leading N)
+    dtype: str
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape, dtype=np.int64)) * np.dtype(self.dtype).itemsize
+
+
+@dataclasses.dataclass
+class ArrayFileMeta:
+    n_records: int
+    fields: List[FieldMeta]
+
+    @property
+    def record_bytes(self) -> int:
+        return sum(f.nbytes for f in self.fields)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "n_records": self.n_records,
+                "fields": [
+                    {"name": f.name, "shape": list(f.shape), "dtype": f.dtype}
+                    for f in self.fields
+                ],
+            }
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "ArrayFileMeta":
+        d = json.loads(text)
+        return cls(
+            n_records=int(d["n_records"]),
+            fields=[
+                FieldMeta(f["name"], tuple(int(s) for s in f["shape"]), f["dtype"])
+                for f in d["fields"]
+            ],
+        )
+
+
+def meta_path(path) -> Path:
+    return Path(str(path) + ".meta.json")
+
+
+def pack_arrays(path, arrays: Dict[str, np.ndarray]) -> ArrayFileMeta:
+    """Write per-example arrays (each shaped ``(N, ...)``) as one record file.
+
+    Field order follows dict insertion order and is part of the format.
+    """
+    items = list(arrays.items())
+    if not items:
+        raise ValueError("pack_arrays: no arrays given")
+    n = items[0][1].shape[0]
+    for name, a in items:
+        if a.shape[0] != n:
+            raise ValueError(
+                f"pack_arrays: field {name!r} has {a.shape[0]} records, expected {n}"
+            )
+    meta = ArrayFileMeta(
+        n_records=n,
+        fields=[FieldMeta(name, tuple(a.shape[1:]), str(a.dtype)) for name, a in items],
+    )
+    path = Path(path)
+    with open(path, "wb") as f:
+        for i in range(n):
+            for _, a in items:
+                f.write(np.ascontiguousarray(a[i]).tobytes())
+    meta_path(path).write_text(meta.to_json())
+    return meta
+
+
+def read_meta(path) -> ArrayFileMeta:
+    mp = meta_path(path)
+    if not mp.exists():
+        raise FileNotFoundError(f"no sidecar {mp} for array file {path}")
+    return ArrayFileMeta.from_json(mp.read_text())
+
+
+def split_batch(
+    meta: ArrayFileMeta, raw: np.ndarray, batch: int
+) -> Dict[str, np.ndarray]:
+    """Split a record-interleaved ``(batch * record_bytes,)`` uint8 buffer
+    into typed per-field arrays shaped ``(batch, *field.shape)``. Copies
+    per field when records have more than one field (de-interleave)."""
+    rb = meta.record_bytes
+    recs = raw.reshape(batch, rb)
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for f in meta.fields:
+        chunk = recs[:, off : off + f.nbytes]
+        out[f.name] = np.ascontiguousarray(chunk).view(f.dtype).reshape((batch,) + f.shape)
+        off += f.nbytes
+    return out
+
+
+def split_planar(
+    meta: ArrayFileMeta, raw: np.ndarray, batch: int
+) -> Dict[str, np.ndarray]:
+    """Split a planar (field-blocked) slot buffer — the native loader's
+    output layout — into typed per-field arrays. Pure zero-copy views, so
+    the consumer thread does no byte shuffling at all."""
+    out: Dict[str, np.ndarray] = {}
+    off = 0
+    for f in meta.fields:
+        block = raw[off : off + batch * f.nbytes]
+        out[f.name] = block.view(f.dtype).reshape((batch,) + f.shape)
+        off += batch * f.nbytes
+    return out

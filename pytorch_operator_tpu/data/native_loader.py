@@ -1,0 +1,252 @@
+"""ctypes binding for the C++ prefetching loader (``native/loader.cc``),
+with a pure-Python fallback.
+
+Usage::
+
+    with open_loader(path, batch=128, shuffle=True, seed=0) as ld:
+        for step in range(steps):
+            epoch, index, fields = ld.next_batch()   # dict of np arrays
+            train_step(state, fields["x"], fields["y"])
+
+``next_batch`` returns arrays that are OWNED BY THE LOADER only until the
+next ``next_batch``/``close`` for the native path (the slot is released on
+the next call); callers that stash batches must copy. jax.device_put /
+jnp.asarray during the borrow is the intended consumption pattern.
+
+The native library is auto-built with ``make -C native`` on first use (g++,
+no deps — Environment: native toolchain is baked in; pybind11 is not, hence
+ctypes). If the toolchain is missing, :func:`open_loader` silently falls
+back to :class:`PyLoader`, which has identical semantics but does the gather
+on the calling thread (and a different — equally deterministic — shuffle
+order, as it uses numpy's RNG rather than splitmix64).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .array_file import ArrayFileMeta, read_meta, split_batch, split_planar
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "libtpujob_loader.so"
+
+
+class LoaderUnavailable(RuntimeError):
+    pass
+
+
+_lib = None
+
+
+def _load_lib() -> ctypes.CDLL:
+    """Load (building if stale/missing) the native library. Raises
+    LoaderUnavailable when it can't be built here."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    src = _NATIVE_DIR / "loader.cc"
+    if not src.exists():
+        raise LoaderUnavailable(f"native source missing: {src}")
+    if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < src.stat().st_mtime:
+        try:
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            raise LoaderUnavailable(f"cannot build native loader: {detail}") from e
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    lib.tpujob_loader_open.restype = ctypes.c_void_p
+    lib.tpujob_loader_open.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+    ]
+    lib.tpujob_loader_acquire.restype = ctypes.c_void_p
+    lib.tpujob_loader_acquire.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.tpujob_loader_release.restype = None
+    lib.tpujob_loader_release.argtypes = [ctypes.c_void_p]
+    lib.tpujob_loader_batches_per_epoch.restype = ctypes.c_uint64
+    lib.tpujob_loader_batches_per_epoch.argtypes = [ctypes.c_void_p]
+    lib.tpujob_loader_close.restype = None
+    lib.tpujob_loader_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class NativeLoader:
+    """Background-prefetching batch loader over a packed array file."""
+
+    def __init__(
+        self,
+        path,
+        batch: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        prefetch: int = 4,
+        meta: Optional[ArrayFileMeta] = None,
+    ):
+        self.meta = meta or read_meta(path)
+        self.batch = batch
+        lib = _load_lib()
+        self._lib = lib
+        field_sizes = (ctypes.c_uint64 * len(self.meta.fields))(
+            *[f.nbytes for f in self.meta.fields]
+        )
+        self._handle = lib.tpujob_loader_open(
+            str(path).encode(),
+            self.meta.record_bytes,
+            self.meta.n_records,
+            batch,
+            prefetch,
+            seed,
+            1 if shuffle else 0,
+            field_sizes,
+            len(self.meta.fields),
+        )
+        if not self._handle:
+            raise LoaderUnavailable(
+                f"tpujob_loader_open failed for {path} "
+                f"(record_bytes={self.meta.record_bytes}, "
+                f"n_records={self.meta.n_records}, batch={batch} — is the file "
+                f"at least record_bytes*n_records long and batch <= n_records?)"
+            )
+        self._borrowed = False
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return int(self._lib.tpujob_loader_batches_per_epoch(self._handle))
+
+    def next_batch(self) -> Tuple[int, int, Dict[str, np.ndarray]]:
+        """Blocks for the next prefetched batch; returns (epoch, index,
+        {field: array}). Releases the previously borrowed slot first."""
+        if self._handle is None:
+            raise RuntimeError("loader is closed")
+        if self._borrowed:
+            self._lib.tpujob_loader_release(self._handle)
+            self._borrowed = False
+        epoch = ctypes.c_uint64()
+        index = ctypes.c_uint64()
+        ptr = self._lib.tpujob_loader_acquire(
+            self._handle, ctypes.byref(epoch), ctypes.byref(index)
+        )
+        if not ptr:
+            raise RuntimeError("loader closed while waiting for a batch")
+        self._borrowed = True
+        nbytes = self.batch * self.meta.record_bytes
+        raw = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)), shape=(nbytes,)
+        )
+        # The C++ gather wrote the slot planar (field-blocked), so the field
+        # views below are zero-copy — no byte shuffling on this thread.
+        return (
+            int(epoch.value),
+            int(index.value),
+            split_planar(self.meta, raw, self.batch),
+        )
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.tpujob_loader_close(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "NativeLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PyLoader:
+    """Same contract as NativeLoader, pure numpy (no prefetch thread)."""
+
+    def __init__(
+        self,
+        path,
+        batch: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        prefetch: int = 4,  # accepted for interface parity; unused
+        meta: Optional[ArrayFileMeta] = None,
+    ):
+        self.meta = meta or read_meta(path)
+        self.batch = batch
+        self.shuffle = shuffle
+        self.seed = seed
+        rb = self.meta.record_bytes
+        self._records = np.memmap(path, dtype=np.uint8, mode="r").reshape(-1, rb)[
+            : self.meta.n_records
+        ]
+        self._epoch = 0
+        self._index = 0
+        self._perm = self._make_perm()
+
+    def _make_perm(self) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(self.meta.n_records)
+        return np.random.default_rng(self.seed + self._epoch).permutation(
+            self.meta.n_records
+        )
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self.meta.n_records // self.batch
+
+    def next_batch(self) -> Tuple[int, int, Dict[str, np.ndarray]]:
+        if self._index >= self.batches_per_epoch:
+            self._epoch += 1
+            self._index = 0
+            self._perm = self._make_perm()
+        idx = self._perm[self._index * self.batch : (self._index + 1) * self.batch]
+        raw = np.ascontiguousarray(self._records[idx]).reshape(-1)
+        out = (self._epoch, self._index, split_batch(self.meta, raw, self.batch))
+        self._index += 1
+        return out
+
+    def close(self) -> None:
+        self._records = None
+
+    def __enter__(self) -> "PyLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def open_loader(
+    path,
+    batch: int,
+    shuffle: bool = True,
+    seed: int = 0,
+    prefetch: int = 4,
+    native: Optional[bool] = None,
+):
+    """Open the best available loader. ``native=None`` tries the C++ loader
+    and falls back to PyLoader; True/False force one implementation."""
+    if native is False:
+        return PyLoader(path, batch, shuffle=shuffle, seed=seed, prefetch=prefetch)
+    try:
+        return NativeLoader(path, batch, shuffle=shuffle, seed=seed, prefetch=prefetch)
+    except LoaderUnavailable:
+        if native is True:
+            raise
+        return PyLoader(path, batch, shuffle=shuffle, seed=seed, prefetch=prefetch)

@@ -1,0 +1,52 @@
+"""Pack in-tree datasets into the native loader's array-file format.
+
+Usage::
+
+    python -m pytorch_operator_tpu.data.pack --out digits.bin --dataset digits
+    python -m pytorch_operator_tpu.data.pack --out syn.bin --dataset synthetic \
+        --n 4096 --height 32 --width 32 --classes 10
+
+The output is ``<out>`` plus a ``<out>.meta.json`` sidecar; feed it to
+workloads via ``--data-file`` (mnist) or :func:`open_loader` directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .array_file import pack_arrays
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", required=True)
+    p.add_argument("--dataset", choices=("digits", "synthetic"), default="digits")
+    p.add_argument("--split", default="train", choices=("train", "test"))
+    p.add_argument("--n", type=int, default=4096, help="synthetic: record count")
+    p.add_argument("--height", type=int, default=32)
+    p.add_argument("--width", type=int, default=32)
+    p.add_argument("--classes", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.dataset == "digits":
+        from ..workloads.datasets import digits
+
+        x, y = digits(args.split)
+    else:
+        from ..workloads.datasets import synthetic_images
+
+        x, y = synthetic_images(
+            args.n, args.height, args.width, args.classes, seed=args.seed
+        )
+    meta = pack_arrays(args.out, {"x": x, "y": y})
+    print(
+        f"packed {meta.n_records} records "
+        f"({meta.record_bytes} B each) -> {args.out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

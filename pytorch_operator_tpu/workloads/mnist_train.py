@@ -30,6 +30,13 @@ def main(argv=None) -> int:
     p.add_argument("--lr", type=float, default=2e-3)
     p.add_argument("--target-acc", type=float, default=0.97)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--data-file",
+        default=None,
+        help="stream train batches from a packed array file via the native "
+        "prefetch loader (see pytorch_operator_tpu.data.pack) instead of "
+        "the in-memory dataset",
+    )
     args = p.parse_args(argv)
 
     world = rendezvous.initialize_from_env()
@@ -56,12 +63,19 @@ def main(argv=None) -> int:
     x_test, y_test = digits("test")
     # Global batch must divide the dp extent evenly and fit the dataset
     # (a batch larger than the training set would yield zero steps/epoch).
+    # With --data-file the packed file's record count is the binding cap,
+    # not the in-memory set (which then only serves evaluation).
     dp = mesh.shape["dp"]
-    batch = (min(args.batch_size, len(x_train)) // dp) * dp
+    n_train = len(x_train)
+    if args.data_file:
+        from ..data import read_meta
+
+        n_train = read_meta(args.data_file).n_records
+    batch = (min(args.batch_size, n_train) // dp) * dp
     if batch == 0:
         print(
-            f"[mnist] error: training set ({len(x_train)}) smaller than the "
-            f"dp extent ({dp}); cannot form a global batch",
+            f"[mnist] error: training set ({n_train} records) smaller than "
+            f"the dp extent ({dp}); cannot form a global batch",
             flush=True,
         )
         return 1
@@ -94,25 +108,62 @@ def main(argv=None) -> int:
         logits = model.apply(params, bx)
         return jnp.sum((jnp.argmax(logits, -1) == by) * mask)
 
+    # Train-batch source: in-memory shuffle, or the native prefetch loader
+    # streaming from a packed array file (the gather then overlaps device
+    # compute on a background C++ thread).
+    loader = None
+    if args.data_file:
+        from ..data import open_loader
+
+        # Multi-process gangs pin the native loader: the pure-python
+        # fallback shuffles with a different RNG, and divergent per-rank
+        # permutations would silently corrupt assembled global batches.
+        loader = open_loader(
+            args.data_file,
+            batch,
+            seed=args.seed,
+            native=True if world.num_processes > 1 else None,
+        )
+        if loader.batches_per_epoch == 0:
+            print(
+                f"[mnist] error: {args.data_file} holds fewer records than "
+                f"the global batch ({batch}); zero steps per epoch",
+                flush=True,
+            )
+            loader.close()
+            return 1
+
+        def epoch_iter(epoch):
+            for _ in range(loader.batches_per_epoch):
+                _, _, fields = loader.next_batch()
+                yield fields["x"], fields["y"]
+
+    else:
+
+        def epoch_iter(epoch):
+            yield from epoch_batches(x_train, y_train, batch, seed=args.seed + epoch)
+
     step = 0
     loss = None
-    for epoch in range(args.epochs):
-        for bx, by in epoch_batches(
-            x_train, y_train, batch, seed=args.seed + epoch
-        ):
-            gx = global_batch(bx, mesh)
-            gy = global_batch(by, mesh)
-            params, opt_state, loss = train_step(params, opt_state, gx, gy)
-            if step == 0:
-                float(jax.device_get(loss))  # real fence (not block_until_ready)
-                rendezvous.report_first_step(step)
-                print(
-                    f"[mnist] first step done at +{time.time() - t0:.2f}s",
-                    flush=True,
-                )
-            step += 1
-        if loss is not None:
-            rendezvous.report_metrics(step, epoch=epoch, loss=float(loss))
+    try:
+        for epoch in range(args.epochs):
+            for bx, by in epoch_iter(epoch):
+                gx = global_batch(bx, mesh)
+                gy = global_batch(by, mesh)
+                params, opt_state, loss = train_step(params, opt_state, gx, gy)
+                if step == 0:
+                    float(jax.device_get(loss))  # real fence (not block_until_ready)
+                    rendezvous.report_first_step(step)
+                    print(
+                        f"[mnist] first step done at +{time.time() - t0:.2f}s",
+                        flush=True,
+                    )
+                step += 1
+            if loss is not None:
+                rendezvous.report_metrics(step, epoch=epoch, loss=float(loss))
+    finally:
+        if loader is not None:
+            loader.close()
 
     # Evaluate the whole test set as ONE padded global batch: per-dispatch
     # latency (remote PJRT tunnels especially) makes hundreds of tiny eval

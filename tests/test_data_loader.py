@@ -1,0 +1,174 @@
+"""Native prefetch loader + array file tests.
+
+Reference analog: the reference's input pipeline is torch DataLoader's
+native worker layer inside user containers (SURVEY.md §2 preamble); here
+it's native/loader.cc + the ctypes binding, tested against the pure-numpy
+fallback for identical contracts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pytorch_operator_tpu.data import (
+    LoaderUnavailable,
+    open_loader,
+    pack_arrays,
+    read_meta,
+)
+
+
+@pytest.fixture
+def packed(tmp_path):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 4, 4, 3)).astype(np.float32)
+    y = np.arange(64, dtype=np.int32)  # unique labels → order tracking
+    path = tmp_path / "data.bin"
+    meta = pack_arrays(path, {"x": x, "y": y})
+    return path, meta, x, y
+
+
+def _loader(path, native, **kw):
+    try:
+        return open_loader(path, native=native, **kw)
+    except LoaderUnavailable as e:
+        pytest.skip(f"native loader unavailable: {e}")
+
+
+class TestArrayFile:
+    def test_meta_roundtrip(self, packed):
+        path, meta, x, y = packed
+        m = read_meta(path)
+        assert m.n_records == 64
+        assert [f.name for f in m.fields] == ["x", "y"]
+        assert m.fields[0].shape == (4, 4, 3)
+        assert m.fields[0].dtype == "float32"
+        assert m.record_bytes == 4 * 4 * 3 * 4 + 4
+
+    def test_pack_rejects_ragged(self, tmp_path):
+        with pytest.raises(ValueError, match="records"):
+            pack_arrays(
+                tmp_path / "bad.bin",
+                {"x": np.zeros((4, 2)), "y": np.zeros(3)},
+            )
+
+
+@pytest.mark.parametrize("native", [True, False], ids=["native", "python"])
+class TestLoaderContract:
+    def test_ordered_batches_match_source(self, packed, native):
+        path, meta, x, y = packed
+        with _loader(path, native, batch=16, shuffle=False) as ld:
+            assert ld.batches_per_epoch == 4
+            for b in range(4):
+                epoch, index, fields = ld.next_batch()
+                assert (epoch, index) == (0, b)
+                np.testing.assert_array_equal(fields["y"], y[b * 16 : (b + 1) * 16])
+                np.testing.assert_array_equal(fields["x"], x[b * 16 : (b + 1) * 16])
+            # Wraps into epoch 1, same order without shuffle.
+            epoch, index, fields = ld.next_batch()
+            assert (epoch, index) == (1, 0)
+            np.testing.assert_array_equal(fields["y"], y[:16])
+
+    def test_shuffle_epoch_covers_all_records_once(self, packed, native):
+        path, meta, x, y = packed
+        with _loader(path, native, batch=16, shuffle=True, seed=7) as ld:
+            seen = []
+            for _ in range(ld.batches_per_epoch):
+                _, _, fields = ld.next_batch()
+                seen.extend(fields["y"].tolist())
+            assert sorted(seen) == list(range(64))  # exactly once each
+            assert seen != list(range(64))  # actually shuffled
+
+    def test_shuffle_reproducible_and_epoch_varying(self, packed, native):
+        path, meta, x, y = packed
+
+        def first_epoch(seed):
+            with _loader(path, native, batch=16, shuffle=True, seed=seed) as ld:
+                out = []
+                for _ in range(ld.batches_per_epoch):
+                    out.extend(ld.next_batch()[2]["y"].tolist())
+                return out
+
+        assert first_epoch(3) == first_epoch(3)
+        assert first_epoch(3) != first_epoch(4)
+
+        with _loader(path, native, batch=16, shuffle=True, seed=3) as ld:
+            e0, e1 = [], []
+            for _ in range(ld.batches_per_epoch):
+                e0.extend(ld.next_batch()[2]["y"].tolist())
+            for _ in range(ld.batches_per_epoch):
+                e1.extend(ld.next_batch()[2]["y"].tolist())
+            assert sorted(e0) == sorted(e1)
+            assert e0 != e1  # fresh permutation per epoch
+
+    def test_records_intact_under_shuffle(self, packed, native):
+        """x rows must travel with their y labels through the gather."""
+        path, meta, x, y = packed
+        with _loader(path, native, batch=16, shuffle=True, seed=1) as ld:
+            _, _, fields = ld.next_batch()
+            for row, label in zip(fields["x"], fields["y"]):
+                np.testing.assert_array_equal(row, x[label])
+
+
+class TestNativeSpecifics:
+    def test_open_rejects_short_file(self, tmp_path, packed):
+        path, meta, x, y = packed
+        short = tmp_path / "short.bin"
+        short.write_bytes(path.read_bytes()[: meta.record_bytes * 10])
+        try:
+            from pytorch_operator_tpu.data.native_loader import NativeLoader
+
+            with pytest.raises(LoaderUnavailable, match="open failed"):
+                NativeLoader(short, batch=16, meta=meta)
+        except LoaderUnavailable as e:
+            pytest.skip(f"native loader unavailable: {e}")
+
+    def test_batch_larger_than_dataset_rejected(self, packed):
+        path, meta, x, y = packed
+        from pytorch_operator_tpu.data.native_loader import NativeLoader, _load_lib
+
+        try:
+            _load_lib()
+        except LoaderUnavailable as e:
+            pytest.skip(f"native loader unavailable: {e}")
+        with pytest.raises(LoaderUnavailable, match="open failed"):
+            NativeLoader(path, batch=128)
+
+    def test_prefetch_overlaps(self, packed):
+        """The producer fills the ring while the consumer is idle."""
+        import time
+
+        path, meta, x, y = packed
+        ld = _loader(path, True, batch=16, prefetch=3)
+        try:
+            time.sleep(0.3)  # producer should have filled the ring by now
+            t0 = time.time()
+            ld.next_batch()
+            assert time.time() - t0 < 0.1  # served from the ring, no wait
+        finally:
+            ld.close()
+
+
+class TestMnistIntegration:
+    def test_mnist_trains_from_data_file(self, tmp_path):
+        import tests.jaxenv  # noqa: F401
+
+        from pytorch_operator_tpu.data.pack import main as pack_main
+        from pytorch_operator_tpu.workloads.mnist_train import main as mnist_main
+
+        out = tmp_path / "digits.bin"
+        assert pack_main(["--out", str(out), "--dataset", "digits"]) == 0
+        rc = mnist_main(
+            [
+                "--epochs",
+                "4",
+                "--batch-size",
+                "128",
+                "--target-acc",
+                "0.9",
+                "--data-file",
+                str(out),
+            ]
+        )
+        assert rc == 0
