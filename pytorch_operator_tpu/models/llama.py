@@ -59,8 +59,15 @@ class LlamaConfig:
 
 
 def llama3_8b(**over) -> LlamaConfig:
-    """The real Llama-3-8B shape (BASELINE.json:10 target workload)."""
-    return LlamaConfig(**over)
+    """The real Llama-3-8B shape (BASELINE.json:10 target workload).
+
+    Defaults to the pallas flash kernel: at this scale the S×S score
+    materialization dominates attention HBM traffic (3.5 ms vs 75 ms dense
+    fwd at S=8192 — BASELINE.md). flash_attention falls back to dense
+    automatically when the tiling doesn't fit (S that doesn't divide into
+    lane/sublane-aligned blocks, or D not lane-aligned).
+    """
+    return LlamaConfig(**{"attn_impl": "flash", **over})
 
 
 def llama_tiny(**over) -> LlamaConfig:

@@ -389,7 +389,14 @@ def flash_attention(
         interpret = jax.default_backend() == "cpu"
 
     block_q, block_k = min(block_q, S), min(block_k, S)
-    if S % block_q or S % block_k or (not interpret and D % 128):
+    if (
+        S % block_q
+        or S % block_k
+        # Real-TPU tiling: lane-aligned D and k-blocks, sublane-aligned
+        # q-blocks. Clamped blocks from short sequences must still align,
+        # else Mosaic rejects the tile (e.g. S=100 → block_q=100).
+        or (not interpret and (D % 128 or block_q % 8 or block_k % 128))
+    ):
         return _dense_reference(q, k, v, causal=causal)
     cfg = _FlashCfg(causal, block_q, block_k, H // KH, interpret)
 

@@ -124,6 +124,7 @@ def run_benchmark(
     warmup: int = 5,
     lr: float = 0.1,
     momentum: float = 0.9,
+    windows: int = 1,
     profile_dir: str | None = None,
     log=print,
 ) -> dict:
@@ -132,6 +133,12 @@ def run_benchmark(
     Timing fence: a real host transfer (device_get), NOT block_until_ready —
     on remote-tunnel PJRT backends the latter can resolve before the
     dispatch queue drains, inflating throughput by orders of magnitude.
+
+    ``windows`` > 1 times that many back-to-back windows of ``steps`` and
+    reports the FASTEST (min-time estimator): the tunneled backend has ±5%
+    run-to-run noise (BASELINE.md), and the minimum over a few windows is
+    the standard low-variance estimate of attainable throughput. All
+    windows run real training steps on the same state.
     """
     import jax
 
@@ -163,8 +170,11 @@ def run_benchmark(
     )
     # Fuse steps into chunked dispatches (see make_train_chunk). One chunk
     # size → one compile; timed steps round UP to a chunk multiple so a run
-    # never executes fewer steps than asked for.
-    chunk = min(10, max(steps, 1))
+    # never executes fewer steps than asked for. Cap 30 keeps warmup (one
+    # chunk minimum) bounded; at the bench default (steps=30) each timed
+    # window is a single dispatch — measured +2.8% vs chunk=10 on the
+    # tunneled TPU (BASELINE.md).
+    chunk = min(30, max(steps, 1))
     steps = math.ceil(max(steps, 1) / chunk) * chunk
     warm_chunks = max(1, round(warmup / chunk))
     train_chunk = make_train_chunk(model, tx, chunk)
@@ -191,15 +201,22 @@ def run_benchmark(
 
     from .trainer import maybe_profile
 
+    if profile_dir and windows > 1:
+        # The trace must show the run the reported number comes from; with
+        # a min-over-windows estimator it wouldn't, so profile one window.
+        log("[resnet] --profile-dir set: timing a single window")
+        windows = 1
     with maybe_profile(profile_dir, lambda m: log(f"[resnet] {m}")):
-        t0 = time.time()
-        for _ in range(steps // chunk):
-            params, batch_stats, opt_state, loss = train_chunk(
-                params, batch_stats, opt_state, gx, gy
-            )
-        final_loss = float(jax.device_get(loss))
-        # dt is taken here, before stop_trace() flushes the trace to disk.
-        dt = time.time() - t0
+        dt = math.inf
+        for _ in range(max(windows, 1)):
+            t0 = time.time()
+            for _ in range(steps // chunk):
+                params, batch_stats, opt_state, loss = train_chunk(
+                    params, batch_stats, opt_state, gx, gy
+                )
+            final_loss = float(jax.device_get(loss))
+            # dt is taken here, before stop_trace() flushes the trace.
+            dt = min(dt, time.time() - t0)
 
     images_per_sec = batch * steps / dt
     per_chip = images_per_sec / n_dev
@@ -235,6 +252,10 @@ def main(argv=None) -> int:
     p.add_argument("--depth", type=int, default=50, choices=[18, 34, 50, 101, 152])
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument(
+        "--windows", type=int, default=1,
+        help="time this many windows of --steps and report the fastest",
+    )
+    p.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace of the timed window here",
     )
@@ -251,6 +272,7 @@ def main(argv=None) -> int:
         warmup=args.warmup,
         lr=args.lr,
         momentum=args.momentum,
+        windows=args.windows,
         profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"

@@ -38,6 +38,18 @@ def test_forward_matches_dense(causal, H, KH):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_unaligned_short_seq_falls_back_to_dense():
+    """With interpret=False, a short sequence whose clamped blocks are not
+    sublane/lane-aligned (S=100 → block_q=100) must take the dense path
+    BEFORE any pallas call — so this runs fine on the CPU backend."""
+    import jax
+
+    q, k, v = _rand_qkv(jax.random.key(3), 1, 100, 2, 2, 128, np.float32)
+    out = flash_attention(q, k, v, interpret=False)
+    ref = _dense_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_uneven_blocks():
     """block_q != block_k exercises the rectangular diagonal masking."""
     import jax
