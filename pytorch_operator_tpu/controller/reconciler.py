@@ -14,6 +14,7 @@ the same tests run against :class:`~.runner.FakeRunner`.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from pathlib import Path
 from typing import List, Optional
@@ -68,6 +69,11 @@ class Reconciler:
         self._unschedulable_warned = set()
         # Per-file byte offsets for incremental status-report scanning.
         self._scan_offsets = {}
+        # Per-key serialization (reference: the workqueue processes each job
+        # key on one worker at a time). Two concurrent syncs of one job
+        # would both observe a missing replica and double-create it.
+        self._key_locks: dict = {}
+        self._key_locks_guard = threading.Lock()
 
     # ---- helpers ----
 
@@ -162,8 +168,24 @@ class Reconciler:
 
     # ---- the core sync ----
 
+    def key_lock(self, key: str) -> threading.Lock:
+        """The per-key mutex; also taken by supervisor delete/scale so a
+        teardown can't interleave with an in-flight sync of the same job."""
+        with self._key_locks_guard:
+            return self._key_locks.setdefault(key, threading.Lock())
+
+    def drop_key_lock(self, key: str) -> None:
+        """Retire a deleted job's lock. Benign if the key reappears: the
+        next key_lock() simply mints a fresh Lock."""
+        with self._key_locks_guard:
+            self._key_locks.pop(key, None)
+
     def sync(self, key: str, now: Optional[float] = None) -> bool:
         """One reconcile pass. Returns True if the job still needs syncing."""
+        with self.key_lock(key):
+            return self._sync_locked(key, now)
+
+    def _sync_locked(self, key: str, now: Optional[float]) -> bool:
         now = time.time() if now is None else now
         job = self.store.get(key)
         if job is None:

@@ -124,64 +124,76 @@ class FakeRunner(ProcessRunner):
         self.templates: Dict[str, ProcessTemplate] = {}
         self.actions: List[tuple] = []
         self.capacity = capacity  # None = unlimited
+        # Same thread-safety contract as SubprocessRunner: per-key reconcile
+        # locks serialize same-key access, but different keys hit the shared
+        # dicts concurrently (tests/test_stress.py).
+        self._lock = threading.RLock()
 
     def create(self, job_key, rtype, index, template, env):
         name = replica_name(job_key, rtype, index)
-        if name in self.handles:
-            raise RuntimeError(f"duplicate create for {name}")
-        h = ReplicaHandle(
-            name=name,
-            job_key=job_key,
-            replica_type=rtype,
-            index=index,
-            phase=ReplicaPhase.PENDING,
-            created_at=time.time(),
-        )
-        self.handles[name] = h
-        self.envs[name] = dict(env)
-        self.templates[name] = template
-        self.actions.append(("create", name))
-        return h
+        with self._lock:
+            if name in self.handles:
+                raise RuntimeError(f"duplicate create for {name}")
+            h = ReplicaHandle(
+                name=name,
+                job_key=job_key,
+                replica_type=rtype,
+                index=index,
+                phase=ReplicaPhase.PENDING,
+                created_at=time.time(),
+            )
+            self.handles[name] = h
+            self.envs[name] = dict(env)
+            self.templates[name] = template
+            self.actions.append(("create", name))
+            return h
 
     def delete(self, name, grace_seconds: float = 5.0):
-        self.actions.append(("delete", name))
-        h = self.handles.pop(name, None)
-        if h is not None:
-            self.envs.pop(name, None)
-            self.templates.pop(name, None)
+        with self._lock:
+            self.actions.append(("delete", name))
+            h = self.handles.pop(name, None)
+            if h is not None:
+                self.envs.pop(name, None)
+                self.templates.pop(name, None)
 
     def sync(self):
         pass
 
     def list_for_job(self, job_key):
-        return [h for h in self.handles.values() if h.job_key == job_key]
+        with self._lock:
+            return [h for h in self.handles.values() if h.job_key == job_key]
 
     def get(self, name):
-        return self.handles.get(name)
+        with self._lock:
+            return self.handles.get(name)
 
     def remove_record(self, name):
-        self.handles.pop(name, None)
+        with self._lock:
+            self.handles.pop(name, None)
 
     def schedulable_slots(self):
-        if self.capacity is None:
-            return None
-        used = sum(1 for h in self.handles.values() if h.is_active())
-        return max(0, self.capacity - used)
+        with self._lock:
+            if self.capacity is None:
+                return None
+            used = sum(1 for h in self.handles.values() if h.is_active())
+            return max(0, self.capacity - used)
 
     # --- test helpers ---
 
     def set_phase(self, name: str, phase: ReplicaPhase, exit_code: Optional[int] = None):
-        h = self.handles[name]
-        h.phase = phase
-        if exit_code is not None:
-            h.exit_code = exit_code
-        if phase in (ReplicaPhase.SUCCEEDED, ReplicaPhase.FAILED):
-            h.finished_at = time.time()
+        with self._lock:
+            h = self.handles[name]
+            h.phase = phase
+            if exit_code is not None:
+                h.exit_code = exit_code
+            if phase in (ReplicaPhase.SUCCEEDED, ReplicaPhase.FAILED):
+                h.finished_at = time.time()
 
     def set_all_running(self, job_key: str):
-        for h in self.list_for_job(job_key):
-            if h.phase == ReplicaPhase.PENDING:
-                h.phase = ReplicaPhase.RUNNING
+        with self._lock:
+            for h in self.list_for_job(job_key):
+                if h.phase == ReplicaPhase.PENDING:
+                    h.phase = ReplicaPhase.RUNNING
 
 
 class SubprocessRunner(ProcessRunner):

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import os
 import socket
-import threading
 import time
 from pathlib import Path
 from typing import List, Optional
@@ -76,7 +75,6 @@ class Supervisor:
             status_root=self.state_dir / "status",
             checkpoint_root=self.state_dir / "checkpoints",
         )
-        self._lock = threading.Lock()
 
     # ---- API-server-ish surface ----
 
@@ -104,17 +102,23 @@ class Supervisor:
         Checkpoints/status artifacts survive by default (job-level resume,
         SURVEY.md §5); ``purge_artifacts=True`` reclaims them.
         """
-        job = self.store.get(key)
-        if job is None:
-            return False
-        for h in self.runner.list_for_job(key):
-            self.runner.delete(h.name)
-        self.gang.delete_group(key)
-        self.expectations.delete_expectations(key)
-        self.store.delete(key)
-        self.events.drop_job(key)
-        if purge_artifacts:
-            purge_job_artifacts(self.state_dir, key)
+        # Serialize against an in-flight sync of this job: a teardown that
+        # interleaves with a reconcile pass would race replica creation.
+        with self.reconciler.key_lock(key):
+            job = self.store.get(key)
+            if job is None:
+                return False
+            for h in self.runner.list_for_job(key):
+                self.runner.delete(h.name)
+            self.gang.delete_group(key)
+            self.expectations.delete_expectations(key)
+            self.store.delete(key)
+            self.events.drop_job(key)
+            if purge_artifacts:
+                purge_job_artifacts(self.state_dir, key)
+        # Job record gone → retire its reconcile lock (a daemon with high
+        # job churn would otherwise leak one Lock per key ever seen).
+        self.reconciler.drop_key_lock(key)
         return True
 
     def scale(self, key: str, worker_replicas: int) -> TPUJob:
@@ -123,42 +127,45 @@ class Supervisor:
         Requires an elastic_policy; the new count must lie within
         [min_replicas, max_replicas] (reference: torchelastic min/max).
         """
-        job = self.store.get(key)
-        if job is None:
-            raise KeyError(key)
-        ep = job.spec.elastic_policy
-        if ep is None:
-            raise ValidationError(["scale: job has no elastic_policy"])
-        if not (ep.min_replicas <= worker_replicas <= ep.max_replicas):
-            raise ValidationError(
-                [
-                    f"scale: worker_replicas={worker_replicas} outside "
-                    f"[{ep.min_replicas}, {ep.max_replicas}]"
-                ]
-            )
-        workers = job.spec.replica_specs.get(ReplicaType.WORKER)
-        if workers is None:
-            raise ValidationError(["scale: job has no Worker replicas"])
-        if workers.replicas == worker_replicas:
+        with self.reconciler.key_lock(key):
+            job = self.store.get(key)
+            if job is None:
+                raise KeyError(key)
+            ep = job.spec.elastic_policy
+            if ep is None:
+                raise ValidationError(["scale: job has no elastic_policy"])
+            if not (ep.min_replicas <= worker_replicas <= ep.max_replicas):
+                raise ValidationError(
+                    [
+                        f"scale: worker_replicas={worker_replicas} outside "
+                        f"[{ep.min_replicas}, {ep.max_replicas}]"
+                    ]
+                )
+            workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+            if workers is None:
+                raise ValidationError(["scale: job has no Worker replicas"])
+            if workers.replicas == worker_replicas:
+                return job
+            workers.replicas = worker_replicas
+            # Membership change → tear down the world; next sync re-creates
+            # it with the new WORLD_SIZE (elastic re-rendezvous).
+            handles = self.runner.list_for_job(key)
+            if handles and not job.is_finished():
+                for h in handles:
+                    self.runner.delete(h.name)
+                    self.metrics.replicas_deleted.inc()
+                job.status.restart_count += 1
+                self.metrics.jobs_restarted.inc()
+                msg = (
+                    f"elastic resize to {worker_replicas} workers "
+                    f"(restart #{job.status.restart_count})."
+                )
+                job.set_condition(
+                    ConditionType.RESTARTING, reason="TPUJobScaled", message=msg
+                )
+                self.events.normal(key, "TPUJobScaled", msg)
+            self.store.update(job)
             return job
-        workers.replicas = worker_replicas
-        # Membership change → tear down the world; next sync re-creates it
-        # with the new WORLD_SIZE (elastic re-rendezvous).
-        handles = self.runner.list_for_job(key)
-        if handles and not job.is_finished():
-            for h in handles:
-                self.runner.delete(h.name)
-                self.metrics.replicas_deleted.inc()
-            job.status.restart_count += 1
-            self.metrics.jobs_restarted.inc()
-            msg = (
-                f"elastic resize to {worker_replicas} workers "
-                f"(restart #{job.status.restart_count})."
-            )
-            job.set_condition(ConditionType.RESTARTING, reason="TPUJobScaled", message=msg)
-            self.events.normal(key, "TPUJobScaled", msg)
-        self.store.update(job)
-        return job
 
     # ---- reconcile loop ----
 
