@@ -55,6 +55,7 @@ class Reconciler:
         expectations: Optional[ControllerExpectations] = None,
         status_root: Optional[Path] = None,
         checkpoint_root: Optional[Path] = None,
+        cache_root: Optional[Path] = None,
         coordinator_host: str = "127.0.0.1",
     ):
         self.store = store
@@ -65,6 +66,9 @@ class Reconciler:
         self.expectations = expectations or ControllerExpectations()
         self.status_root = Path(status_root) if status_root else None
         self.checkpoint_root = Path(checkpoint_root) if checkpoint_root else None
+        # ONE cache for the whole state dir (not per-job): the win is a
+        # resubmitted job hitting the previous run's compiled executables.
+        self.cache_root = Path(cache_root) if cache_root else None
         self.coordinator_host = coordinator_host
         self._unschedulable_warned = set()
         # Per-file byte offsets for incremental status-report scanning.
@@ -125,6 +129,22 @@ class Reconciler:
         self.expectations.delete_expectations(key)
         self._unschedulable_warned.discard(key)
 
+    def _reset_status_dir(self, key: str) -> None:
+        """Clear a prior incarnation's status reports (and their scan
+        offsets) at job creation. Restarts within one incarnation keep the
+        dir — their reports are still this job's."""
+        if self.status_root is None:
+            return
+        d = self.status_root / key.replace("/", "_")
+        if d.is_dir():
+            import shutil
+
+            shutil.rmtree(d, ignore_errors=True)
+        # Parent-dir comparison, not a string prefix: "default_train" must
+        # not also purge "default_train2"'s offsets.
+        for p in [p for p in self._scan_offsets if Path(p).parent == d]:
+            del self._scan_offsets[p]
+
     def _scan_first_step(self, job: TPUJob, key: str) -> None:
         """Pick up first-training-step reports from workload status files —
         the schedule-to-first-step latency probe (BASELINE.json:2)."""
@@ -161,6 +181,11 @@ class Reconciler:
                     continue
                 if rec.get("event") == "first_step":
                     ts = float(rec.get("ts", 0.0))
+                    # Defense in depth vs stale files (e.g. a daemon restart
+                    # loses scan offsets): a first step cannot precede this
+                    # incarnation's submission.
+                    if job.status.submit_time is not None and ts < job.status.submit_time:
+                        continue
                     if earliest is None or ts < earliest:
                         earliest = ts
         if earliest is not None:
@@ -206,6 +231,11 @@ class Reconciler:
             )
             self.events.normal(key, "TPUJobCreated", f"TPUJob {key} is created.")
             self.metrics.jobs_created.inc()
+            # A fresh incarnation must not inherit the previous run's status
+            # reports: a stale first_step record from a deleted+resubmitted
+            # job with this key would yield a bogus (even negative)
+            # schedule-to-first-step latency.
+            self._reset_status_dir(key)
 
         # ActiveDeadlineSeconds (reference: RunPolicy deadline → Failed).
         deadline = job.spec.run_policy.active_deadline_seconds
@@ -316,6 +346,10 @@ class Reconciler:
                 job.spec.port = _find_free_port()
             status_dir = self._status_dir(key)
             checkpoint_dir = self._checkpoint_dir(key)
+            cache_dir = None
+            if self.cache_root is not None:
+                self.cache_root.mkdir(parents=True, exist_ok=True)
+                cache_dir = str(self.cache_root)
             num_processes = sum(
                 self._desired_replicas(job, rt) for rt in job.spec.replica_specs
             )
@@ -327,6 +361,7 @@ class Reconciler:
                     coordinator_host=self.coordinator_host,
                     status_dir=status_dir,
                     checkpoint_dir=checkpoint_dir,
+                    compile_cache_dir=cache_dir,
                 )
                 self.runner.create(
                     key, rtype, index, job.spec.replica_specs[rtype].template, env

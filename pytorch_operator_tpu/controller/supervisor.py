@@ -74,6 +74,7 @@ class Supervisor:
             expectations=self.expectations,
             status_root=self.state_dir / "status",
             checkpoint_root=self.state_dir / "checkpoints",
+            cache_root=self.state_dir / "xla_cache",
         )
 
     # ---- API-server-ish surface ----

@@ -36,6 +36,7 @@ def build_cluster_env(
     coordinator_host: str = "127.0.0.1",
     status_dir: Optional[str] = None,
     checkpoint_dir: Optional[str] = None,
+    compile_cache_dir: Optional[str] = None,
 ) -> Dict[str, str]:
     """Build the injected environment for one replica process.
 
@@ -88,5 +89,16 @@ def build_cluster_env(
         env["TPUJOB_STATUS_DIR"] = status_dir
     if checkpoint_dir is not None:
         env["TPUJOB_CHECKPOINT_DIR"] = checkpoint_dir
+    # Persistent XLA compilation cache, shared across the state dir: a
+    # resubmitted/restarted job skips its ~30s cold compile, which is most
+    # of schedule-to-first-step on TPU (BASELINE.md). Template env wins —
+    # injected env overrides template env at spawn, so only set it when
+    # the user didn't.
+    if (
+        compile_cache_dir is not None
+        and "JAX_COMPILATION_CACHE_DIR"
+        not in job.spec.replica_specs[rtype].template.env
+    ):
+        env["JAX_COMPILATION_CACHE_DIR"] = compile_cache_dir
 
     return env

@@ -84,6 +84,67 @@ class TestCreation:
         assert w1["TPUJOB_REPLICA_TYPE"] == "Worker"
         assert w1["TPU_WORKER_HOSTNAMES"].count(",") == 2
 
+    def test_resubmission_does_not_inherit_stale_first_step(self, tmp_path):
+        """Delete + resubmit under the same key must wipe the previous
+        incarnation's status reports, else schedule-to-first-step latency
+        goes negative (computed from the OLD run's first_step record)."""
+        import json as _json
+        import time as _time
+
+        store = JobStore()
+        runner = FakeRunner()
+        rec = Reconciler(store=store, runner=runner, status_root=tmp_path / "status")
+        key = store.add(new_job(name="stale", workers=0))
+        rec.sync(key)
+        # Old incarnation reports its first step, then is deleted.
+        d = tmp_path / "status" / key.replace("/", "_")
+        stale_ts = _time.time() - 3600
+        (d / "Master-0.jsonl").write_text(
+            _json.dumps({"event": "first_step", "ts": stale_ts}) + "\n"
+        )
+        rec.sync(key)
+        assert store.get(key).status.first_step_time is None  # filtered: pre-submit
+        store.delete(key)
+
+        key = store.add(new_job(name="stale", workers=0))
+        rec.sync(key)
+        job = store.get(key)
+        assert not (d / "Master-0.jsonl").exists()  # dir wiped at creation
+        assert job.status.first_step_time is None
+        # A report from THIS incarnation is picked up normally.
+        d.mkdir(parents=True, exist_ok=True)
+        now_ts = _time.time()
+        (d / "Master-0.jsonl").write_text(
+            _json.dumps({"event": "first_step", "ts": now_ts}) + "\n"
+        )
+        rec.sync(key)
+        job = store.get(key)
+        assert job.status.first_step_time == now_ts
+        assert job.status.first_step_time >= job.status.submit_time
+
+    def test_compile_cache_injection(self, tmp_path):
+        """With a cache_root, replicas get JAX_COMPILATION_CACHE_DIR (shared
+        across jobs — resubmits reuse compiled executables), and a template
+        env override wins."""
+        store = JobStore()
+        runner = FakeRunner()
+        rec = Reconciler(store=store, runner=runner, cache_root=tmp_path / "xc")
+        key = store.add(new_job(name="cachejob", workers=0))
+        rec.sync(key)
+        env = runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
+        assert env["JAX_COMPILATION_CACHE_DIR"] == str(tmp_path / "xc")
+        assert (tmp_path / "xc").is_dir()
+
+        override = new_job(name="cachejob2", workers=0)
+        override.spec.replica_specs[ReplicaType.MASTER].template.env[
+            "JAX_COMPILATION_CACHE_DIR"
+        ] = "/custom"
+        key2 = store.add(override)
+        rec.sync(key2)
+        env2 = runner.envs[replica_name(key2, ReplicaType.MASTER, 0)]
+        # Injection defers to the template; spawn-time merge applies /custom.
+        assert "JAX_COMPILATION_CACHE_DIR" not in env2
+
     def test_no_duplicate_creation_on_resync(self):
         store, runner, _, _, rec = make_harness()
         key = store.add(new_job(workers=2))
