@@ -142,6 +142,17 @@ def cmd_submit(args) -> int:
 
 
 def cmd_supervisor(args) -> int:
+    # SIGTERM (systemd stop / kubelet-style termination) takes the same
+    # clean shutdown path as Ctrl-C: kill replicas, release the lease.
+    # One-shot: a re-delivered SIGTERM during the cleanup itself must not
+    # abort it (that would orphan replicas and hold the lease).
+    import signal
+
+    def _sigterm(signum, frame):
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
     sup = Supervisor(
         state_dir=_state_dir(args),
         gang_enabled=not args.no_gang,

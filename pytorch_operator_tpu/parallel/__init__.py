@@ -7,6 +7,7 @@ XLA collectives over ICI/DCN.
 
 from .mesh import (  # noqa: F401
     MESH_AXIS_ORDER,
+    make_hybrid_mesh,
     make_mesh,
     mesh_from_env,
     parse_mesh_spec,

@@ -29,12 +29,31 @@ MESH_AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 # and innermost mesh dims map to physically-adjacent devices on TPU slices.
 
 
+def split_hybrid_spec(spec: str) -> tuple[str, str]:
+    """Split a string spec into ``(ici, dcn)`` halves: axes marked with the
+    ``@dcn`` suffix (``"dp=2@dcn,fsdp=-1"``) go to the dcn half. This is THE
+    grammar for hybrid specs; :func:`parse_mesh_spec` accepts the suffix too
+    (stripping it), so validators can reuse one parser."""
+    ici_parts, dcn_parts = [], []
+    for part in spec.split(","):
+        part = part.strip()
+        if part.endswith("@dcn"):
+            dcn_parts.append(part[: -len("@dcn")])
+        elif part:
+            ici_parts.append(part)
+    return ",".join(ici_parts), ",".join(dcn_parts)
+
+
 def parse_mesh_spec(spec: Union[str, Mapping[str, int]]) -> Dict[str, int]:
-    """Parse ``"dp=2,tp=4"`` (or a mapping) into an ordered axis dict."""
+    """Parse ``"dp=2,tp=4"`` (or a mapping) into an ordered axis dict.
+    ``@dcn`` suffixes are accepted and stripped — use
+    :func:`split_hybrid_spec` to recover the ici/dcn split."""
     if isinstance(spec, str):
         out: Dict[str, int] = {}
         for part in spec.split(","):
             part = part.strip()
+            if part.endswith("@dcn"):
+                part = part[: -len("@dcn")]
             if not part:
                 continue
             if "=" not in part:
@@ -90,9 +109,18 @@ def make_mesh(
     spec: Union[str, Mapping[str, int], None] = None,
     devices: Optional[Sequence] = None,
 ):
-    """Build a named Mesh from a spec (default: all devices on ``dp``)."""
+    """Build a named Mesh from a spec (default: all devices on ``dp``).
+
+    String specs may mark axes as inter-slice with an ``@dcn`` suffix —
+    ``"dp=2@dcn,fsdp=-1,tp=2"`` builds the :func:`make_hybrid_mesh` layout
+    (dp across slices over DCN, fsdp×tp on ICI within each slice). This is
+    the syntax workloads accept via ``--mesh`` / ``TPUJOB_MESH``.
+    """
     import jax
 
+    if isinstance(spec, str) and "@dcn" in spec:
+        ici_spec, dcn_spec = split_hybrid_spec(spec)
+        return make_hybrid_mesh(ici=ici_spec, dcn=dcn_spec, devices=devices)
     if devices is None:
         devices = jax.devices()
     axes = resolve_axis_sizes(spec if spec is not None else {"dp": -1}, len(devices))
@@ -109,3 +137,85 @@ def mesh_from_env(default: str = "dp=-1"):
     import os
 
     return make_mesh(os.environ.get("TPUJOB_MESH", default))
+
+
+def make_hybrid_mesh(
+    ici: Union[str, Mapping[str, int]],
+    dcn: Union[str, Mapping[str, int]],
+    devices: Optional[Sequence] = None,
+):
+    """Mesh spanning multiple slices: ``dcn`` axes cross the data-center
+    network (between slices), ``ici`` axes stay on the intra-slice
+    interconnect.
+
+    The reference's analog is NCCL over the pod network for ALL traffic;
+    TPU-first, the slow inter-slice hops must only carry the
+    bandwidth-light collectives (data-parallel gradient reduction), while
+    tp/sp/fsdp ride ICI. That's exactly what this layout encodes: dcn axes
+    are OUTERMOST (consecutive devices share a slice), so e.g.
+    ``make_hybrid_mesh(ici="fsdp=-1,tp=2", dcn="dp=2")`` gives per-slice
+    fsdp×tp with gradient psums over dp crossing DCN once per step.
+
+    Built on ``mesh_utils.create_hybrid_device_mesh`` when the devices
+    expose slice topology (real multi-slice TPU); falls back to a plain
+    reshape (CPU/test meshes, where locality is moot) — the axis semantics
+    are identical either way.
+
+    ``ici`` may use one ``-1`` wildcard, resolved against the per-slice
+    device count; ``dcn`` sizes must be explicit (the number of slices is
+    deployment config, not discoverable from a flat device list).
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    dcn_axes = parse_mesh_spec(dcn)
+    if not dcn_axes:
+        return make_mesh(ici, devices)
+    if any(s == -1 for s in dcn_axes.values()):
+        raise ValueError("dcn axes must have explicit sizes (no -1 wildcard)")
+    n_slices = 1
+    for s in dcn_axes.values():
+        n_slices *= s
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} slices"
+        )
+    per_slice = len(devices) // n_slices
+    # An explicitly empty ici spec means "no intra-slice axes" (one device
+    # per slice) — it must NOT fall into resolve_axis_sizes's dp=-1
+    # default, which would mint a phantom dp axis (or a bogus overlap
+    # error when dp is a dcn axis).
+    if not parse_mesh_spec(ici):
+        if per_slice != 1:
+            raise ValueError(
+                f"empty ici spec needs exactly 1 device per slice, "
+                f"got {per_slice}"
+            )
+        ici_axes: Dict[str, int] = {}
+    else:
+        ici_axes = resolve_axis_sizes(ici, per_slice)
+    if set(ici_axes) & set(dcn_axes):
+        raise ValueError(
+            f"axes {sorted(set(ici_axes) & set(dcn_axes))} appear in both "
+            "ici and dcn specs"
+        )
+
+    axis_names = tuple(dcn_axes) + tuple(ici_axes)  # dcn outermost
+    shape = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    if getattr(devices[0], "slice_index", None) is not None:
+        from jax.experimental import mesh_utils
+
+        # create_hybrid_device_mesh takes same-length per-axis shapes,
+        # multiplied elementwise; an axis lives on one network, so the
+        # other network's extent there is 1.
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (1,) * len(dcn_axes) + tuple(ici_axes.values()),
+            tuple(dcn_axes.values()) + (1,) * len(ici_axes),
+            devices=devices,
+        )
+        return Mesh(dev_array.reshape(shape), axis_names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)

@@ -46,6 +46,81 @@ class TestMeshSpec:
         assert mesh.devices.shape == (2, 2, 2)
 
 
+class TestHybridMesh:
+    """Multi-slice meshes: dcn axes outermost, ici axes within a slice."""
+
+    def test_axes_and_shape(self):
+        from pytorch_operator_tpu.parallel import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh(ici="fsdp=-1,tp=2", dcn="dp=2")
+        assert mesh.axis_names == ("dp", "fsdp", "tp")
+        assert mesh.devices.shape == (2, 2, 2)
+        # dcn outermost: each dp row holds one contiguous 4-device "slice".
+        flat = mesh.devices.reshape(2, -1)
+        ids = [[d.id for d in row] for row in flat]
+        assert ids[0] == sorted(ids[0]) and ids[1] == sorted(ids[1])
+        assert max(ids[0]) < min(ids[1])
+
+    def test_gradient_psum_over_dcn_axis(self):
+        """The intended layout: fsdp/tp traffic inside a slice, one dp
+        gradient reduction across DCN — exercised with a real psum."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pytorch_operator_tpu.parallel import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh(ici="fsdp=4", dcn="dp=2")
+        x = jnp.arange(8.0).reshape(8, 1)
+        xs = jax.device_put(x, NamedSharding(mesh, P(("dp", "fsdp"))))
+        total = jax.jit(lambda a: a.sum())(xs)
+        assert float(total) == sum(range(8))
+
+    def test_overlapping_axes_rejected(self):
+        from pytorch_operator_tpu.parallel import make_hybrid_mesh
+
+        with pytest.raises(ValueError, match="both"):
+            make_hybrid_mesh(ici="dp=4", dcn="dp=2")
+
+    def test_dcn_wildcard_rejected(self):
+        from pytorch_operator_tpu.parallel import make_hybrid_mesh
+
+        with pytest.raises(ValueError, match="explicit"):
+            make_hybrid_mesh(ici="fsdp=4", dcn="dp=-1")
+
+    def test_empty_dcn_degrades_to_plain_mesh(self):
+        from pytorch_operator_tpu.parallel import make_hybrid_mesh
+
+        mesh = make_hybrid_mesh(ici="dp=-1", dcn="")
+        assert mesh.devices.shape == (8,)
+
+    def test_at_dcn_suffix_in_make_mesh(self):
+        """The --mesh / TPUJOB_MESH user syntax for hybrid layouts."""
+        mesh = make_mesh("dp=2@dcn,fsdp=-1,tp=2")
+        assert mesh.axis_names == ("dp", "fsdp", "tp")
+        assert mesh.devices.shape == (2, 2, 2)
+
+    def test_all_dcn_spec(self):
+        """Pure cross-slice data parallel: one device per slice, no
+        phantom ici axes."""
+        mesh = make_mesh("dp=8@dcn")
+        assert mesh.axis_names == ("dp",)
+        assert mesh.devices.shape == (8,)
+
+    def test_all_dcn_spec_with_leftover_devices_rejected(self):
+        from pytorch_operator_tpu.parallel import make_hybrid_mesh
+
+        with pytest.raises(ValueError, match="1 device per slice"):
+            make_hybrid_mesh(ici="", dcn="dp=2")
+
+    def test_parse_mesh_spec_accepts_dcn_suffix(self):
+        """The canonical parser must not choke on the documented syntax."""
+        assert parse_mesh_spec("dp=2@dcn,tp=2") == {"dp": 2, "tp": 2}
+        from pytorch_operator_tpu.parallel.mesh import split_hybrid_spec
+
+        assert split_hybrid_spec("dp=2@dcn,fsdp=-1,tp=2") == ("fsdp=-1,tp=2", "dp=2")
+
+
 class TestShardingRules:
     def test_logical_to_spec(self):
         mesh = make_mesh("dp=2,tp=4")
