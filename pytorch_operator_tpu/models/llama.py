@@ -52,6 +52,10 @@ class LlamaConfig:
     # "ring" (sequence-parallel ring attention over the mesh's ``sp`` axis —
     # parallel/ring.py; requires passing the mesh to the model).
     attn_impl: str = "dense"
+    # Loss implementation: "dense" ([B,S,V] logits then optax xent) or
+    # "chunked" (fused head+loss over vocab chunks — ops/chunked_xent.py;
+    # saves O(B·S·V) HBM, the dominant activation at V=128k).
+    xent_impl: str = "dense"
 
     @property
     def q_per_kv(self) -> int:
@@ -65,9 +69,11 @@ def llama3_8b(**over) -> LlamaConfig:
     materialization dominates attention HBM traffic (3.5 ms vs 75 ms dense
     fwd at S=8192 — BASELINE.md). flash_attention falls back to dense
     automatically when the tiling doesn't fit (S that doesn't divide into
-    lane/sublane-aligned blocks, or D not lane-aligned).
+    lane/sublane-aligned blocks, or D not lane-aligned). Also defaults to
+    the chunked-vocab loss: [B,S,128256] f32 logits would otherwise be the
+    single largest activation in the step.
     """
-    return LlamaConfig(**{"attn_impl": "flash", **over})
+    return LlamaConfig(**{"attn_impl": "flash", "xent_impl": "chunked", **over})
 
 
 def llama_tiny(**over) -> LlamaConfig:
@@ -241,13 +247,27 @@ class Block(nn.Module):
 
 
 class Llama(nn.Module):
-    """Decoder-only LM: tokens [B,S] int32 → logits [B,S,vocab]."""
+    """Decoder-only LM: tokens [B,S] int32 → logits [B,S,vocab].
+
+    ``return_hidden=True`` returns the final-norm hidden states [B,S,D]
+    instead of applying the LM head — the input to the chunked-vocab loss
+    (ops/chunked_xent.py), which fuses head matmul + cross-entropy without
+    materializing [B,S,V] logits. The head params exist either way.
+    """
 
     cfg: LlamaConfig
     mesh: Any = None
 
+    @staticmethod
+    def head_kernel(params):
+        """The LM-head weight [D, V] out of a params tree (unboxed) — the
+        model-owned accessor the chunked-loss trainer path uses, so head
+        naming stays out of shared infrastructure."""
+        w = params["lm_head"]["kernel"]
+        return w.unbox() if hasattr(w, "unbox") else w
+
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, return_hidden: bool = False):
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
@@ -279,12 +299,18 @@ class Llama(nn.Module):
         (x, _), _ = ScanBlocks(cfg, self.mesh, name="layers")((x, positions), None)
 
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
-        logits = nn.DenseGeneral(
+        lm_head = nn.DenseGeneral(
             cfg.vocab_size, use_bias=False,
             dtype=jnp.float32, param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("embed", "vocab")
             ),
             name="lm_head",
-        )(x)
-        return logits
+        )
+        if return_hidden:
+            if self.is_initializing():
+                # Params must exist regardless of the loss path; a 1-token
+                # slice keeps the init trace cheap.
+                lm_head(x[:, :1])
+            return x
+        return lm_head(x)

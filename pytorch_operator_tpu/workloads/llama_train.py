@@ -56,6 +56,7 @@ def run(
     max_steps: int | None = None,
     remat: bool | None = None,
     attn_impl: str | None = None,
+    xent_impl: str | None = None,
     preempt_at: int | None = None,
     profile_dir: str | None = None,
     log=print,
@@ -74,6 +75,8 @@ def run(
         over["remat"] = remat
     if attn_impl is not None:
         over["attn_impl"] = attn_impl
+    if xent_impl is not None:
+        over["xent_impl"] = xent_impl
     cfg = getattr(llama_lib, CONFIGS[config])(**over)
 
     n_dev = jax.device_count()
@@ -198,6 +201,11 @@ def main(argv=None) -> int:
         "ring = sequence-parallel over sp)",
     )
     p.add_argument(
+        "--xent", choices=("dense", "chunked"), default=None, dest="xent_impl",
+        help="loss implementation (chunked = fused head+loss over vocab "
+        "chunks, no [B,S,V] logits tensor)",
+    )
+    p.add_argument(
         "--preempt-at", type=int, default=None,
         help="fault injection: die with a retryable exit code at this step "
         "on the replica's first life (simulated TPU preemption)",
@@ -222,6 +230,7 @@ def main(argv=None) -> int:
         max_steps=args.max_steps,
         remat=True if args.remat else None,
         attn_impl=args.attn_impl,
+        xent_impl=args.xent_impl,
         preempt_at=args.preempt_at,
         profile_dir=args.profile_dir,
         log=lambda msg: print(

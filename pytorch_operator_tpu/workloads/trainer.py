@@ -56,13 +56,29 @@ def init_sharded_train_state(model_init: Callable, tx, mesh):
 
 
 def make_lm_train_step(model, tx, mesh):
-    """Next-token cross-entropy train step, jitted with donated state."""
+    """Next-token cross-entropy train step, jitted with donated state.
+
+    When the model config sets ``xent_impl="chunked"``, the LM head matmul
+    is fused into the loss via ops/chunked_xent.py — the model returns
+    hidden states and no [B,S,V] logits tensor ever exists.
+    """
     import jax
     import optax
 
     from ..parallel import activation_rules
 
+    chunked = getattr(getattr(model, "cfg", None), "xent_impl", "dense") == "chunked"
+
     def loss_fn(params, tokens):
+        if chunked:
+            from ..ops.chunked_xent import chunked_softmax_xent
+
+            with activation_rules(mesh):
+                hidden = model.apply({"params": params}, tokens, return_hidden=True)
+            # Head access goes through the model (it owns its param naming).
+            w = model.head_kernel(params)
+            h = hidden[:, :-1].reshape(-1, hidden.shape[-1])
+            return chunked_softmax_xent(h, w, tokens[:, 1:].reshape(-1)).mean()
         with activation_rules(mesh):
             logits = model.apply({"params": params}, tokens)
         return optax.softmax_cross_entropy_with_integer_labels(
