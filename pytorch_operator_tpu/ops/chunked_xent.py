@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def chunked_softmax_xent(hidden, w, labels, *, chunk: int = 16384):
+def chunked_softmax_xent(hidden, w, labels, *, chunk: int = 8192):
     """Per-token ``-log p(label)`` without materializing ``[N, V]`` logits.
 
     hidden ``[N, D]`` (bf16/f32), w ``[D, V]`` (the lm_head kernel),
@@ -113,11 +113,17 @@ def _xent_bwd(n_chunks: int, chunk: int, res, ct):
         p = jnp.exp(hidden32 @ w_c32 - lse[:, None])  # softmax chunk
         local = labels - start
         in_chunk = (labels >= c_idx * chunk) & (local < chunk)
-        onehot = (
-            jax.nn.one_hot(jnp.clip(local, 0, chunk - 1), chunk, dtype=jnp.float32)
-            * in_chunk[:, None]
+        g = p * ct32[:, None]  # [N, chunk]
+        # Label correction as a scatter-add, NOT a materialized one-hot —
+        # a second [N, chunk] buffer here is what blows peak HBM at the
+        # batch sizes this op exists for.
+        g = g.at[jnp.arange(g.shape[0]), jnp.clip(local, 0, chunk - 1)].add(
+            -ct32 * in_chunk,
+            # One update per row, rows ascending: let XLA skip the
+            # collision-safe scatter lowering.
+            unique_indices=True,
+            indices_are_sorted=True,
         )
-        g = (p - onehot) * ct32[:, None]  # [N, chunk]
         # Tail chunk: zero the already-counted columns so the overlapped
         # read-add-write below cannot double-contribute.
         g = g * _fresh_mask(start, c_idx, chunk)[None, :]
