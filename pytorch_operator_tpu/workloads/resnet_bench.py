@@ -114,6 +114,36 @@ def make_train_chunk(model, tx, chunk: int, label_smoothing: float = 0.1):
     return train_chunk
 
 
+def make_train_chunk_fed(model, tx, label_smoothing: float = 0.1):
+    """Like :func:`make_train_chunk`, but each fused step consumes its OWN
+    batch: ``bxs``/``bys`` are stacked ``[chunk, B, ...]`` and a
+    ``lax.scan`` walks them. This is the real-data path — batches come
+    from the native prefetch loader, one host transfer per chunk.
+    """
+    import functools
+
+    import jax
+
+    step = _train_step_fn(model, tx, label_smoothing)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_chunk(params, batch_stats, opt_state, bxs, bys):
+        def body(s, batch):
+            params, batch_stats, opt_state = s
+            bx, by = batch
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, bx, by
+            )
+            return (params, batch_stats, opt_state), loss
+
+        (params, batch_stats, opt_state), losses = jax.lax.scan(
+            body, (params, batch_stats, opt_state), (bxs, bys)
+        )
+        return params, batch_stats, opt_state, losses[-1]
+
+    return train_chunk
+
+
 def run_benchmark(
     *,
     depth: int = 50,
@@ -125,6 +155,7 @@ def run_benchmark(
     lr: float = 0.1,
     momentum: float = 0.9,
     windows: int = 1,
+    data_file: str | None = None,
     profile_dir: str | None = None,
     log=print,
 ) -> dict:
@@ -139,6 +170,13 @@ def run_benchmark(
     run-to-run noise (BASELINE.md), and the minimum over a few windows is
     the standard low-variance estimate of attainable throughput. All
     windows run real training steps on the same state.
+
+    ``data_file``: train from a packed array file via the native prefetch
+    loader (SURVEY.md §7 step 5's real-data path) — every fused step gets
+    its own batch (stacked per chunk, lax.scan inside one dispatch), and
+    the reported throughput INCLUDES the input pipeline. Image geometry
+    comes from the file; ``classes`` stays the caller's (validated against
+    the file's labels). The synthetic mode isolates compute.
     """
     import jax
 
@@ -148,6 +186,15 @@ def run_benchmark(
     from .datasets import synthetic_images
 
     warmup = max(warmup, 1)  # the first (compile) step can never be timed
+    meta = None
+    if data_file:
+        from ..data import read_meta
+
+        meta = read_meta(data_file)
+        field_x = next((f for f in meta.fields if f.name == "x"), meta.fields[0])
+        # ResNet params are spatial-size-independent (convs + global pool),
+        # so the file's H suffices for init; batches carry the real (H, W).
+        image_size = field_x.shape[0]
     model_cls = {
         18: resnet_lib.ResNet18,
         34: resnet_lib.ResNet34,
@@ -160,9 +207,19 @@ def run_benchmark(
     n_dev = jax.device_count()
     mesh = make_mesh({"dp": n_dev})
     batch = max(batch_size // n_dev, 1) * n_dev
+    if meta is not None and batch > meta.n_records:
+        raise ValueError(
+            f"--data-file holds {meta.n_records} records < global batch {batch}"
+        )
+    geometry = (
+        "x".join(str(s) for s in field_x.shape[:2]) + "px"
+        if meta is not None
+        else f"{image_size}px"
+    )
     log(
         f"[resnet] ResNet-{depth} on {n_dev} device(s) "
-        f"({jax.devices()[0].platform}), global batch {batch}, {image_size}px"
+        f"({jax.devices()[0].platform}), global batch {batch}, {geometry}"
+        + (f", data file {data_file}" if data_file else " (synthetic)")
     )
 
     params, batch_stats, opt_state, tx = build_train_state(
@@ -177,46 +234,101 @@ def run_benchmark(
     chunk = min(30, max(steps, 1))
     steps = math.ceil(max(steps, 1) / chunk) * chunk
     warm_chunks = max(1, round(warmup / chunk))
-    train_chunk = make_train_chunk(model, tx, chunk)
-    hx, hy = synthetic_images(batch, image_size, image_size, classes)
     # Feed bf16 pixels: the model's first op casts anyway, and a bf16 batch
     # halves the per-step HBM read of the largest activation tensor.
     import jax.numpy as jnp
+    import numpy as np
 
-    gx, gy = global_batch(hx.astype(jnp.bfloat16), mesh), global_batch(hy, mesh)
+    loader = None
+    if data_file:
+        from jax.sharding import NamedSharding, PartitionSpec
 
-    t_start = time.time()
-    for i in range(warm_chunks):
-        params, batch_stats, opt_state, loss = train_chunk(
-            params, batch_stats, opt_state, gx, gy
+        from ..data import open_loader
+        from ..parallel.data import put_global
+
+        # Multi-process gangs pin the native loader: the python fallback
+        # shuffles with a different RNG, and divergent per-rank orders
+        # would silently corrupt assembled global batches (same guard as
+        # mnist_train).
+        loader = open_loader(
+            data_file, batch, seed=0,
+            native=True if jax.process_count() > 1 else None,
         )
-        if i == 0:
-            float(jax.device_get(loss))
-            rendezvous.report_first_step(0)
-            log(
-                f"[resnet] first chunk ({chunk} steps, compile) "
-                f"+{time.time() - t_start:.1f}s"
+        x_sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
+        _, _, first = loader.next_batch()
+        if int(first["y"].max()) >= classes:
+            loader.close()
+            raise ValueError(
+                f"--data-file labels reach {int(first['y'].max())} but the "
+                f"model head has {classes} classes (pass --classes)"
             )
-    float(jax.device_get(loss))
 
-    from .trainer import maybe_profile
+        def next_batches():
+            """chunk loader batches stacked [chunk, B, ...], one transfer.
 
-    if profile_dir and windows > 1:
-        # The trace must show the run the reported number comes from; with
-        # a min-over-windows estimator it wouldn't, so profile one window.
-        log("[resnet] --profile-dir set: timing a single window")
-        windows = 1
-    with maybe_profile(profile_dir, lambda m: log(f"[resnet] {m}")):
-        dt = math.inf
-        for _ in range(max(windows, 1)):
-            t0 = time.time()
-            for _ in range(steps // chunk):
-                params, batch_stats, opt_state, loss = train_chunk(
-                    params, batch_stats, opt_state, gx, gy
+            The loader hands out zero-copy views into a slot it reuses on
+            the next call — everything stashed across calls MUST be copied
+            (astype always copies here since the file is f32, y via
+            .copy()).
+            """
+            xs, ys = [], []
+            for _ in range(chunk):
+                _, _, fields = loader.next_batch()
+                xs.append(fields["x"].astype(jnp.bfloat16))
+                ys.append(fields["y"].copy())
+            return (
+                put_global(np.stack(xs), x_sh),
+                put_global(np.stack(ys), x_sh),
+            )
+
+        train_chunk = make_train_chunk_fed(model, tx)
+    else:
+        train_chunk = make_train_chunk(model, tx, chunk)
+        hx, hy = synthetic_images(batch, image_size, image_size, classes)
+        gx, gy = global_batch(hx.astype(jnp.bfloat16), mesh), global_batch(hy, mesh)
+
+        def next_batches():
+            return gx, gy
+
+    try:
+        t_start = time.time()
+        for i in range(warm_chunks):
+            bx, by = next_batches()
+            params, batch_stats, opt_state, loss = train_chunk(
+                params, batch_stats, opt_state, bx, by
+            )
+            if i == 0:
+                float(jax.device_get(loss))
+                rendezvous.report_first_step(0)
+                log(
+                    f"[resnet] first chunk ({chunk} steps, compile) "
+                    f"+{time.time() - t_start:.1f}s"
                 )
-            final_loss = float(jax.device_get(loss))
-            # dt is taken here, before stop_trace() flushes the trace.
-            dt = min(dt, time.time() - t0)
+        float(jax.device_get(loss))
+
+        from .trainer import maybe_profile
+
+        if profile_dir and windows > 1:
+            # The trace must show the run the reported number comes from;
+            # with a min-over-windows estimator it wouldn't, so profile one
+            # window.
+            log("[resnet] --profile-dir set: timing a single window")
+            windows = 1
+        with maybe_profile(profile_dir, lambda m: log(f"[resnet] {m}")):
+            dt = math.inf
+            for _ in range(max(windows, 1)):
+                t0 = time.time()
+                for _ in range(steps // chunk):
+                    bx, by = next_batches()
+                    params, batch_stats, opt_state, loss = train_chunk(
+                        params, batch_stats, opt_state, bx, by
+                    )
+                final_loss = float(jax.device_get(loss))
+                # dt is taken here, before stop_trace() flushes the trace.
+                dt = min(dt, time.time() - t0)
+    finally:
+        if loader is not None:
+            loader.close()
 
     images_per_sec = batch * steps / dt
     per_chip = images_per_sec / n_dev
@@ -238,6 +350,7 @@ def run_benchmark(
         "global_batch": batch,
         "devices": n_dev,
         "final_loss": round(final_loss, 4),
+        "input": "file" if data_file else "synthetic",
     }
 
 
@@ -254,6 +367,12 @@ def main(argv=None) -> int:
     p.add_argument(
         "--windows", type=int, default=1,
         help="time this many windows of --steps and report the fastest",
+    )
+    p.add_argument(
+        "--data-file", default=None,
+        help="train from a packed array file via the native prefetch loader "
+        "(real-data mode; see pytorch_operator_tpu.data.pack). Throughput "
+        "then includes the input pipeline.",
     )
     p.add_argument(
         "--profile-dir", default=None,
@@ -273,6 +392,7 @@ def main(argv=None) -> int:
         lr=args.lr,
         momentum=args.momentum,
         windows=args.windows,
+        data_file=args.data_file,
         profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"

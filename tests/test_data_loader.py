@@ -135,6 +135,25 @@ class TestNativeSpecifics:
         with pytest.raises(LoaderUnavailable, match="open failed"):
             NativeLoader(path, batch=128)
 
+    def test_stashed_batches_keep_image_label_pairing(self, packed):
+        """The resnet --data-file path stashes ``chunk`` batches before
+        stacking. Slots are reused after ``prefetch`` calls, so stashing
+        works ONLY with copies (x via astype, y via .copy()) — this guards
+        that idiom against silent image/label mismatch."""
+        path, meta, x, y = packed
+        ld = _loader(path, True, batch=8, shuffle=True, seed=2, prefetch=3)
+        try:
+            xs, ys = [], []
+            for _ in range(6):  # > prefetch: slots recycle under our feet
+                _, _, fields = ld.next_batch()
+                xs.append(fields["x"].astype(np.float32))
+                ys.append(fields["y"].copy())
+            for bx, by in zip(np.stack(xs), np.stack(ys)):
+                for row, label in zip(bx, by):
+                    np.testing.assert_array_equal(row, x[label])
+        finally:
+            ld.close()
+
     def test_prefetch_overlaps(self, packed):
         """The producer fills the ring while the consumer is idle."""
         import time

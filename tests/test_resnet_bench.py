@@ -64,6 +64,62 @@ class TestBench:
         assert result["unit"] == "images/sec/chip"
 
 
+class TestDataFileMode:
+    def test_trains_from_packed_file(self, tmp_path):
+        """Real-data path: distinct per-step batches from the native
+        prefetch loader, scanned inside one dispatch."""
+        from pytorch_operator_tpu.data.pack import main as pack_main
+        from pytorch_operator_tpu.workloads.resnet_bench import run_benchmark
+
+        out = tmp_path / "syn.bin"
+        assert pack_main([
+            "--out", str(out), "--dataset", "synthetic",
+            "--n", "64", "--height", "32", "--width", "32", "--classes", "10",
+        ]) == 0
+        result = run_benchmark(
+            depth=18,
+            batch_size=16,
+            classes=10,
+            steps=4,
+            warmup=2,
+            data_file=str(out),
+            log=lambda *_: None,
+        )
+        assert result["input"] == "file"
+        assert np.isfinite(result["final_loss"])
+        assert result["value"] > 0
+
+    def test_labels_exceeding_classes_rejected(self, tmp_path):
+        from pytorch_operator_tpu.data.pack import main as pack_main
+        from pytorch_operator_tpu.workloads.resnet_bench import run_benchmark
+
+        out = tmp_path / "syn.bin"
+        pack_main([
+            "--out", str(out), "--dataset", "synthetic",
+            "--n", "32", "--height", "16", "--width", "16", "--classes", "10",
+        ])
+        with pytest.raises(ValueError, match="classes"):
+            run_benchmark(
+                depth=18, batch_size=16, classes=4, steps=2, warmup=1,
+                data_file=str(out), log=lambda *_: None,
+            )
+
+    def test_file_smaller_than_batch_rejected(self, tmp_path):
+        from pytorch_operator_tpu.data.pack import main as pack_main
+        from pytorch_operator_tpu.workloads.resnet_bench import run_benchmark
+
+        out = tmp_path / "tiny.bin"
+        pack_main([
+            "--out", str(out), "--dataset", "synthetic",
+            "--n", "8", "--height", "16", "--width", "16",
+        ])
+        with pytest.raises(ValueError, match="records < global batch"):
+            run_benchmark(
+                depth=18, batch_size=64, steps=2, warmup=1,
+                data_file=str(out), log=lambda *_: None,
+            )
+
+
 class TestProfileTrace:
     def test_profile_dir_writes_trace(self, tmp_path):
         from pytorch_operator_tpu.workloads.resnet_bench import run_benchmark
