@@ -53,6 +53,7 @@ def run(
     warmup: int = 2,
     lr: float = 3e-4,
     checkpoint_every: int = 0,
+    async_checkpoint: bool = False,
     max_steps: int | None = None,
     remat: bool | None = None,
     attn_impl: str | None = None,
@@ -149,7 +150,16 @@ def run(
             device_get=lambda x: jax.device_get(x),
             on_first_step=on_first,
             checkpoint_every=checkpoint_every,
-            save=(lambda s, st: mgr.save(s, st)) if mgr is not None else None,
+            # Async saves overlap the orbax write with the next training
+            # steps (the step fn does not donate state, so the buffers stay
+            # valid); mgr.close()/the final save below still commit
+            # everything before exit. Blocking is the default — preemption
+            # tests need the just-saved step to be durable.
+            save=(
+                (lambda s, st: mgr.save(s, st, block=not async_checkpoint))
+                if mgr is not None
+                else None
+            ),
             start_step=start_step,
             log=lambda m: log(f"[llama] {m}"),
             profile_dir=profile_dir,
@@ -193,6 +203,12 @@ def main(argv=None) -> int:
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument(
+        "--async-checkpoint", action="store_true",
+        help="overlap orbax saves with training (committed by job end; a "
+        "preemption may lose the in-flight save and resume one interval "
+        "earlier)",
+    )
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument("--remat", action="store_true")
     p.add_argument(
@@ -227,6 +243,7 @@ def main(argv=None) -> int:
         warmup=args.warmup,
         lr=args.lr,
         checkpoint_every=args.checkpoint_every,
+        async_checkpoint=args.async_checkpoint,
         max_steps=args.max_steps,
         remat=True if args.remat else None,
         attn_impl=args.attn_impl,

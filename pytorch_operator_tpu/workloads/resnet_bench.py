@@ -191,7 +191,14 @@ def run_benchmark(
         from ..data import read_meta
 
         meta = read_meta(data_file)
-        field_x = next((f for f in meta.fields if f.name == "x"), meta.fields[0])
+        names = [f.name for f in meta.fields]
+        if "x" not in names or "y" not in names:
+            raise ValueError(
+                f"--data-file needs fields named 'x' (images) and 'y' "
+                f"(labels); {data_file} has {names} "
+                f"(pack with pytorch_operator_tpu.data.pack)"
+            )
+        field_x = next(f for f in meta.fields if f.name == "x")
         # ResNet params are spatial-size-independent (convs + global pool),
         # so the file's H suffices for init; batches carry the real (H, W).
         image_size = field_x.shape[0]
@@ -267,19 +274,17 @@ def run_benchmark(
             """chunk loader batches stacked [chunk, B, ...], one transfer.
 
             The loader hands out zero-copy views into a slot it reuses on
-            the next call — everything stashed across calls MUST be copied
-            (astype always copies here since the file is f32, y via
-            .copy()).
+            the next call, so stashed data MUST be copied out — done here
+            by assigning into preallocated stacks (one cast/copy pass, no
+            second np.stack copy; this path is already input-bound).
             """
-            xs, ys = [], []
-            for _ in range(chunk):
+            sx = np.empty((chunk, batch) + field_x.shape, jnp.bfloat16)
+            sy = np.empty((chunk, batch), np.int32)
+            for i in range(chunk):
                 _, _, fields = loader.next_batch()
-                xs.append(fields["x"].astype(jnp.bfloat16))
-                ys.append(fields["y"].copy())
-            return (
-                put_global(np.stack(xs), x_sh),
-                put_global(np.stack(ys), x_sh),
-            )
+                sx[i] = fields["x"]  # casts f32 → bf16 in place
+                sy[i] = fields["y"]
+            return put_global(sx, x_sh), put_global(sy, x_sh)
 
         train_chunk = make_train_chunk_fed(model, tx)
     else:

@@ -56,7 +56,13 @@ def init_sharded_train_state(model_init: Callable, tx, mesh):
 
 
 def make_lm_train_step(model, tx, mesh):
-    """Next-token cross-entropy train step, jitted with donated state.
+    """Next-token cross-entropy train step, jitted WITHOUT state donation.
+
+    Keep it donation-free: async checkpointing (llama_train
+    --async-checkpoint) hands the returned state to an in-flight orbax
+    save while the next step runs — donated buffers would be invalidated
+    under the save. (XLA still updates params efficiently; donation here
+    buys little for the LM workloads.)
 
     When the model config sets ``xent_impl="chunked"``, the LM head matmul
     is fused into the loss via ops/chunked_xent.py — the model returns

@@ -67,6 +67,25 @@ def test_llama_checkpoint_resume(tmp_path, monkeypatch):
     assert r2["end_step"] == r1["end_step"] + 5  # warmup(1) + steps(4)
 
 
+def test_llama_async_checkpoint_resume(tmp_path, monkeypatch):
+    """Async saves must still be durable by job end (mgr.close commits),
+    so a follow-up run resumes exactly like the blocking path."""
+    monkeypatch.setenv("TPUJOB_CHECKPOINT_DIR", str(tmp_path / "ck"))
+    r1 = llama_train.run(
+        config="tiny", mesh_spec="fsdp=8", batch_size=8, seq_len=32,
+        steps=4, warmup=1, checkpoint_every=3, async_checkpoint=True,
+        log=lambda *_: None,
+    )
+    logs = []
+    r2 = llama_train.run(
+        config="tiny", mesh_spec="fsdp=8", batch_size=8, seq_len=32,
+        steps=4, warmup=1, checkpoint_every=3, async_checkpoint=True,
+        log=logs.append,
+    )
+    assert any("resumed from checkpoint" in m for m in logs), logs
+    assert r2["end_step"] == r1["end_step"] + 5
+
+
 def test_llama_max_steps_caps_work(tmp_path, monkeypatch):
     monkeypatch.setenv("TPUJOB_CHECKPOINT_DIR", str(tmp_path / "ck"))
     r1 = llama_train.run(
