@@ -315,11 +315,47 @@ def cmd_logs(args) -> int:
         if not paths:
             print(f"error: no logs found for tpujob {key}", file=sys.stderr)
             return 1
-    for p in paths:
-        if len(paths) > 1:
-            print(f"==> {p.name} <==")
-        sys.stdout.write(p.read_text(errors="replace"))
-    return 0
+    if not args.follow:
+        for p in paths:
+            if len(paths) > 1:
+                print(f"==> {p.name} <==")
+            sys.stdout.write(p.read_text(errors="replace"))
+        return 0
+
+    # kubectl logs -f analog: one incremental read pass, repeated until the
+    # job record is finished OR gone (deleted / TTL-GC'd mid-follow). The
+    # finished check runs BEFORE the pass so the last pass drains output
+    # written right up to the finish. New replicas appearing mid-follow
+    # (restarts) are picked up by the glob.
+    store = JobStore(persist_dir=state / "jobs")
+    offsets: dict = {}
+
+    def read_pass() -> None:
+        for p in sorted(log_dir.glob(f"{prefix}-*.log")):
+            if args.replica and not p.name.endswith(f"-{args.replica}.log"):
+                continue
+            off = offsets.get(p, 0)
+            try:
+                with p.open("rb") as f:
+                    f.seek(off)
+                    data = f.read()
+            except OSError:
+                continue  # purged under us — nothing more to print
+            if data:
+                sys.stdout.write(data.decode(errors="replace"))
+                sys.stdout.flush()
+                offsets[p] = off + len(data)
+
+    try:
+        while True:
+            job = store.reload(key)
+            finished = job is None or job.is_finished()
+            read_pass()
+            if finished:
+                return 0
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_delete(args) -> int:
@@ -431,6 +467,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("logs", help="print replica logs")
     sp.add_argument("name")
     sp.add_argument("--replica", default=None, help="e.g. master-0, worker-1")
+    sp.add_argument(
+        "-f", "--follow", action="store_true",
+        help="stream new log output until the job finishes",
+    )
     add_ns(sp)
     sp.set_defaults(func=cmd_logs)
 

@@ -120,6 +120,28 @@ class JobStore:
                     new_keys.append(key)
         return new_keys
 
+    def reload(self, key: str) -> Optional[TPUJob]:
+        """Re-read one job's record from disk, replacing the cached object.
+
+        For READ-ONLY observers (``tpujob logs -f`` polling a job another
+        process owns) — an owning supervisor must never call this, its
+        in-memory object is the authority. Returns None (and drops the
+        cache entry) when the file is gone.
+        """
+        if self.persist_dir is None:
+            return self.get(key)
+        p = self.persist_dir / (key.replace("/", "_") + ".json")
+        with self._lock:
+            try:
+                job = TPUJob.from_dict(json.loads(p.read_text()))
+            except OSError:
+                self._jobs.pop(key, None)
+                return None
+            except (ValueError, KeyError):
+                return self._jobs.get(key)
+            self._jobs[key] = job
+            return job
+
     def _marker_path(self, key: str, kind: str) -> Path:
         return self.persist_dir / (key.replace("/", "_") + "." + kind)
 

@@ -59,6 +59,46 @@ class TestCLI:
         rc = run_cli("--state-dir", state, "get", "cli-job")
         assert rc == 1
 
+    def test_logs_follow_streams_until_finish(self, tmp_path, capsys):
+        """kubectl logs -f analog: stream output of a live job, return when
+        it finishes."""
+        import sys as _sys
+        import threading
+
+        from pytorch_operator_tpu.api import load_job
+        from pytorch_operator_tpu.controller.supervisor import Supervisor
+
+        state = tmp_path / "state"
+        spec = tmp_path / "slow.yaml"
+        spec.write_text(
+            f"""
+metadata: {{name: slowjob}}
+spec:
+  replica_specs:
+    Master:
+      template:
+        command: [{_sys.executable!r}, "-c", "import time; print('early', flush=True); time.sleep(2); print('late', flush=True)"]
+"""
+        )
+        sup = Supervisor(state_dir=state)
+        t = threading.Thread(target=lambda: sup.run(load_job(spec), timeout=60))
+        t.start()
+        try:
+            # Wait for the log file to exist, then follow it to completion.
+            import time as _time
+
+            deadline = _time.time() + 30
+            while not list((state / "logs").glob("*.log")):
+                assert _time.time() < deadline, "job never started"
+                _time.sleep(0.1)
+            rc = run_cli("--state-dir", state, "logs", "slowjob", "--follow")
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "early" in out and "late" in out
+        finally:
+            t.join(timeout=30)
+            sup.shutdown()
+
     def test_run_invalid_spec(self, tmp_path, capsys):
         bad = tmp_path / "bad.yaml"
         bad.write_text("metadata: {name: bad}\nspec: {replica_specs: {Worker: {template: {module: m}}}}\n")
