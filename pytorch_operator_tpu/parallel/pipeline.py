@@ -1,0 +1,123 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+Reference parity note: the reference has no pipeline support at all
+(SURVEY.md §2 parallelism table) — this is beyond-parity, completing the
+mesh-axis vocabulary (dp/fsdp/tp/sp/ep/pp) with an executable pp path.
+
+TPU-first design: no per-stage processes or NCCL send/recv. The whole
+pipeline is ONE jitted SPMD program under ``shard_map``: every stage holds
+its slice of the layer-stacked params (leading axis sharded over ``pp``),
+a ``lax.scan`` walks the M + P - 1 schedule ticks, and activations hop to
+the next stage with ``lax.ppermute`` riding ICI. Autodiff through the scan
++ ppermute yields the reverse pipeline schedule for free (ppermute's
+transpose is the reverse rotation), so backward needs no hand scheduling.
+
+The bubble fraction is the textbook (P-1)/(M+P-1) — raise ``microbatches``
+to amortize. Stages compute on every tick (bubble ticks process garbage
+that is masked out), which keeps the program shape static for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+def pipeline_apply(
+    fn: Callable,
+    stage_params,
+    x,
+    *,
+    mesh,
+    microbatches: int,
+    axis: str = "pp",
+):
+    """Run ``y = fn(params_P-1, fn(..., fn(params_0, x)))`` as a pipeline.
+
+    ``stage_params``: pytree whose leaves have leading axis P (one slice
+    per stage) — the layout ``nn.scan``-stacked layer params already have.
+    ``fn(params_slice, act) -> act`` is one stage's computation and must
+    preserve the activation shape (transformer-block style).
+    ``x``: the global batch ``[B, ...]``; ``B % microbatches == 0``.
+    Returns the pipeline output, replicated over the ``pp`` axis.
+
+    Pure and composable: call it under your own ``jit``/``grad`` (inputs
+    are resharded to the pipeline layout by the surrounding jit; autodiff
+    produces the reverse pipeline schedule).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    n_stages = mesh.shape[axis]
+    M = microbatches
+    B = x.shape[0]
+    if M < 1:
+        raise ValueError("microbatches must be >= 1")
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stage_params leading axes {leading} != pp extent {n_stages}"
+        )
+
+    # Params: leading (stage) axis sharded over pp; activations replicated
+    # across pp (each stage sees the full microbatch stream, uses its turn).
+    param_spec = jax.tree.map(lambda _: P(axis), stage_params)
+
+    def per_stage(params_local, x_local):
+        # params_local leaves: [1, ...] (this stage's slice).
+        params_local = jax.tree.map(lambda l: l[0], params_local)
+        s = jax.lax.axis_index(axis)
+        xm = x_local.reshape((M, B // M) + x_local.shape[1:])
+        zero_mb = jnp.zeros_like(xm[0])
+
+        def tick(carry, t):
+            act_in, outs = carry
+            # Stage 0 ingests microbatch t (drain ticks t >= M reuse the
+            # last microbatch; their outputs never reach the valid output
+            # window); later stages take the handoff.
+            mb = jax.lax.dynamic_index_in_dim(
+                xm, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            inp = jnp.where(s == 0, mb, act_in)
+            y = fn(params_local, inp)
+            # The last stage emits microbatch t-(P-1) on tick t.
+            out_idx = t - (n_stages - 1)
+            valid = (s == n_stages - 1) & (out_idx >= 0)
+            safe_idx = jnp.clip(out_idx, 0, M - 1)
+            current = jax.lax.dynamic_index_in_dim(
+                outs, safe_idx, 0, keepdims=False
+            )
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(valid, y, current), safe_idx, 0
+            )
+            # Rotate activations one stage forward around the ring.
+            act_next = jax.lax.ppermute(
+                y, axis, [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            )
+            return (act_next, outs), None
+
+        # The carry becomes pp-varying after the first tick (axis_index /
+        # ppermute); mark the zero-initialized carry varying up front so
+        # scan's carry types line up.
+        init = jax.tree.map(
+            lambda a: jax.lax.pcast(a, (axis,), to="varying"),
+            (zero_mb, jnp.zeros_like(xm)),
+        )
+        (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(M + n_stages - 1))
+        # Only the last stage holds real outputs; zero-mask + psum
+        # replicates them to every stage (loss code runs everywhere).
+        outs = jax.lax.psum(
+            jnp.where(s == n_stages - 1, outs, jnp.zeros_like(outs)), axis
+        )
+        return outs.reshape(x_local.shape)
+
+    return shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(param_spec, P()),
+        out_specs=P(),
+    )(stage_params, x)
