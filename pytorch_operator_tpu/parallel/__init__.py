@@ -34,6 +34,7 @@ from .data import (  # noqa: F401
     put_global,
     shard_batch_size,
 )
+from .moe import moe_mlp  # noqa: F401
 from .pipeline import pipeline_apply  # noqa: F401
 from .ring import (  # noqa: F401
     ring_attention_shard,

@@ -1,0 +1,81 @@
+"""Expert-parallel Mixture-of-Experts MLP over the ``ep`` mesh axis.
+
+Reference parity note: absent from the reference (SURVEY.md §2 parallelism
+table) — beyond-parity, completing the mesh-axis vocabulary with an
+executable ``ep`` path (dp/fsdp/tp/sp/pp are covered elsewhere).
+
+TPU-first design: experts live sharded over ``ep`` (each device owns
+``E / ep`` experts' FFN weights) inside one ``shard_map`` program. Routing
+is the dense-dispatch formulation: every device runs its local experts
+over the full token batch and scales each token's output by its gate
+weight for that expert (zero for unrouted tokens), then a single ``psum``
+over ``ep`` combines expert contributions. No gather/scatter of tokens,
+no capacity factors, no dropped tokens — compute per device scales with
+local expert count, and the only collective is one psum riding ICI.
+(A capacity-based sparse dispatch trades exactness for FLOPs; this layer
+prioritizes exactness and XLA-friendly static shapes.)
+"""
+
+from __future__ import annotations
+
+
+def moe_mlp(
+    params,
+    x,
+    *,
+    mesh,
+    top_k: int = 2,
+    axis: str = "ep",
+):
+    """Top-k gated MoE feed-forward. x ``[N, D]`` → ``[N, D]``.
+
+    ``params``::
+
+        {"gate": [D, E],                      # router (replicated)
+         "w_in": [E, D, F], "w_out": [E, F, D]}  # experts (sharded over ep)
+
+    Gate probabilities are softmax over the top-k experts per token
+    (standard renormalized top-k routing); expert FFN is gelu.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_exp = params["w_in"].shape[0]
+    ep = mesh.shape[axis]
+    if n_exp % ep:
+        raise ValueError(f"experts {n_exp} not divisible by ep={ep}")
+    if not (1 <= top_k <= n_exp):
+        raise ValueError(f"top_k={top_k} outside [1, {n_exp}]")
+
+    # Router runs replicated (it is tiny); per-token weights for every
+    # expert, zero for experts outside the token's top-k.
+    logits = x.astype(jnp.float32) @ params["gate"].astype(jnp.float32)  # [N, E]
+    top_vals, top_idx = jax.lax.top_k(logits, top_k)
+    probs = jax.nn.softmax(top_vals, axis=-1)  # renormalized over the top-k
+    gates = jnp.zeros_like(logits)
+    gates = jnp.put_along_axis(gates, top_idx, probs, axis=-1, inplace=False)
+
+    param_spec = {"gate": P(), "w_in": P(axis), "w_out": P(axis)}
+
+    def per_shard(params_local, gates_local, x_local):
+        # Local experts: [E/ep, D, F]; this shard's slice of the gate
+        # matrix columns.
+        e_local = params_local["w_in"].shape[0]
+        shard = jax.lax.axis_index(axis)
+        g = jax.lax.dynamic_slice_in_dim(
+            gates_local, shard * e_local, e_local, axis=1
+        )  # [N, E/ep]
+        h = jnp.einsum("nd,edf->enf", x_local, params_local["w_in"])
+        h = jax.nn.gelu(h)
+        y = jnp.einsum("enf,efd->end", h, params_local["w_out"])
+        out = jnp.einsum("end,ne->nd", y, g.astype(y.dtype))
+        return jax.lax.psum(out, axis)
+
+    return shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(param_spec, P(), P()),
+        out_specs=P(),
+    )(params, gates, x)
