@@ -56,6 +56,11 @@ class LlamaConfig:
     # "chunked" (fused head+loss over vocab chunks — ops/chunked_xent.py;
     # saves O(B·S·V) HBM, the dominant activation at V=128k).
     xent_impl: str = "dense"
+    # Mixture-of-experts: n_experts > 0 replaces the dense SwiGLU MLP with
+    # a top-k gated gelu MoE whose experts shard over the mesh's ``ep``
+    # axis (parallel/moe.py); 0 = dense.
+    n_experts: int = 0
+    moe_top_k: int = 2
 
     @property
     def q_per_kv(self) -> int:
@@ -226,6 +231,60 @@ class MLP(nn.Module):
         )(h)
 
 
+class MoEMLP(nn.Module):
+    """Expert-parallel top-k MoE feed-forward (parallel/moe.py dispatch).
+
+    Experts shard over the mesh's ``ep`` axis via the ``expert`` logical
+    annotation; without a mesh (or with ep extent 1) the dense reference
+    runs — same math, no shard_map.
+    """
+
+    cfg: LlamaConfig
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        from ..parallel.moe import moe_mlp, moe_mlp_reference
+
+        cfg = self.cfg
+        E, D, F = cfg.n_experts, cfg.d_model, cfg.d_ff
+        gate = self.param(
+            "gate",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", None)
+            ),
+            (D, E),
+            cfg.param_dtype,
+        )
+        w_in = self.param(
+            "w_in",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "embed", "mlp")
+            ),
+            (E, D, F),
+            cfg.param_dtype,
+        )
+        w_out = self.param(
+            "w_out",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("expert", "mlp", "embed")
+            ),
+            (E, F, D),
+            cfg.param_dtype,
+        )
+        params = {
+            "gate": gate,
+            "w_in": w_in.astype(cfg.dtype),
+            "w_out": w_out.astype(cfg.dtype),
+        }
+        x2d = x.reshape(-1, D)
+        if self.mesh is not None and self.mesh.shape.get("ep", 1) > 1:
+            out = moe_mlp(params, x2d, mesh=self.mesh, top_k=cfg.moe_top_k)
+        else:
+            out = moe_mlp_reference(params, x2d, top_k=cfg.moe_top_k)
+        return out.reshape(x.shape).astype(x.dtype)
+
+
 class Block(nn.Module):
     """Pre-norm decoder block; carries (hidden, positions) through scan."""
 
@@ -239,9 +298,11 @@ class Block(nn.Module):
         x = x + Attention(self.cfg, self.mesh, name="attn")(
             RMSNorm(self.cfg.rms_eps, name="attn_norm")(x), positions
         )
-        x = x + MLP(self.cfg, name="mlp")(
-            RMSNorm(self.cfg.rms_eps, name="mlp_norm")(x)
-        )
+        if self.cfg.n_experts > 0:
+            mlp = MoEMLP(self.cfg, self.mesh, name="moe_mlp")
+        else:
+            mlp = MLP(self.cfg, name="mlp")
+        x = x + mlp(RMSNorm(self.cfg.rms_eps, name="mlp_norm")(x))
         x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
         return (x, positions), None
 

@@ -19,6 +19,40 @@ prioritizes exactness and XLA-friendly static shapes.)
 from __future__ import annotations
 
 
+def _gates(params, x, top_k: int):
+    """Per-token dense gate weights [N, E]: softmax over the top-k experts
+    (renormalized top-k routing), zero elsewhere. Router math in f32."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = x.astype(jnp.float32) @ params["gate"].astype(jnp.float32)
+    top_vals, top_idx = jax.lax.top_k(logits, top_k)
+    probs = jax.nn.softmax(top_vals, axis=-1)
+    gates = jnp.zeros_like(logits)
+    return jnp.put_along_axis(gates, top_idx, probs, axis=-1, inplace=False)
+
+
+def _expert_ffn(w_in, w_out, gates, x):
+    """Gated gelu FFN over an expert block: [E?, D, F] weights, [N, E?]
+    gates → [N, D]. The shared compute of the sharded and dense paths."""
+    import jax
+    import jax.numpy as jnp
+
+    h = jax.nn.gelu(jnp.einsum("nd,edf->enf", x, w_in))
+    y = jnp.einsum("enf,efd->end", h, w_out)
+    return jnp.einsum("end,ne->nd", y, gates.astype(y.dtype))
+
+
+def moe_mlp_reference(params, x, *, top_k: int = 2):
+    """Unsharded dense MoE — the single-device reference/fallback."""
+    n_exp = params["w_in"].shape[0]
+    if not (1 <= top_k <= n_exp):
+        raise ValueError(f"top_k={top_k} outside [1, {n_exp}]")
+    return _expert_ffn(
+        params["w_in"], params["w_out"], _gates(params, x, top_k), x
+    )
+
+
 def moe_mlp(
     params,
     x,
@@ -38,7 +72,6 @@ def moe_mlp(
     (standard renormalized top-k routing); expert FFN is gelu.
     """
     import jax
-    import jax.numpy as jnp
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -49,15 +82,22 @@ def moe_mlp(
     if not (1 <= top_k <= n_exp):
         raise ValueError(f"top_k={top_k} outside [1, {n_exp}]")
 
-    # Router runs replicated (it is tiny); per-token weights for every
-    # expert, zero for experts outside the token's top-k.
-    logits = x.astype(jnp.float32) @ params["gate"].astype(jnp.float32)  # [N, E]
-    top_vals, top_idx = jax.lax.top_k(logits, top_k)
-    probs = jax.nn.softmax(top_vals, axis=-1)  # renormalized over the top-k
-    gates = jnp.zeros_like(logits)
-    gates = jnp.put_along_axis(gates, top_idx, probs, axis=-1, inplace=False)
-
+    # Router runs replicated (it is tiny).
+    gates = _gates(params, x, top_k)
     param_spec = {"gate": P(), "w_in": P(axis), "w_out": P(axis)}
+    # Composition with data parallelism: keep tokens sharded over present
+    # batch axes (each (dp, ep) device computes its token rows × its local
+    # experts) instead of replicating the batch into every ep shard.
+    batch_axes = tuple(
+        a for a in ("dp", "fsdp") if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+    n_rows = 1
+    for a in batch_axes:
+        n_rows *= mesh.shape[a]
+    if batch_axes and x.shape[0] % n_rows == 0:
+        tok_spec = P(batch_axes)
+    else:
+        tok_spec = P()
 
     def per_shard(params_local, gates_local, x_local):
         # Local experts: [E/ep, D, F]; this shard's slice of the gate
@@ -66,16 +106,13 @@ def moe_mlp(
         shard = jax.lax.axis_index(axis)
         g = jax.lax.dynamic_slice_in_dim(
             gates_local, shard * e_local, e_local, axis=1
-        )  # [N, E/ep]
-        h = jnp.einsum("nd,edf->enf", x_local, params_local["w_in"])
-        h = jax.nn.gelu(h)
-        y = jnp.einsum("enf,efd->end", h, params_local["w_out"])
-        out = jnp.einsum("end,ne->nd", y, g.astype(y.dtype))
+        )  # [N_local, E/ep]
+        out = _expert_ffn(params_local["w_in"], params_local["w_out"], g, x_local)
         return jax.lax.psum(out, axis)
 
     return shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(param_spec, P(), P()),
-        out_specs=P(),
+        in_specs=(param_spec, tok_spec, tok_spec),
+        out_specs=tok_spec,
     )(params, gates, x)

@@ -58,6 +58,7 @@ def run(
     remat: bool | None = None,
     attn_impl: str | None = None,
     xent_impl: str | None = None,
+    n_experts: int | None = None,
     preempt_at: int | None = None,
     profile_dir: str | None = None,
     log=print,
@@ -78,13 +79,22 @@ def run(
         over["attn_impl"] = attn_impl
     if xent_impl is not None:
         over["xent_impl"] = xent_impl
+    if n_experts is not None:
+        over["n_experts"] = n_experts
     cfg = getattr(llama_lib, CONFIGS[config])(**over)
 
     n_dev = jax.device_count()
     import os
 
     mesh = make_mesh(mesh_spec or os.environ.get("TPUJOB_MESH", "fsdp=-1"))
-    # The model only consults the mesh for sequence-parallel (ring) attention.
+    # The model consults the mesh for ring attention (sp axis) and MoE
+    # expert dispatch (ep axis).
+    if cfg.n_experts > 0 and mesh.shape.get("ep", 1) <= 1:
+        log(
+            f"[llama] WARNING: n_experts={cfg.n_experts} but the mesh has no "
+            f"ep axis — experts run replicated on every device (dense "
+            f'fallback). Use e.g. --mesh "dp=2,ep={cfg.n_experts}".'
+        )
     model = llama_lib.Llama(cfg, mesh=mesh)
     batch = max(batch_size // n_dev, 1) * n_dev if batch_size % n_dev else batch_size
     log(
@@ -222,6 +232,12 @@ def main(argv=None) -> int:
         "chunks, no [B,S,V] logits tensor)",
     )
     p.add_argument(
+        "--experts", type=int, default=None, dest="n_experts",
+        help="mixture-of-experts MLP with this many experts, sharded over "
+        "the mesh's ep axis (falls back to replicated dense compute, with "
+        "a warning, when the mesh has no ep axis); default dense SwiGLU",
+    )
+    p.add_argument(
         "--preempt-at", type=int, default=None,
         help="fault injection: die with a retryable exit code at this step "
         "on the replica's first life (simulated TPU preemption)",
@@ -248,6 +264,7 @@ def main(argv=None) -> int:
         remat=True if args.remat else None,
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,
+        n_experts=args.n_experts,
         preempt_at=args.preempt_at,
         profile_dir=args.profile_dir,
         log=lambda msg: print(

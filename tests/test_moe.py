@@ -80,6 +80,38 @@ class TestMoE:
         ref = _reference(params, x, 1)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
+    def test_llama_moe_ep_matches_dense_fallback(self):
+        """The MoE llama on an ep mesh must compute exactly what the same
+        params compute through the meshless dense-reference path."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_operator_tpu.models import llama as llama_lib
+
+        cfg = llama_lib.llama_tiny(n_experts=4, moe_top_k=2)
+        mesh = make_mesh("ep=4", devices=jax.devices()[:4])
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, (2, 16)), jnp.int32
+        )
+        model_ep = llama_lib.Llama(cfg, mesh=mesh)
+        variables = model_ep.init(jax.random.key(0), tokens)
+        out_ep = model_ep.apply(variables, tokens)
+        out_ref = llama_lib.Llama(cfg).apply(variables, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out_ep), np.asarray(out_ref), rtol=2e-2, atol=2e-2
+        )
+
+    def test_llama_moe_trains(self):
+        """End-to-end: MoE llama trains through the shared trainer on an
+        ep-bearing mesh; loss decreases from chance."""
+        from pytorch_operator_tpu.workloads import llama_train
+
+        result = llama_train.run(
+            config="tiny", mesh_spec="dp=2,ep=4", batch_size=8, seq_len=32,
+            steps=25, warmup=1, lr=1e-3, n_experts=4, log=lambda *_: None,
+        )
+        assert result["final_loss"] < 5.2, result
+
     def test_bad_expert_split_rejected(self):
         import jax
         import jax.numpy as jnp
