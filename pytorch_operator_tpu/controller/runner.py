@@ -13,6 +13,7 @@ process. Two runners share one interface:
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -29,6 +30,77 @@ from ..api.types import ProcessTemplate, ReplicaPhase, ReplicaType
 def replica_name(job_key: str, rtype: ReplicaType, index: int) -> str:
     """Canonical replica name: ``<ns>/<job>-<type>-<index>`` (pod-name analog)."""
     return f"{job_key}-{rtype.value.lower()}-{index}"
+
+
+def _proc_stat(pid: int):
+    """(start_ticks, state, pgrp) from ``/proc/<pid>/stat``, or None if gone.
+
+    The comm field (2) may contain spaces/parens, so split after the LAST
+    ``)``. start_ticks (field 22) uniquely stamps a pid incarnation —
+    the guard against pid reuse when adopting persisted records.
+    """
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    rest = raw[raw.rfind(")") + 2 :].split()
+    return int(rest[19]), rest[0], int(rest[2])
+
+
+def _pid_alive(pid: Optional[int], start_ticks: Optional[int]) -> bool:
+    """Is this exact process incarnation still running (zombies count as
+    dead — an orphan reparented to a non-reaping pid 1 stays 'Z')?"""
+    if pid is None:
+        return False
+    stat = _proc_stat(pid)
+    if stat is None or stat[1] == "Z":
+        return False
+    return start_ticks is None or stat[0] == start_ticks
+
+
+def _group_members_alive(pgid: int) -> bool:
+    """Any non-zombie process left in this process group? The exit-capture
+    wrapper dies instantly on SIGTERM, so the wrapper's own exit proves
+    nothing about the replica underneath — liveness and termination must be
+    judged on the whole group. (A pid number stays allocated while it is a
+    live pgid, so members found here are ours, not a pid-reuse stranger —
+    up to the unavoidable full-wraparound edge once the group empties.)"""
+    for d in os.listdir("/proc"):
+        if not d.isdigit():
+            continue
+        stat = _proc_stat(int(d))
+        if stat is not None and stat[1] != "Z" and stat[2] == pgid:
+            return True
+    return False
+
+
+def _replica_alive(pid: Optional[int], start_ticks: Optional[int]) -> bool:
+    """Replica liveness = wrapper pid alive OR any group member alive (a
+    TERM-trapping replica can outlive its wrapper).
+
+    Ordering matters for the pid-reuse guard: a LIVE pid with mismatched
+    start ticks proves the pid was recycled to a stranger (our whole group
+    must have emptied for the kernel to free the number), so the group
+    check applies only when the wrapper pid itself is dead/zombie.
+    """
+    if pid is None:
+        return False
+    stat = _proc_stat(pid)
+    if stat is not None and stat[1] != "Z":
+        return start_ticks is None or stat[0] == start_ticks
+    return _group_members_alive(pid)
+
+
+# Wrapper that records the replica's exit code to a file the supervisor can
+# read after a restart (the pod-status analog: exit codes survive the
+# controller). The child runs in the wrapper's process group; a group
+# signal that kills the wrapper too (SIGKILL preemption) leaves no file,
+# which adoption classifies as a signal death (137, retryable).
+_EXIT_CAPTURE_SH = (
+    'ef="$1"; shift; "$@"; rc=$?; '
+    'printf %s "$rc" > "$ef.tmp" && mv -f "$ef.tmp" "$ef"; exit "$rc"'
+)
 
 
 def normalize_exit_code(code: Optional[int]) -> Optional[int]:
@@ -209,18 +281,107 @@ class SubprocessRunner(ProcessRunner):
         self.state_dir = Path(state_dir)
         self.log_dir = self.state_dir / "logs"
         self.log_dir.mkdir(parents=True, exist_ok=True)
+        # Replica records persist here so a restarted supervisor re-adopts
+        # live replicas instead of double-creating the world (reference:
+        # pods live in the API server; a controller restart lists + claims
+        # them, SURVEY.md §3.2 "label-claim + adoption").
+        self.replica_dir = self.state_dir / "replicas"
+        self.replica_dir.mkdir(parents=True, exist_ok=True)
         self.max_slots = max_slots
         self.handles: Dict[str, ReplicaHandle] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
         self._log_files: Dict[str, object] = {}
+        # Replicas adopted from a previous incarnation: polled via /proc
+        # (they are not our children, so no Popen/waitpid).
+        self._adopted: Dict[str, int] = {}  # name -> pid
+        self._pid_starts: Dict[str, Optional[int]] = {}
         self._lock = threading.RLock()
+        self._load_records()
 
-    def _argv(self, template: ProcessTemplate) -> List[str]:
+    # ---- persistence + adoption ----
+
+    def _record_path(self, name: str) -> Path:
+        return self.replica_dir / (name.replace("/", "_") + ".json")
+
+    def _exit_path(self, name: str) -> Path:
+        return self.replica_dir / (name.replace("/", "_") + ".exit")
+
+    def _save(self, h: ReplicaHandle) -> None:
+        rec = h.to_dict()
+        rec["pid_start"] = self._pid_starts.get(h.name)
+        tmp = self._record_path(h.name).with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(rec))
+        tmp.replace(self._record_path(h.name))
+
+    def _forget_files(self, name: str) -> None:
+        for p in (self._record_path(name), self._exit_path(name)):
+            try:
+                p.unlink()
+            except OSError:
+                pass
+
+    def _read_exit_file(self, name: str) -> Optional[int]:
+        try:
+            return int(self._exit_path(name).read_text().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _load_records(self) -> None:
+        """Adopt persisted replicas: live pids (same /proc start time) come
+        back RUNNING; dead ones get their exit code from the exit-capture
+        file, or 137 (signal death, retryable) if none was written."""
+        for rec_file in sorted(self.replica_dir.glob("*.json")):
+            try:
+                rec = json.loads(rec_file.read_text())
+                h = ReplicaHandle(
+                    name=rec["name"],
+                    job_key=rec["job_key"],
+                    replica_type=ReplicaType(rec["replica_type"]),
+                    index=rec["index"],
+                    phase=ReplicaPhase(rec["phase"]),
+                    exit_code=rec.get("exit_code"),
+                    pid=rec.get("pid"),
+                    created_at=rec.get("created_at", 0.0),
+                    finished_at=rec.get("finished_at"),
+                    log_path=rec.get("log_path"),
+                )
+            except Exception:
+                # A corrupt/foreign-schema record must not brick every
+                # supervisor start; quarantine it and move on.
+                try:
+                    rec_file.replace(rec_file.with_suffix(".json.corrupt"))
+                except OSError:
+                    pass
+                continue
+            pid_start = rec.get("pid_start")
+            self._pid_starts[h.name] = pid_start
+            if h.is_active():
+                if _replica_alive(h.pid, pid_start):
+                    h.phase = ReplicaPhase.RUNNING
+                    self._adopted[h.name] = h.pid
+                else:
+                    self._finish_dead_adopted(h)
+            self.handles[h.name] = h
+
+    def _finish_dead_adopted(self, h: ReplicaHandle) -> None:
+        """Classify a replica found dead without a waitpid: exit-capture file
+        if written, else 137 (group signal killed the wrapper too —
+        the preemption case, retryable under ExitCode policy)."""
+        code = self._read_exit_file(h.name)
+        h.exit_code = 137 if code is None else code
+        h.phase = (
+            ReplicaPhase.SUCCEEDED if h.exit_code == 0 else ReplicaPhase.FAILED
+        )
+        h.finished_at = time.time()
+        self._save(h)
+
+    def _argv(self, template: ProcessTemplate, exit_path: Path) -> List[str]:
         if template.command:
             argv = list(template.command)
         else:
             argv = [sys.executable, "-m", template.module]
-        return argv + list(template.args)
+        argv += list(template.args)
+        return ["/bin/sh", "-c", _EXIT_CAPTURE_SH, "sh", str(exit_path)] + argv
 
     def create(self, job_key, rtype, index, template, env):
         name = replica_name(job_key, rtype, index)
@@ -239,10 +400,11 @@ class SubprocessRunner(ProcessRunner):
             if pkg_root not in parts:
                 parts.insert(0, pkg_root)
             full_env["PYTHONPATH"] = os.pathsep.join(parts)
+            self._forget_files(name)  # stale record/exit file of a prior run
             log_f = open(log_path, "ab")
             try:
                 proc = subprocess.Popen(
-                    self._argv(template),
+                    self._argv(template, self._exit_path(name)),
                     env=full_env,
                     cwd=template.working_dir or None,
                     stdout=log_f,
@@ -264,6 +426,7 @@ class SubprocessRunner(ProcessRunner):
                     log_path=str(log_path),
                 )
                 self.handles[name] = h
+                self._save(h)
                 return h
             h = ReplicaHandle(
                 name=name,
@@ -278,6 +441,9 @@ class SubprocessRunner(ProcessRunner):
             self.handles[name] = h
             self._procs[name] = proc
             self._log_files[name] = log_f
+            stat = _proc_stat(proc.pid)
+            self._pid_starts[name] = stat[0] if stat else None
+            self._save(h)
             return h
 
     def sync(self):
@@ -286,35 +452,75 @@ class SubprocessRunner(ProcessRunner):
                 code = proc.poll()
                 if code is None:
                     continue
+                self._procs.pop(name)
+                f = self._log_files.pop(name, None)
+                if f is not None:
+                    f.close()
                 h = self.handles[name]
+                if code < 0 and _group_members_alive(proc.pid):
+                    # The wrapper was killed by a signal but the replica's
+                    # group survives (TERM-trapping replica, stray kill of
+                    # the sh): the replica is NOT dead — demote to
+                    # adopted-style group tracking. (A wrapper that EXITS
+                    # has waited for its child, so exit ⇒ replica done.)
+                    self._adopted[name] = proc.pid
+                    continue
                 h.exit_code = normalize_exit_code(code)
                 h.phase = (
                     ReplicaPhase.SUCCEEDED if code == 0 else ReplicaPhase.FAILED
                 )
                 h.finished_at = time.time()
-                self._procs.pop(name)
-                f = self._log_files.pop(name, None)
-                if f is not None:
-                    f.close()
+                self._save(h)
+            # Adopted replicas (previous incarnation's children): poll /proc;
+            # when dead, the exit-capture file has the code — absent means a
+            # group signal killed the wrapper too (preemption) → 137.
+            for name, pid in list(self._adopted.items()):
+                if _replica_alive(pid, self._pid_starts.get(name)):
+                    continue
+                self._adopted.pop(name)
+                self._finish_dead_adopted(self.handles[name])
 
     def delete(self, name, grace_seconds: float = 5.0):
         with self._lock:
             proc = self._procs.get(name)
             h = self.handles.get(name)
-        if proc is not None and proc.poll() is None:
-            # SIGTERM the whole process group, escalate to SIGKILL.
-            try:
-                os.killpg(proc.pid, signal.SIGTERM)
-            except (ProcessLookupError, PermissionError):
-                pass
-            try:
-                proc.wait(timeout=grace_seconds)
-            except subprocess.TimeoutExpired:
+            adopted_pid = self._adopted.get(name)
+        if proc is not None:
+            if proc.poll() is None:
+                # SIGTERM the whole process group, escalate to SIGKILL.
                 try:
-                    os.killpg(proc.pid, signal.SIGKILL)
+                    os.killpg(proc.pid, signal.SIGTERM)
                 except (ProcessLookupError, PermissionError):
                     pass
-                proc.wait()
+                try:
+                    proc.wait(timeout=grace_seconds)
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                    proc.wait()
+            elif _group_members_alive(proc.pid):
+                # Wrapper pre-deceased the replica (stray kill, OOM): the
+                # survivors never saw a TERM — give them the same graceful
+                # signal before the escalation below.
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            # proc is the exit-capture wrapper, which dies on TERM even when
+            # the replica traps it — keep going until the whole group is
+            # gone or the grace budget forces a KILL.
+            self._ensure_group_dead(proc.pid, grace_seconds)
+        elif adopted_pid is not None:
+            # Adopted replica: not our child — poll /proc for termination
+            # instead of waitpid, with the same TERM→KILL escalation.
+            self._signal_adopted(name, adopted_pid, grace_seconds)
+        elif h is not None and h.pid is not None:
+            # Neither our child nor adopted-live: a replica already
+            # classified finished. Its wrapper is gone, but a TERM-trapping
+            # descendant may survive — reap any remaining group members.
+            self._signal_adopted(name, h.pid, grace_seconds)
         with self._lock:
             proc = self._procs.pop(name, None)
             if proc is not None and h is not None:
@@ -324,7 +530,46 @@ class SubprocessRunner(ProcessRunner):
             f = self._log_files.pop(name, None)
             if f is not None:
                 f.close()
+            self._adopted.pop(name, None)
+            self._pid_starts.pop(name, None)
             self.handles.pop(name, None)
+            self._forget_files(name)
+
+    def _signal_adopted(self, name: str, pid: int, grace_seconds: float) -> None:
+        start = self._pid_starts.get(name)
+        stat = _proc_stat(pid)
+        if (
+            stat is not None
+            and stat[1] != "Z"
+            and start is not None
+            and stat[0] != start
+        ):
+            return  # pid reused by a stranger — never signal it
+        if not _pid_alive(pid, start) and not _group_members_alive(pid):
+            # Wrapper gone and no surviving group members (a pid stays
+            # allocated while it is a live pgid, so members ⇒ ours).
+            return
+        try:
+            os.killpg(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        self._ensure_group_dead(pid, grace_seconds)
+
+    def _ensure_group_dead(self, pgid: int, grace_seconds: float) -> None:
+        """Wait for every member of the replica's process group to exit,
+        escalating to a group SIGKILL when the grace budget runs out."""
+        deadline = time.time() + grace_seconds
+        while time.time() < deadline:
+            if not _group_members_alive(pgid):
+                return
+            time.sleep(0.05)
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            return
+        kill_deadline = time.time() + 2.0
+        while time.time() < kill_deadline and _group_members_alive(pgid):
+            time.sleep(0.05)
 
     def list_for_job(self, job_key):
         with self._lock:
@@ -336,9 +581,11 @@ class SubprocessRunner(ProcessRunner):
 
     def remove_record(self, name):
         with self._lock:
-            if name in self._procs:
+            if name in self._procs or name in self._adopted:
                 raise RuntimeError(f"cannot remove record of live replica {name}")
             self.handles.pop(name, None)
+            self._pid_starts.pop(name, None)
+            self._forget_files(name)
 
     def schedulable_slots(self):
         if self.max_slots is None:
@@ -348,7 +595,14 @@ class SubprocessRunner(ProcessRunner):
         return max(0, self.max_slots - used)
 
     def shutdown(self):
-        """Terminate everything (supervisor exit)."""
+        """Terminate replicas THIS incarnation spawned (supervisor exit).
+
+        Adopted replicas are spared: they are another incarnation's world
+        (possibly a live daemon sharing the state dir with a foreground
+        ``tpujob run``), and the reference's controller shutdown never kills
+        pods it merely adopted — job-scoped ``delete()`` remains the only
+        path that tears them down.
+        """
         with self._lock:
             names = list(self._procs.keys())
         for name in names:

@@ -1,0 +1,296 @@
+"""Supervisor crash-resilience: replica records persist and live replicas
+are re-adopted on restart.
+
+Reference behavior: the operator's pods live in the API server, so a
+controller restart neither kills running pods nor double-creates them —
+on start the informer lists existing pods and the controller claims them
+by label (SURVEY.md §3.1-3.2 "GetPodsForJob ... label-claim + adoption").
+Locally: SubprocessRunner persists replica records (pid + /proc start-time
+guard) under ``<state_dir>/replicas/`` and an exit-capture shell wrapper
+records the exit code, so a restarted supervisor adopts live processes,
+recovers exit codes of replicas that finished while it was down, and
+classifies orphans that died without a record as signal deaths (137,
+retryable — the preemption case).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from pathlib import Path
+
+import pytest
+
+from pytorch_operator_tpu.api.types import ProcessTemplate, ReplicaPhase, ReplicaType
+from pytorch_operator_tpu.controller.runner import SubprocessRunner, replica_name
+from pytorch_operator_tpu.controller.supervisor import Supervisor
+
+from tests.testutil import new_job
+
+KEY = "default/adopt-job"
+
+
+def _wait(cond, timeout=15.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _pid_gone_or_zombie(pid):
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            stat = f.read()
+    except OSError:
+        return True
+    return stat[stat.rfind(")") + 2 :].split()[0] == "Z"
+
+
+def sleeper(seconds="30"):
+    return ProcessTemplate(command=["sleep", seconds])
+
+
+class TestRunnerAdoption:
+    def test_record_persisted_and_live_replica_adopted(self, tmp_path):
+        a = SubprocessRunner(tmp_path)
+        h = a.create(KEY, ReplicaType.MASTER, 0, sleeper(), {})
+        name = h.name
+        assert (tmp_path / "replicas").is_dir()
+        rec_files = list((tmp_path / "replicas").glob("*.json"))
+        assert len(rec_files) == 1
+        rec = json.loads(rec_files[0].read_text())
+        assert rec["name"] == name and rec["pid"] == h.pid
+        assert rec.get("pid_start") is not None
+
+        # "Crash": drop runner A without shutdown; runner B adopts.
+        b = SubprocessRunner(tmp_path)
+        adopted = b.get(name)
+        assert adopted is not None
+        assert adopted.phase == ReplicaPhase.RUNNING
+        assert adopted.pid == h.pid
+        assert b.list_for_job(KEY)[0].name == name
+
+        # Adopted replicas are deletable (kill escalation works cross-parent).
+        b.delete(name, grace_seconds=2.0)
+        assert b.get(name) is None
+        assert not list((tmp_path / "replicas").glob("*"))
+        assert _wait(lambda: _pid_gone_or_zombie(h.pid))
+        a.shutdown()
+
+    @pytest.mark.parametrize("code,phase", [(0, ReplicaPhase.SUCCEEDED), (7, ReplicaPhase.FAILED)])
+    def test_exit_code_recovered_across_restart(self, tmp_path, code, phase):
+        a = SubprocessRunner(tmp_path)
+        t = ProcessTemplate(command=["sh", "-c", f"exit {code}"])
+        h = a.create(KEY, ReplicaType.MASTER, 0, t, {})
+        # Let it finish while the supervisor is "down" (no a.sync()).
+        assert _wait(lambda: _pid_gone_or_zombie(h.pid))
+        b = SubprocessRunner(tmp_path)
+        got = b.get(h.name)
+        assert got is not None and got.phase == phase
+        assert got.exit_code == code
+        assert got.finished_at is not None
+        a.shutdown()
+
+    def test_orphan_signal_death_without_exit_record_is_retryable(self, tmp_path):
+        a = SubprocessRunner(tmp_path)
+        h = a.create(KEY, ReplicaType.WORKER, 0, sleeper(), {})
+        # SIGKILL the whole group (preemption analog): the exit-capture
+        # wrapper dies too, so no exit file is written.
+        os.killpg(h.pid, signal.SIGKILL)
+        assert _wait(lambda: _pid_gone_or_zombie(h.pid))
+        b = SubprocessRunner(tmp_path)
+        got = b.get(h.name)
+        assert got.phase == ReplicaPhase.FAILED
+        assert got.exit_code == 137  # retryable under ExitCode policy
+        a.shutdown()
+
+    def test_pid_reuse_guard(self, tmp_path):
+        a = SubprocessRunner(tmp_path)
+        h = a.create(KEY, ReplicaType.MASTER, 0, sleeper(), {})
+        rec_file = next((tmp_path / "replicas").glob("*.json"))
+        rec = json.loads(rec_file.read_text())
+        rec["pid_start"] = rec["pid_start"] + 12345  # a different process
+        rec_file.write_text(json.dumps(rec))
+        b = SubprocessRunner(tmp_path)
+        got = b.get(h.name)
+        # Start-time mismatch ⇒ not our process ⇒ treated as dead, and the
+        # live stranger must NOT be killed by delete.
+        assert got.phase == ReplicaPhase.FAILED and got.exit_code == 137
+        b.delete(h.name)
+        assert not _pid_gone_or_zombie(h.pid)
+        a.shutdown()
+
+    def test_adopted_replica_finish_detected_by_sync(self, tmp_path):
+        a = SubprocessRunner(tmp_path)
+        t = ProcessTemplate(command=["sh", "-c", "sleep 0.3; exit 5"])
+        h = a.create(KEY, ReplicaType.MASTER, 0, t, {})
+        b = SubprocessRunner(tmp_path)
+        assert b.get(h.name).phase == ReplicaPhase.RUNNING
+
+        def finished():
+            b.sync()
+            return b.get(h.name).is_finished()
+
+        assert _wait(finished)
+        got = b.get(h.name)
+        assert got.phase == ReplicaPhase.FAILED and got.exit_code == 5
+        a.shutdown()
+
+
+def _creation_events(state_dir: Path, key: str) -> int:
+    """Count SuccessfulCreateReplica in the PERSISTED event log — it spans
+    supervisor incarnations (the in-memory recorder dies with each one)."""
+    p = state_dir / "events" / (key.replace("/", "_") + ".events.jsonl")
+    if not p.exists():
+        return 0
+    return sum(
+        1
+        for line in p.read_text().splitlines()
+        if line.strip() and json.loads(line)["reason"] == "SuccessfulCreateReplica"
+    )
+
+
+class TestAdoptionSafety:
+    def test_shutdown_spares_adopted_replicas(self, tmp_path):
+        """A foreground 'tpujob run' sharing a daemon's state dir must not
+        kill the daemon's world on exit: shutdown() only reaps replicas the
+        same incarnation spawned (controller shutdown never kills adopted
+        pods)."""
+        daemon = SubprocessRunner(tmp_path)
+        h = daemon.create(KEY, ReplicaType.MASTER, 0, sleeper(), {})
+        fg = SubprocessRunner(tmp_path)  # adopts the daemon's replica
+        assert fg.get(h.name).phase == ReplicaPhase.RUNNING
+        fg.shutdown()
+        assert not _pid_gone_or_zombie(h.pid)  # still running
+        assert fg._record_path(h.name).exists()  # record intact
+        daemon.shutdown()
+        assert _wait(lambda: _pid_gone_or_zombie(h.pid))
+
+    @pytest.mark.parametrize("adopt", [False, True])
+    def test_delete_escalates_to_kill_for_term_trapping_replica(self, tmp_path, adopt):
+        """The exit-capture wrapper dies instantly on SIGTERM even when the
+        replica traps it; delete() must judge termination on the whole
+        process group and escalate to SIGKILL (regression: the wrapper's
+        exit used to satisfy proc.wait, skipping the escalation)."""
+        a = SubprocessRunner(tmp_path)
+        t = ProcessTemplate(command=["sh", "-c", "trap '' TERM; sleep 30"])
+        h = a.create(KEY, ReplicaType.MASTER, 0, t, {})
+        time.sleep(0.2)  # let the trap install
+        runner = SubprocessRunner(tmp_path) if adopt else a
+        t0 = time.time()
+        runner.delete(h.name, grace_seconds=0.5)
+        assert time.time() - t0 < 5.0
+        # Every group member (wrapper AND the trap-sleeping replica) is gone.
+        def group_empty():
+            import pytorch_operator_tpu.controller.runner as r
+            return not r._group_members_alive(h.pid)
+        assert _wait(group_empty, timeout=5.0)
+        a.shutdown()
+
+    def test_wrapper_death_alone_does_not_kill_adoption_liveness(self, tmp_path):
+        """If only the exit-capture wrapper dies (stray kill/OOM) while the
+        replica's group survives, adoption must see the replica as RUNNING —
+        not classify it dead and let the reconciler double-create it."""
+        import pytorch_operator_tpu.controller.runner as r
+
+        a = SubprocessRunner(tmp_path)
+        t = ProcessTemplate(command=["sh", "-c", "trap '' TERM; sleep 30"])
+        h = a.create(KEY, ReplicaType.MASTER, 0, t, {})
+        time.sleep(0.3)
+        os.kill(h.pid, signal.SIGKILL)  # the wrapper only, not the group
+        assert _wait(lambda: _pid_gone_or_zombie(h.pid))
+        assert r._group_members_alive(h.pid)  # replica survived
+
+        b = SubprocessRunner(tmp_path)
+        assert b.get(h.name).phase == ReplicaPhase.RUNNING
+        b.sync()
+        assert b.get(h.name).phase == ReplicaPhase.RUNNING
+        b.delete(h.name, grace_seconds=0.5)
+        assert _wait(lambda: not r._group_members_alive(h.pid), timeout=5.0)
+        a.shutdown()
+
+    @pytest.mark.parametrize("sync_first", [False, True])
+    def test_delete_reaps_survivors_after_wrapper_predeceased(self, tmp_path, sync_first):
+        """delete() must reap surviving group members even when the wrapper
+        already exited — both straight from the Popen record and after a
+        sync() has demoted the replica to group tracking."""
+        import pytorch_operator_tpu.controller.runner as r
+
+        a = SubprocessRunner(tmp_path)
+        t = ProcessTemplate(command=["sh", "-c", "trap '' TERM; sleep 30"])
+        h = a.create(KEY, ReplicaType.MASTER, 0, t, {})
+        time.sleep(0.3)
+        os.kill(h.pid, signal.SIGKILL)  # wrapper only; group survives
+        assert _wait(lambda: _pid_gone_or_zombie(h.pid))
+        if sync_first:
+            # Signal-killed wrapper + surviving group ⇒ NOT dead: the
+            # replica stays RUNNING under group tracking.
+            a.sync()
+            assert a.get(h.name).phase == ReplicaPhase.RUNNING
+        assert r._group_members_alive(h.pid)
+        a.delete(h.name, grace_seconds=0.5)
+        assert _wait(lambda: not r._group_members_alive(h.pid), timeout=5.0)
+        a.shutdown()
+
+    def test_corrupt_record_quarantined_not_fatal(self, tmp_path):
+        a = SubprocessRunner(tmp_path)
+        h = a.create(KEY, ReplicaType.MASTER, 0, sleeper(), {})
+        bad = tmp_path / "replicas" / "default_broken-master-0.json"
+        bad.write_text('{"name": "x", "replica_type": "NotAType"}')
+        b = SubprocessRunner(tmp_path)  # must not raise
+        assert b.get(h.name) is not None
+        assert not bad.exists()
+        assert bad.with_suffix(".json.corrupt").exists()
+        a.shutdown()
+
+
+class TestSupervisorRestart:
+    def test_restart_adopts_world_and_does_not_double_create(self, tmp_state_dir):
+        s1 = Supervisor(state_dir=tmp_state_dir, gang_enabled=True)
+        job = new_job(name="adopt-e2e", workers=1)
+        for rs in job.spec.replica_specs.values():
+            rs.template = ProcessTemplate(command=["sh", "-c", "sleep 1.5"])
+        key = s1.submit(job)
+        assert _wait(
+            lambda: (s1.sync_once() or True)
+            and len(s1.runner.list_for_job(key)) == 2
+            and all(h.phase == ReplicaPhase.RUNNING for h in s1.runner.list_for_job(key))
+        )
+        pids = {h.name: h.pid for h in s1.runner.list_for_job(key)}
+
+        # Crash: NO shutdown — replicas keep running, then a fresh
+        # supervisor on the same state dir takes over.
+        s2 = Supervisor(state_dir=tmp_state_dir, gang_enabled=True)
+        s2.sync_once()
+        handles = s2.runner.list_for_job(key)
+        assert {h.name: h.pid for h in handles if h.pid} == pids  # same processes
+        # Only the original creations, no respawns after the restart.
+        assert _creation_events(tmp_state_dir, key) == 2
+
+        final = s2.wait(key, timeout=30)
+        assert final.is_succeeded()
+        s2.shutdown()
+        s1.shutdown()
+
+    def test_master_succeeded_while_supervisor_down(self, tmp_state_dir):
+        s1 = Supervisor(state_dir=tmp_state_dir)
+        job = new_job(name="adopt-done", workers=0)
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            command=["sh", "-c", "exit 0"]
+        )
+        key = s1.submit(job)
+        s1.sync_once()
+        h = s1.runner.get(replica_name(key, ReplicaType.MASTER, 0))
+        assert _wait(lambda: _pid_gone_or_zombie(h.pid))
+        # Restarted supervisor must mark the job Succeeded from the
+        # recovered exit record — not respawn the master.
+        s2 = Supervisor(state_dir=tmp_state_dir)
+        final = s2.wait(key, timeout=15)
+        assert final.is_succeeded()
+        assert _creation_events(tmp_state_dir, key) == 1
+        s2.shutdown()
+        s1.shutdown()
