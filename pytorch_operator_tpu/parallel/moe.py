@@ -75,16 +75,34 @@ def moe_mlp(
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    n_exp = params["w_in"].shape[0]
+    n_exp, d_model, d_ff = params["w_in"].shape
     ep = mesh.shape[axis]
     if n_exp % ep:
         raise ValueError(f"experts {n_exp} not divisible by ep={ep}")
     if not (1 <= top_k <= n_exp):
         raise ValueError(f"top_k={top_k} outside [1, {n_exp}]")
 
+    def present(a):
+        return a in mesh.axis_names and mesh.shape[a] > 1
+
+    # Compose with the transformer's weight shardings instead of forcing
+    # replication (which would silently all-gather the expert weights on
+    # every call). Two different semantics for the two axis kinds:
+    #  - tp shards the F (mlp) dim Megatron-style WITHIN each expert:
+    #    gelu is elementwise over F, so w_in stays column-parallel, w_out
+    #    row-parallel, and the output psum below also completes the F
+    #    contraction — TP never gathers weights.
+    #  - fsdp shards the D (embed) dim as STORAGE only (ZeRO-3): compute
+    #    needs full D, so the weights are gathered just-in-time inside the
+    #    shard_map (the standard ZeRO gather, explicit here).
+    tp_ax = "tp" if present("tp") and d_ff % mesh.shape["tp"] == 0 else None
+    fsdp_ax = (
+        "fsdp" if present("fsdp") and d_model % mesh.shape["fsdp"] == 0 else None
+    )
+
     # Router runs replicated (it is tiny).
     gates = _gates(params, x, top_k)
-    param_spec = {"gate": P(), "w_in": P(axis), "w_out": P(axis)}
+    weight_spec = {"w_in": P(axis, fsdp_ax, tp_ax), "w_out": P(axis, tp_ax, fsdp_ax)}
     # Composition with data parallelism: keep tokens sharded over present
     # batch axes (each (dp, ep) device computes its token rows × its local
     # experts) instead of replicating the batch into every ep shard.
@@ -99,20 +117,27 @@ def moe_mlp(
     else:
         tok_spec = P()
 
-    def per_shard(params_local, gates_local, x_local):
-        # Local experts: [E/ep, D, F]; this shard's slice of the gate
+    def per_shard(weights, gates_local, x_local):
+        w_in, w_out = weights["w_in"], weights["w_out"]
+        if fsdp_ax is not None:
+            # ZeRO just-in-time gather of the embed-dim storage shards.
+            w_in = jax.lax.all_gather(w_in, fsdp_ax, axis=1, tiled=True)
+            w_out = jax.lax.all_gather(w_out, fsdp_ax, axis=2, tiled=True)
+        # Local experts: [E/ep, D, F/tp]; this shard's slice of the gate
         # matrix columns.
-        e_local = params_local["w_in"].shape[0]
+        e_local = w_in.shape[0]
         shard = jax.lax.axis_index(axis)
         g = jax.lax.dynamic_slice_in_dim(
             gates_local, shard * e_local, e_local, axis=1
         )  # [N_local, E/ep]
-        out = _expert_ffn(params_local["w_in"], params_local["w_out"], g, x_local)
-        return jax.lax.psum(out, axis)
+        out = _expert_ffn(w_in, w_out, g, x_local)
+        # One psum finishes BOTH reductions: expert contributions over ep
+        # and (when tp is active) the F contraction over tp.
+        return jax.lax.psum(out, (axis,) if tp_ax is None else (axis, tp_ax))
 
     return shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(param_spec, tok_spec, tok_spec),
+        in_specs=(weight_spec, tok_spec, tok_spec),
         out_specs=tok_spec,
-    )(params, gates, x)
+    )({"w_in": params["w_in"], "w_out": params["w_out"]}, gates, x)

@@ -59,6 +59,7 @@ def run(
     attn_impl: str | None = None,
     xent_impl: str | None = None,
     n_experts: int | None = None,
+    moe_top_k: int | None = None,
     preempt_at: int | None = None,
     profile_dir: str | None = None,
     log=print,
@@ -81,7 +82,16 @@ def run(
         over["xent_impl"] = xent_impl
     if n_experts is not None:
         over["n_experts"] = n_experts
+    if moe_top_k is not None:
+        over["moe_top_k"] = moe_top_k
     cfg = getattr(llama_lib, CONFIGS[config])(**over)
+    # Validate the routing config up front — otherwise a bad top_k only
+    # surfaces as a ValueError deep inside model tracing.
+    if cfg.n_experts > 0 and not (1 <= cfg.moe_top_k <= cfg.n_experts):
+        raise ValueError(
+            f"moe_top_k={cfg.moe_top_k} must lie in [1, n_experts="
+            f"{cfg.n_experts}] — pass --moe-top-k to adjust the routing"
+        )
 
     n_dev = jax.device_count()
     import os
@@ -238,6 +248,10 @@ def main(argv=None) -> int:
         "a warning, when the mesh has no ep axis); default dense SwiGLU",
     )
     p.add_argument(
+        "--moe-top-k", type=int, default=None, dest="moe_top_k",
+        help="experts routed per token (default 2); must be <= --experts",
+    )
+    p.add_argument(
         "--preempt-at", type=int, default=None,
         help="fault injection: die with a retryable exit code at this step "
         "on the replica's first life (simulated TPU preemption)",
@@ -265,6 +279,7 @@ def main(argv=None) -> int:
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,
         n_experts=args.n_experts,
+        moe_top_k=args.moe_top_k,
         preempt_at=args.preempt_at,
         profile_dir=args.profile_dir,
         log=lambda msg: print(
