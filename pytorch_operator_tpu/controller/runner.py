@@ -40,12 +40,15 @@ def _proc_stat(pid: int):
     the guard against pid reuse when adopting persisted records.
     """
     try:
-        with open(f"/proc/{pid}/stat") as f:
+        # Binary read: comm is arbitrary bytes (prctl PR_SET_NAME), so a
+        # text-mode open could raise UnicodeDecodeError on a host process
+        # we merely scanned past.
+        with open(f"/proc/{pid}/stat", "rb") as f:
             raw = f.read()
     except OSError:
         return None
-    rest = raw[raw.rfind(")") + 2 :].split()
-    return int(rest[19]), rest[0], int(rest[2])
+    rest = raw[raw.rfind(b")") + 2 :].split()
+    return int(rest[19]), rest[0].decode("ascii"), int(rest[2])
 
 
 def _pid_alive(pid: Optional[int], start_ticks: Optional[int]) -> bool:
@@ -66,16 +69,24 @@ def _group_members_alive(pgid: int) -> bool:
     judged on the whole group. (A pid number stays allocated while it is a
     live pgid, so members found here are ours, not a pid-reuse stranger —
     up to the unavoidable full-wraparound edge once the group empties.)"""
+    return pgid in _live_pgids()
+
+
+def _live_pgids() -> set:
+    """One /proc pass: the set of process groups with a non-zombie member."""
+    out = set()
     for d in os.listdir("/proc"):
         if not d.isdigit():
             continue
         stat = _proc_stat(int(d))
-        if stat is not None and stat[1] != "Z" and stat[2] == pgid:
-            return True
-    return False
+        if stat is not None and stat[1] != "Z":
+            out.add(stat[2])
+    return out
 
 
-def _replica_alive(pid: Optional[int], start_ticks: Optional[int]) -> bool:
+def _replica_alive(
+    pid: Optional[int], start_ticks: Optional[int], live_pgids: Optional[set] = None
+) -> bool:
     """Replica liveness = wrapper pid alive OR any group member alive (a
     TERM-trapping replica can outlive its wrapper).
 
@@ -83,12 +94,15 @@ def _replica_alive(pid: Optional[int], start_ticks: Optional[int]) -> bool:
     start ticks proves the pid was recycled to a stranger (our whole group
     must have emptied for the kernel to free the number), so the group
     check applies only when the wrapper pid itself is dead/zombie.
+    ``live_pgids`` lets a caller amortize the /proc pass over many replicas.
     """
     if pid is None:
         return False
     stat = _proc_stat(pid)
     if stat is not None and stat[1] != "Z":
         return start_ticks is None or stat[0] == start_ticks
+    if live_pgids is not None:
+        return pid in live_pgids
     return _group_members_alive(pid)
 
 
@@ -306,7 +320,12 @@ class SubprocessRunner(ProcessRunner):
     def _exit_path(self, name: str) -> Path:
         return self.replica_dir / (name.replace("/", "_") + ".exit")
 
-    def _save(self, h: ReplicaHandle) -> None:
+    def _save(self, h: ReplicaHandle, only_if_tracked: bool = False) -> None:
+        """``only_if_tracked``: phase-update saves must not resurrect a
+        record another incarnation's delete() just unlinked (shared state
+        dir) — a stale FAILED record would be adopted by the next start."""
+        if only_if_tracked and not self._record_path(h.name).exists():
+            return
         rec = h.to_dict()
         rec["pid_start"] = self._pid_starts.get(h.name)
         tmp = self._record_path(h.name).with_suffix(".json.tmp")
@@ -356,7 +375,12 @@ class SubprocessRunner(ProcessRunner):
             pid_start = rec.get("pid_start")
             self._pid_starts[h.name] = pid_start
             if h.is_active():
-                if _replica_alive(h.pid, pid_start):
+                # Exit-capture file first: the wrapper writes it when the
+                # replica's MAIN process exits, so its presence means done
+                # even if a stray background child keeps the group alive.
+                if self._read_exit_file(h.name) is not None:
+                    self._finish_dead_adopted(h)
+                elif _replica_alive(h.pid, pid_start):
                     h.phase = ReplicaPhase.RUNNING
                     self._adopted[h.name] = h.pid
                 else:
@@ -373,7 +397,7 @@ class SubprocessRunner(ProcessRunner):
             ReplicaPhase.SUCCEEDED if h.exit_code == 0 else ReplicaPhase.FAILED
         )
         h.finished_at = time.time()
-        self._save(h)
+        self._save(h, only_if_tracked=True)
 
     def _argv(self, template: ProcessTemplate, exit_path: Path) -> List[str]:
         if template.command:
@@ -457,25 +481,37 @@ class SubprocessRunner(ProcessRunner):
                 if f is not None:
                     f.close()
                 h = self.handles[name]
-                if code < 0 and _group_members_alive(proc.pid):
+                file_code = self._read_exit_file(name)
+                if code < 0 and file_code is None and _group_members_alive(proc.pid):
                     # The wrapper was killed by a signal but the replica's
                     # group survives (TERM-trapping replica, stray kill of
                     # the sh): the replica is NOT dead — demote to
                     # adopted-style group tracking. (A wrapper that EXITS
-                    # has waited for its child, so exit ⇒ replica done.)
+                    # has waited for its child, so exit ⇒ replica done; an
+                    # exit file means the main child finished first.)
                     self._adopted[name] = proc.pid
                     continue
-                h.exit_code = normalize_exit_code(code)
+                h.exit_code = (
+                    file_code if file_code is not None else normalize_exit_code(code)
+                )
                 h.phase = (
-                    ReplicaPhase.SUCCEEDED if code == 0 else ReplicaPhase.FAILED
+                    ReplicaPhase.SUCCEEDED
+                    if h.exit_code == 0
+                    else ReplicaPhase.FAILED
                 )
                 h.finished_at = time.time()
-                self._save(h)
-            # Adopted replicas (previous incarnation's children): poll /proc;
-            # when dead, the exit-capture file has the code — absent means a
-            # group signal killed the wrapper too (preemption) → 137.
+                self._save(h, only_if_tracked=True)
+            # Adopted replicas (previous incarnation's children): when the
+            # exit-capture file exists the replica's main process is done
+            # (stray group survivors don't keep it RUNNING); otherwise poll
+            # /proc — one pass amortized over all adopted names. A dead
+            # group with no exit file means a group signal killed the
+            # wrapper too (preemption) → 137.
+            live_pgids = _live_pgids() if self._adopted else None
             for name, pid in list(self._adopted.items()):
-                if _replica_alive(pid, self._pid_starts.get(name)):
+                if self._read_exit_file(name) is None and _replica_alive(
+                    pid, self._pid_starts.get(name), live_pgids
+                ):
                     continue
                 self._adopted.pop(name)
                 self._finish_dead_adopted(self.handles[name])

@@ -236,6 +236,36 @@ class TestAdoptionSafety:
         assert _wait(lambda: not r._group_members_alive(h.pid), timeout=5.0)
         a.shutdown()
 
+    def test_exit_file_wins_over_lingering_group_member(self, tmp_path):
+        """A replica whose MAIN process exited (wrapper wrote the exit
+        file) is done, even if a stray background child keeps the process
+        group alive — adoption must not hold the job RUNNING forever."""
+        a = SubprocessRunner(tmp_path)
+        # Main exits 3 immediately; a detached child keeps the group alive.
+        t = ProcessTemplate(command=["sh", "-c", "sleep 30 & exit 3"])
+        h = a.create(KEY, ReplicaType.MASTER, 0, t, {})
+        assert _wait(lambda: a._read_exit_file(h.name) is not None)
+        b = SubprocessRunner(tmp_path)
+        got = b.get(h.name)
+        assert got.phase == ReplicaPhase.FAILED and got.exit_code == 3
+        b.delete(h.name, grace_seconds=0.5)  # reaps the stray child too
+        a.shutdown()
+
+    def test_sync_does_not_resurrect_deleted_record(self, tmp_path):
+        """Shared state dir: incarnation B delete()s a replica; the owning
+        incarnation A's next sync() must not rewrite the record file (a
+        stale FAILED record would poison the next supervisor start)."""
+        a = SubprocessRunner(tmp_path)
+        h = a.create(KEY, ReplicaType.MASTER, 0, sleeper(), {})
+        b = SubprocessRunner(tmp_path)
+        b.delete(h.name, grace_seconds=0.5)
+        assert not a._record_path(h.name).exists()
+        a.sync()  # A's Popen observes the death — must not re-save
+        assert not a._record_path(h.name).exists()
+        c = SubprocessRunner(tmp_path)
+        assert c.get(h.name) is None
+        a.shutdown()
+
     def test_corrupt_record_quarantined_not_fatal(self, tmp_path):
         a = SubprocessRunner(tmp_path)
         h = a.create(KEY, ReplicaType.MASTER, 0, sleeper(), {})
