@@ -255,11 +255,15 @@ class SchedulingPolicy:
     ``--enable-gang-scheduling``; SURVEY.md §2 "Gang scheduling").
 
     ``min_available`` defaults to the total replica count — all-or-nothing.
+    ``priority`` orders jobs competing for capacity (higher wins; volcano
+    priorityClass analog); ``queue`` names a capacity pool enforced by the
+    supervisor's ``--queue-slots`` (volcano queue analog).
     """
 
     gang: bool = True
     min_available: Optional[int] = None
     queue: Optional[str] = None
+    priority: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"gang": self.gang}
@@ -267,6 +271,8 @@ class SchedulingPolicy:
             d["min_available"] = self.min_available
         if self.queue is not None:
             d["queue"] = self.queue
+        if self.priority:
+            d["priority"] = self.priority
         return d
 
     @classmethod
@@ -277,6 +283,11 @@ class SchedulingPolicy:
                 d, "min_available", "scheduling_policy.min_available"
             ),
             queue=d.get("queue"),
+            priority=(
+                _parse_int(d["priority"], "scheduling_policy.priority")
+                if d.get("priority") is not None
+                else 0
+            ),
         )
 
 

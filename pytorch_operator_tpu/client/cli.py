@@ -141,6 +141,28 @@ def cmd_submit(args) -> int:
     return 0
 
 
+def _parse_queue_slots(spec):
+    """``'default=4,batch=2'`` → ``{'default': 4, 'batch': 2}``."""
+    if not spec:
+        return None
+    out = {}
+    for part in spec.split(","):
+        name, _, cap = part.partition("=")
+        name = name.strip()
+        if not name or not cap:
+            raise SystemExit(f"--queue-slots: malformed entry {part!r}")
+        try:
+            n = int(cap)
+        except ValueError:
+            raise SystemExit(f"--queue-slots: non-integer cap in {part!r}")
+        if n <= 0:
+            raise SystemExit(f"--queue-slots: cap must be positive in {part!r}")
+        if name in out:
+            raise SystemExit(f"--queue-slots: duplicate queue {name!r}")
+        out[name] = n
+    return out
+
+
 def cmd_supervisor(args) -> int:
     # SIGTERM (systemd stop / kubelet-style termination) takes the same
     # clean shutdown path as Ctrl-C: kill replicas, release the lease.
@@ -158,6 +180,7 @@ def cmd_supervisor(args) -> int:
         gang_enabled=not args.no_gang,
         max_slots=args.max_slots,
         leader_elect=not args.no_leader_elect,
+        queue_slots=_parse_queue_slots(getattr(args, "queue_slots", None)),
     )
     # Monitoring comes up BEFORE the lease wait: a standby must answer
     # /healthz while blocked (it reports is_leader=false), or liveness
@@ -441,6 +464,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--interval", type=float, default=0.2)
     sp.add_argument("--no-gang", action="store_true")
     sp.add_argument("--max-slots", type=int, default=None)
+    sp.add_argument(
+        "--queue-slots",
+        default=None,
+        dest="queue_slots",
+        help="per-queue replica-slot caps, e.g. 'default=4,batch=2' "
+        "(jobs pick a queue via scheduling_policy.queue; unlisted "
+        "queues are unbounded)",
+    )
     sp.add_argument(
         "--monitoring-port",
         type=int,

@@ -17,9 +17,6 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
 
-from .runner import ProcessRunner
-
-
 @dataclass
 class ProcessGroup:
     """PodGroup analog."""
@@ -54,16 +51,29 @@ class GangScheduler:
         with self._lock:
             self._groups.pop(job_key, None)
 
-    def can_admit(self, job_key: str, needed_now: int, runner: ProcessRunner) -> bool:
-        """All-or-nothing admission: may this job start ``needed_now`` more
-        replicas right now?
+    def admissible(
+        self,
+        needed_now: int,
+        min_needed: int,
+        slots: Optional[int],
+        queue_free: Optional[int] = None,
+    ) -> int:
+        """How many of ``needed_now`` missing replicas may start right now.
 
-        Non-gang mode admits anything the runner has room for piecewise;
-        gang mode admits only if the whole remaining gang fits at once.
+        ``min_needed`` is the gang threshold: the count that must fit at
+        once for ANY replica to start (volcano ``minMember`` semantics —
+        the all-or-nothing default sets it to the whole remaining gang;
+        ``min_available`` below the total allows a partial world that
+        waits at rendezvous for stragglers). Non-gang admission passes
+        ``min_needed=1`` (piecewise). ``slots`` is free runner capacity
+        (minus any higher-priority reservation); ``queue_free`` caps
+        admission to the job's queue capacity (volcano queue analog);
+        None = unbounded.
         """
-        slots = runner.schedulable_slots()
-        if slots is None:
-            return True
-        if not self.enabled:
-            return slots >= 1
-        return slots >= needed_now
+        bounds = [b for b in (slots, queue_free) if b is not None]
+        if not bounds:
+            return needed_now
+        avail = min(bounds)
+        if avail < min_needed:
+            return 0
+        return min(needed_now, avail)

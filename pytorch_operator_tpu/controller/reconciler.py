@@ -57,6 +57,7 @@ class Reconciler:
         checkpoint_root: Optional[Path] = None,
         cache_root: Optional[Path] = None,
         coordinator_host: str = "127.0.0.1",
+        queue_slots: Optional[dict] = None,
     ):
         self.store = store
         self.runner = runner
@@ -70,6 +71,16 @@ class Reconciler:
         # resubmitted job hitting the previous run's compiled executables.
         self.cache_root = Path(cache_root) if cache_root else None
         self.coordinator_host = coordinator_host
+        # Per-queue replica-slot caps (volcano queue analog): jobs name a
+        # queue in scheduling_policy; admission is bounded by the queue's
+        # free capacity. None = no queue enforcement.
+        self.queue_slots = dict(queue_slots) if queue_slots else None
+        # Pass-scoped scheduling state (begin_pass): per-key slots reserved
+        # by held gangs (a job never blocks ITSELF — only jobs synced after
+        # it in priority order), and a queue-usage cache so a pass is
+        # O(jobs) not O(jobs²) in queue accounting.
+        self._pass_reservations: dict = {}
+        self._pass_queue_used = None
         self._unschedulable_warned = set()
         # Per-file byte offsets for incremental status-report scanning.
         self._scan_offsets = {}
@@ -101,6 +112,58 @@ class Reconciler:
         ``delete_job(purge_artifacts=True)`` reclaims it."""
         return self.job_subdir(self.checkpoint_root, key)
 
+    def begin_pass(self) -> None:
+        """Start a supervisor sync pass. Resets the priority reservation
+        (slots claimed by held higher-priority gangs — the supervisor syncs
+        jobs in priority order, so a later lower-priority job cannot steal
+        capacity a pending gang is waiting for) and computes queue usage
+        once for the whole pass.
+
+        A gang that can NEVER fit keeps its reservation and starves lower
+        classes — the same behavior as a volcano PodGroup pending forever;
+        the Unschedulable event is the operator's signal.
+        """
+        self._pass_reservations = {}
+        if self.queue_slots is None:
+            self._pass_queue_used = None
+            return
+        used: dict = {}
+        for key in self.store.keys():
+            job = self.store.get(key)
+            if job is None:
+                continue
+            q = job.spec.run_policy.scheduling_policy.queue or "default"
+            n = sum(1 for h in self.runner.list_for_job(key) if h.is_active())
+            if n:
+                used[q] = used.get(q, 0) + n
+        self._pass_queue_used = used
+
+    def _queue_free(self, job: TPUJob, key: str) -> Optional[int]:
+        """Free replica slots in the job's queue (volcano queue analog):
+        queue capacity minus active replicas of ALL jobs naming that queue.
+        None = queues unconfigured or this queue unlisted (unbounded)."""
+        if self.queue_slots is None:
+            return None
+        qname = job.spec.run_policy.scheduling_policy.queue or "default"
+        cap = self.queue_slots.get(qname)
+        if cap is None:
+            return None
+        if self._pass_queue_used is not None:
+            used = self._pass_queue_used.get(qname, 0)
+        else:
+            # Solo sync (foreground run): compute directly.
+            used = 0
+            for other_key in self.store.keys():
+                other = self.store.get(other_key)
+                if other is None:
+                    continue
+                oq = other.spec.run_policy.scheduling_policy.queue or "default"
+                if oq == qname:
+                    used += sum(
+                        1 for h in self.runner.list_for_job(other_key) if h.is_active()
+                    )
+        return max(0, cap - used)
+
     def _fail_job(self, job: TPUJob, key: str, reason: str, message: str, now: float):
         job.set_condition(
             ConditionType.FAILED, reason=reason, message=message, now=now
@@ -128,6 +191,7 @@ class Reconciler:
         self.gang.delete_group(key)
         self.expectations.delete_expectations(key)
         self._unschedulable_warned.discard(key)
+        self._pass_reservations.pop(key, None)
 
     def _reset_status_dir(self, key: str) -> None:
         """Clear a prior incarnation's status reports (and their scan
@@ -321,18 +385,68 @@ class Reconciler:
 
         if missing:
             total = sum(self._desired_replicas(job, rt) for rt in job.spec.replica_specs)
-            self.gang.sync_group(key, min_member=total)
-            if not self.gang.can_admit(key, len(missing), self.runner):
+            policy = job.spec.run_policy.scheduling_policy
+            # minMember semantics: min_available (defaulted to total by
+            # set_defaults) is the count that must fit at once; below-total
+            # values allow a partial world that waits at rendezvous. Capped
+            # at the CURRENT total: an elastic scale-down must not leave a
+            # stale submit-time threshold that can never be met.
+            min_avail = min(
+                policy.min_available if policy.min_available is not None else total,
+                total,
+            )
+            self.gang.sync_group(key, min_member=min_avail)
+            active_now = sum(1 for h in handles if h.is_active())
+            gang_on = self.gang.enabled and policy.gang
+            min_needed = max(0, min_avail - active_now) if gang_on else 1
+            min_needed = max(1, min(min_needed, len(missing)))
+            slots = self.runner.schedulable_slots()
+            if slots is not None:
+                # Capacity claimed by OTHER (higher-priority, synced
+                # earlier) held gangs is off-limits — no starvation by
+                # small jobs; a job's own reservation never blocks it.
+                reserved_others = sum(
+                    v
+                    for k2, v in list(self._pass_reservations.items())
+                    if k2 != key
+                )
+                slots = max(0, slots - reserved_others)
+            queue_free = self._queue_free(job, key)
+            n_admit = self.gang.admissible(len(missing), min_needed, slots, queue_free)
+            if n_admit == 0:
                 if key not in self._unschedulable_warned:
                     self._unschedulable_warned.add(key)
+                    queue_bound = queue_free is not None and queue_free < min_needed and (
+                        slots is None or queue_free <= slots
+                    )
+                    where = (
+                        f"queue '{policy.queue or 'default'}'"
+                        if queue_bound
+                        else "the available capacity"
+                    )
                     self.events.warning(
                         key, "Unschedulable",
-                        f"gang of {total} replicas does not fit the available "
-                        "capacity; holding all replicas (all-or-nothing).",
+                        f"gang needs {min_needed} slot(s) at once in "
+                        f"{where}; holding replicas "
+                        f"(min_available={min_avail} of {total}).",
                     )
+                # Reserve this gang's demand against lower-priority jobs
+                # synced later in the pass.
+                self._pass_reservations[key] = len(missing)
                 self.store.update(job)
                 return True
             self._unschedulable_warned.discard(key)
+            if n_admit < len(missing):
+                # Stragglers of a partially-admitted gang keep their claim.
+                self._pass_reservations[key] = len(missing) - n_admit
+            else:
+                self._pass_reservations.pop(key, None)
+            missing = missing[:n_admit]
+            if self._pass_queue_used is not None:
+                qname = policy.queue or "default"
+                self._pass_queue_used[qname] = (
+                    self._pass_queue_used.get(qname, 0) + n_admit
+                )
             # Auto-port jobs get a freshly-probed coordinator port for each
             # new world (first launch or gang restart): probing at spawn
             # time keeps the free-probe → coordinator-bind window tiny, and

@@ -47,6 +47,7 @@ class Supervisor:
         poll_interval: float = 0.1,
         persist: bool = True,
         leader_elect: bool = False,
+        queue_slots: Optional[dict] = None,
     ):
         self.state_dir = Path(state_dir) if state_dir is not None else default_state_dir()
         self.state_dir.mkdir(parents=True, exist_ok=True)
@@ -75,6 +76,7 @@ class Supervisor:
             status_root=self.state_dir / "status",
             checkpoint_root=self.state_dir / "checkpoints",
             cache_root=self.state_dir / "xla_cache",
+            queue_slots=queue_slots,
         )
 
     # ---- API-server-ish surface ----
@@ -171,13 +173,31 @@ class Supervisor:
     # ---- reconcile loop ----
 
     def sync_once(self, now: Optional[float] = None) -> bool:
-        """One pass over all jobs; returns True if any job still active."""
+        """One pass over all jobs; returns True if any job still active.
+
+        Jobs sync in priority order (higher ``scheduling_policy.priority``
+        first, FIFO by submit time within a class — the volcano
+        priorityClass analog), so under capacity pressure high-priority
+        gangs claim free slots before lower ones.
+        """
         now = time.time() if now is None else now
         any_active = False
+        jobs = []
         for key in self.store.keys():
             job = self.store.get(key)
             if job is None:
                 continue
+            jobs.append((key, job))
+        jobs.sort(
+            key=lambda kj: (
+                -kj[1].spec.run_policy.scheduling_policy.priority,
+                kj[1].status.submit_time or 0.0,
+            )
+        )
+        # Reset the pass-scoped scheduling state (priority reservations,
+        # queue-usage cache) before admitting in priority order.
+        self.reconciler.begin_pass()
+        for key, job in jobs:
             if job.is_finished():
                 self._gc_ttl(job, key, now)
                 continue

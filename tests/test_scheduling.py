@@ -1,0 +1,243 @@
+"""SchedulingPolicy semantics: priority classes, queue capacity, and
+minMember gang admission.
+
+Reference: volcano gang scheduling as wired by the common job framework —
+PodGroup ``minMember``, queue, and priorityClass (SURVEY.md §2 "Gang
+scheduling", §3.5). Tests run against FakeRunner capacity, the
+fake-clientset trick (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from pytorch_operator_tpu.api.types import ReplicaPhase, ReplicaType, SchedulingPolicy
+from pytorch_operator_tpu.controller.runner import FakeRunner, replica_name
+from pytorch_operator_tpu.controller.supervisor import Supervisor
+from tests.testutil import new_job
+
+
+def make_sup(capacity):
+    return Supervisor(
+        state_dir=None, runner=FakeRunner(capacity=capacity), persist=False
+    )
+
+
+def finish_master(sup, key):
+    sup.runner.set_phase(
+        replica_name(key, ReplicaType.MASTER, 0), ReplicaPhase.SUCCEEDED, exit_code=0
+    )
+
+
+class TestPriority:
+    def test_higher_priority_claims_capacity_first(self, tmp_path):
+        sup = make_sup(capacity=2)
+        lo = new_job(name="lo", workers=1)
+        hi = new_job(name="hi", workers=1)
+        hi.spec.run_policy.scheduling_policy.priority = 10
+        lo_key = sup.submit(lo)  # submitted FIRST, but outranked
+        hi_key = sup.submit(hi)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(hi_key)) == 2
+        assert len(sup.runner.list_for_job(lo_key)) == 0
+        assert any(
+            e.reason == "Unschedulable" for e in sup.events.for_job(lo_key)
+        )
+
+    def test_lower_priority_runs_after_capacity_frees(self, tmp_path):
+        sup = make_sup(capacity=2)
+        lo = new_job(name="lo", workers=1)
+        hi = new_job(name="hi", workers=1)
+        hi.spec.run_policy.scheduling_policy.priority = 10
+        lo_key = sup.submit(lo)
+        hi_key = sup.submit(hi)
+        sup.sync_once()
+        sup.runner.set_all_running(hi_key)
+        finish_master(sup, hi_key)
+        sup.sync_once()  # hi completes; CleanPodPolicy frees its slots
+        sup.sync_once()
+        assert sup.get(hi_key).is_succeeded()
+        assert len(sup.runner.list_for_job(lo_key)) == 2
+
+    def test_equal_priority_is_fifo(self, tmp_path):
+        sup = make_sup(capacity=2)
+        first = sup.submit(new_job(name="first", workers=1))
+        second = sup.submit(new_job(name="second", workers=1))
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(first)) == 2
+        assert len(sup.runner.list_for_job(second)) == 0
+
+
+class TestMinAvailable:
+    def test_partial_world_admitted_at_min_available(self, tmp_path):
+        """min_available below the total admits a partial gang (volcano
+        minMember): the world waits at rendezvous for stragglers, which
+        spawn as capacity frees."""
+        sup = make_sup(capacity=2)
+        job = new_job(name="partial", workers=2)  # total 3
+        job.spec.run_policy.scheduling_policy.min_available = 2
+        key = sup.submit(job)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 2  # admitted at minMember
+        assert not any(
+            e.reason == "Unschedulable" for e in sup.events.for_job(key)
+        )
+        sup.runner.capacity = 3  # capacity frees → straggler spawns
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 3
+
+    def test_all_or_nothing_by_default(self, tmp_path):
+        sup = make_sup(capacity=2)
+        key = sup.submit(new_job(name="whole", workers=2))  # total 3
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 0
+        assert any(e.reason == "Unschedulable" for e in sup.events.for_job(key))
+
+    def test_gang_disabled_per_job_admits_piecewise(self, tmp_path):
+        sup = make_sup(capacity=1)
+        job = new_job(name="piecewise", workers=2)  # total 3 > capacity
+        job.spec.run_policy.scheduling_policy.gang = False
+        key = sup.submit(job)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 1
+
+
+class TestQueues:
+    def make_queued_sup(self, caps, capacity=None):
+        return Supervisor(
+            state_dir=None,
+            runner=FakeRunner(capacity=capacity),
+            persist=False,
+            queue_slots=caps,
+        )
+
+    def test_queue_capacity_bounds_admission(self, tmp_path):
+        sup = self.make_queued_sup({"small": 2})
+        a = new_job(name="a", workers=0)
+        b = new_job(name="b", workers=0)
+        c = new_job(name="c", workers=0)
+        for j in (a, b, c):
+            j.spec.run_policy.scheduling_policy.queue = "small"
+        ka, kb, kc = sup.submit(a), sup.submit(b), sup.submit(c)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(ka)) == 1
+        assert len(sup.runner.list_for_job(kb)) == 1
+        assert len(sup.runner.list_for_job(kc)) == 0
+        ev = [e for e in sup.events.for_job(kc) if e.reason == "Unschedulable"]
+        assert ev and "queue 'small'" in ev[0].message
+
+    def test_unlisted_queue_is_unbounded(self, tmp_path):
+        sup = self.make_queued_sup({"small": 1})
+        job = new_job(name="big", workers=3)
+        job.spec.run_policy.scheduling_policy.queue = "other"
+        key = sup.submit(job)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 4
+
+    def test_queue_frees_when_job_finishes(self, tmp_path):
+        sup = self.make_queued_sup({"q": 1})
+        a = new_job(name="a", workers=0)
+        b = new_job(name="b", workers=0)
+        for j in (a, b):
+            j.spec.run_policy.scheduling_policy.queue = "q"
+        ka, kb = sup.submit(a), sup.submit(b)
+        sup.sync_once()
+        sup.runner.set_all_running(ka)
+        finish_master(sup, ka)
+        sup.sync_once()
+        sup.sync_once()
+        assert sup.get(ka).is_succeeded()
+        assert len(sup.runner.list_for_job(kb)) == 1
+
+
+class TestReservation:
+    def test_held_gang_reserves_slots_against_lower_priority(self, tmp_path):
+        """A pending high-priority gang must not be starved by a stream of
+        small low-priority jobs: its demand is reserved, so later jobs in
+        the pass see no free capacity."""
+        sup = make_sup(capacity=3)
+        occupier = sup.submit(new_job(name="occupier", workers=0))  # 1 slot
+        sup.sync_once()
+        hi = new_job(name="hi", workers=2)  # gang of 3 > 2 free
+        hi.spec.run_policy.scheduling_policy.priority = 10
+        hi_key = sup.submit(hi)
+        small = sup.submit(new_job(name="small", workers=0))  # 1 slot, prio 0
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(hi_key)) == 0  # held
+        # The free slots are reserved for hi — small must NOT sneak in.
+        assert len(sup.runner.list_for_job(small)) == 0
+        # Occupier finishes → 3 free → hi launches; small still waits.
+        sup.runner.set_all_running(occupier)
+        finish_master(sup, occupier)
+        sup.sync_once()
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(hi_key)) == 3
+        assert len(sup.runner.list_for_job(small)) == 0
+        # hi finishes → small finally runs.
+        sup.runner.set_all_running(hi_key)
+        finish_master(sup, hi_key)
+        sup.sync_once()
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(small)) == 1
+
+    def test_scale_down_does_not_wedge_on_stale_min_available(self, tmp_path):
+        """set_defaults pins min_available to the submit-time total; an
+        elastic scale-down must not leave an unreachable gang threshold."""
+        from pytorch_operator_tpu.api.types import ElasticPolicy
+
+        sup = make_sup(capacity=3)
+        job = new_job(
+            name="elastic", workers=4,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=4, max_restarts=4),
+        )
+        key = sup.submit(job)  # total 5 > capacity 3 → held
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 0
+        sup.scale(key, 1)  # now total 2 <= 3
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 2
+
+    def test_unschedulable_blames_binding_constraint(self, tmp_path):
+        """With an ample queue but tight runner slots, the event must blame
+        capacity — not point the operator at the queue."""
+        sup = Supervisor(
+            state_dir=None,
+            runner=FakeRunner(capacity=2),
+            persist=False,
+            queue_slots={"big": 100},
+        )
+        job = new_job(name="tight", workers=2)  # gang of 3 > 2 slots
+        job.spec.run_policy.scheduling_policy.queue = "big"
+        key = sup.submit(job)
+        sup.sync_once()
+        ev = [e for e in sup.events.for_job(key) if e.reason == "Unschedulable"]
+        assert ev and "available capacity" in ev[0].message
+        assert "queue" not in ev[0].message
+
+
+class TestCLIQueueSlots:
+    def test_parse_and_reject(self):
+        import pytest
+
+        from pytorch_operator_tpu.client.cli import _parse_queue_slots
+
+        assert _parse_queue_slots("a=4, b=2".replace(" ", "")) == {"a": 4, "b": 2}
+        assert _parse_queue_slots(None) is None
+        for bad in ("a=0", "a=-2", "a=4,a=1", "a", "=4", "a=x"):
+            with pytest.raises(SystemExit):
+                _parse_queue_slots(bad)
+
+
+class TestAPI:
+    def test_priority_round_trips(self):
+        sp = SchedulingPolicy(priority=7, queue="batch", min_available=3)
+        got = SchedulingPolicy.from_dict(sp.to_dict())
+        assert got == sp
+
+    def test_priority_defaults_to_zero(self):
+        assert SchedulingPolicy.from_dict({}).priority == 0
+        assert SchedulingPolicy.from_dict({"priority": None}).priority == 0
+
+    def test_priority_bad_value_names_field(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="scheduling_policy.priority"):
+            SchedulingPolicy.from_dict({"priority": "high"})
