@@ -38,3 +38,7 @@ from .serialization import (  # noqa: F401
     loads_job,
     save_job,
 )
+from .convert import (  # noqa: F401
+    convert_pytorchjob,
+    is_pytorchjob,
+)

@@ -1,0 +1,198 @@
+"""PyTorchJob → TPUJob conversion (migration shim).
+
+The reference's users submit ``kind: PyTorchJob`` manifests
+(``kubeflow.org/v1``, camelCase keys, ``pytorchReplicaSpecs`` holding pod
+templates; reference: ``pkg/apis/pytorch/v1/types.go`` and
+``examples/mnist`` job YAMLs — SURVEY.md §1 layer 7, §2 "PyTorchJob
+types"). This module converts such a manifest into the TPUJob dict shape
+so ``tpujob submit my-pytorchjob.yaml`` works directly: replica specs,
+restart policies, run policy (including the v1beta2-era spec-level
+placement of cleanPodPolicy/ttl), scheduling policy, and elastic policy
+all map; the pod template's first container becomes the process template.
+
+What cannot map is surfaced, not silently dropped: a container with no
+``command`` is an error (there is no container runtime to run an image's
+entrypoint), and the image name / valueFrom env / priorityClassName are
+recorded as ``tpujob.dev/converted-*`` annotations for the operator to
+see in ``tpujob describe``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+CONVERTED_FROM_ANNOTATION = "tpujob.dev/converted-from"
+
+
+def is_pytorchjob(data: Dict[str, Any]) -> bool:
+    """Does this manifest look like a kubeflow PyTorchJob?"""
+    if data.get("kind") == "PyTorchJob":
+        return True
+    spec = data.get("spec")
+    return isinstance(spec, dict) and "pytorchReplicaSpecs" in spec
+
+
+def convert_pytorchjob(data: Dict[str, Any]) -> Dict[str, Any]:
+    """Convert a PyTorchJob manifest dict to a TPUJob dict.
+
+    Raises ValueError (with the offending path) for constructs that cannot
+    be represented, rather than guessing.
+    """
+    spec = data.get("spec") or {}
+    annotations: Dict[str, str] = {}
+    meta_in = data.get("metadata") or {}
+    out_meta: Dict[str, Any] = {
+        "name": meta_in.get("name", ""),
+        "namespace": meta_in.get("namespace", "default"),
+    }
+    if meta_in.get("labels"):
+        out_meta["labels"] = dict(meta_in["labels"])
+    for k, v in (meta_in.get("annotations") or {}).items():
+        annotations[str(k)] = str(v)
+    annotations[CONVERTED_FROM_ANNOTATION] = (
+        f"{data.get('apiVersion', 'kubeflow.org/v1')} PyTorchJob"
+    )
+
+    replica_specs_in = spec.get("pytorchReplicaSpecs") or {}
+    if not isinstance(replica_specs_in, dict) or not replica_specs_in:
+        raise ValueError("spec.pytorchReplicaSpecs: missing or empty")
+    replica_specs: Dict[str, Any] = {}
+    ports: Dict[str, int] = {}
+    for rtype, rs in replica_specs_in.items():
+        converted, rport = _convert_replica_spec(rtype, rs or {}, annotations)
+        replica_specs[rtype] = converted
+        if rport is not None:
+            ports[rtype] = rport
+    # MASTER_PORT comes from the Master container's pytorchjob-port in the
+    # reference; a Worker's declaration must not override it.
+    port = ports.get("Master", next(iter(ports.values()), None))
+
+    # RunPolicy: v1 nests it under spec.runPolicy; v1beta2 had the same
+    # fields at spec level. Accept both (runPolicy wins where both exist).
+    rp_in = dict(spec.get("runPolicy") or {})
+    for legacy_key in (
+        "cleanPodPolicy",
+        "ttlSecondsAfterFinished",
+        "activeDeadlineSeconds",
+        "backoffLimit",
+        "schedulingPolicy",
+    ):
+        if legacy_key not in rp_in and legacy_key in spec:
+            rp_in[legacy_key] = spec[legacy_key]
+    run_policy: Dict[str, Any] = {}
+    if rp_in.get("cleanPodPolicy") is not None:
+        run_policy["clean_pod_policy"] = rp_in["cleanPodPolicy"]
+    for camel, snake in (
+        ("ttlSecondsAfterFinished", "ttl_seconds_after_finished"),
+        ("activeDeadlineSeconds", "active_deadline_seconds"),
+        ("backoffLimit", "backoff_limit"),
+    ):
+        if rp_in.get(camel) is not None:
+            run_policy[snake] = rp_in[camel]
+    sp_in = rp_in.get("schedulingPolicy") or {}
+    if sp_in:
+        sp_out: Dict[str, Any] = {}
+        if sp_in.get("minAvailable") is not None:
+            sp_out["min_available"] = sp_in["minAvailable"]
+        if sp_in.get("queue"):
+            sp_out["queue"] = sp_in["queue"]
+        if sp_in.get("priorityClass"):
+            # Priority classes are cluster objects we don't have; keep the
+            # name visible and let the operator set a numeric priority.
+            annotations["tpujob.dev/converted-priority-class"] = str(
+                sp_in["priorityClass"]
+            )
+        if sp_out:
+            run_policy["scheduling_policy"] = sp_out
+
+    out_spec: Dict[str, Any] = {"replica_specs": replica_specs}
+    if run_policy:
+        out_spec["run_policy"] = run_policy
+    if port is not None:
+        out_spec["port"] = port
+
+    ep_in = spec.get("elasticPolicy") or {}
+    if ep_in:
+        ep_out: Dict[str, Any] = {}
+        for camel, snake in (
+            ("minReplicas", "min_replicas"),
+            ("maxReplicas", "max_replicas"),
+            ("maxRestarts", "max_restarts"),
+        ):
+            if ep_in.get(camel) is not None:
+                ep_out[snake] = ep_in[camel]
+        if ep_in.get("nProcPerNode") is not None:
+            annotations["tpujob.dev/converted-nproc-per-node"] = str(
+                ep_in["nProcPerNode"]
+            )
+        if ep_out:
+            out_spec["elastic_policy"] = ep_out
+
+    out_meta["annotations"] = annotations
+    return {
+        "api_version": "tpujob.dev/v1",
+        "kind": "TPUJob",
+        "metadata": out_meta,
+        "spec": out_spec,
+    }
+
+
+def _convert_replica_spec(rtype: str, rs: Dict[str, Any], annotations: Dict[str, str]):
+    """One pytorchReplicaSpecs entry → (ReplicaSpec dict, port or None)."""
+    path = f"spec.pytorchReplicaSpecs.{rtype}"
+    out: Dict[str, Any] = {}
+    if rs.get("replicas") is not None:
+        out["replicas"] = rs["replicas"]
+    if rs.get("restartPolicy") is not None:
+        out["restart_policy"] = rs["restartPolicy"]
+
+    pod = (rs.get("template") or {}).get("spec") or {}
+    containers = pod.get("containers") or []
+    if not containers:
+        raise ValueError(f"{path}.template.spec.containers: missing or empty")
+    c = containers[0]
+    if len(containers) > 1:
+        annotations[f"tpujob.dev/converted-sidecars-{rtype.lower()}"] = ",".join(
+            str(x.get("name", "?")) for x in containers[1:]
+        )
+    template: Dict[str, Any] = {}
+    command = list(c.get("command") or [])
+    if not command:
+        raise ValueError(
+            f"{path}: container {c.get('name', '?')!r} has no command — a "
+            "container image's entrypoint cannot run without a container "
+            "runtime; set an explicit command (e.g. ['python', '-m', ...])"
+        )
+    template["command"] = command
+    if c.get("args"):
+        template["args"] = [str(a) for a in c["args"]]
+    if c.get("workingDir"):
+        template["working_dir"] = c["workingDir"]
+    if c.get("image"):
+        annotations[f"tpujob.dev/converted-image-{rtype.lower()}"] = str(c["image"])
+    env: Dict[str, str] = {}
+    dropped = []
+    for e in c.get("env") or []:
+        if "valueFrom" in e:
+            dropped.append(str(e.get("name", "?")))
+            continue
+        env[str(e["name"])] = str(e.get("value", ""))
+    if dropped:
+        annotations[f"tpujob.dev/converted-env-dropped-{rtype.lower()}"] = ",".join(
+            dropped
+        )
+    if env:
+        template["env"] = env
+
+    # google.com/tpu resource limits → tpu_chips (the env's device ask).
+    limits = (c.get("resources") or {}).get("limits") or {}
+    tpu = limits.get("google.com/tpu") or limits.get("cloud-tpus.google.com/v5e")
+    if tpu is not None:
+        template["resources"] = {"tpu_chips": int(tpu)}
+
+    port = None
+    for p in c.get("ports") or []:
+        if p.get("name") == "pytorchjob-port" and p.get("containerPort"):
+            port = int(p["containerPort"])
+    out["template"] = template
+    return out, port

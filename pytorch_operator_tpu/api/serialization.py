@@ -13,10 +13,16 @@ from typing import Union
 
 import yaml
 
+from .convert import convert_pytorchjob, is_pytorchjob
 from .types import TPUJob
 
 
 def job_from_dict(d: dict) -> TPUJob:
+    # Migration shim: a kubeflow PyTorchJob manifest (the reference's user
+    # surface) is converted on the way in, so `tpujob submit` accepts it
+    # directly (api/convert.py).
+    if is_pytorchjob(d):
+        d = convert_pytorchjob(d)
     return TPUJob.from_dict(d)
 
 

@@ -286,6 +286,12 @@ def cmd_describe(args) -> int:
     print(f"Restarts:   {job.status.restart_count}")
     if job.status.submit_time:
         print(f"Submitted:  {time.ctime(job.status.submit_time)}")
+    if job.metadata.labels:
+        print("Labels:     " + ", ".join(f"{k}={v}" for k, v in job.metadata.labels.items()))
+    if job.metadata.annotations:
+        print("Annotations:")
+        for k, v in sorted(job.metadata.annotations.items()):
+            print(f"  {k}: {v}")
     lat = schedule_to_first_step_latency(job)
     if lat is not None:
         print(f"Schedule-to-first-step: {lat:.3f}s")

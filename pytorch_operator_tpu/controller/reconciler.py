@@ -81,6 +81,7 @@ class Reconciler:
         # O(jobs) not O(jobs²) in queue accounting.
         self._pass_reservations: dict = {}
         self._pass_queue_used = None
+        self._in_pass = False
         self._unschedulable_warned = set()
         # Per-file byte offsets for incremental status-report scanning.
         self._scan_offsets = {}
@@ -124,9 +125,20 @@ class Reconciler:
         the Unschedulable event is the operator's signal.
         """
         self._pass_reservations = {}
-        if self.queue_slots is None:
-            self._pass_queue_used = None
-            return
+        self._in_pass = True
+        self._pass_queue_used = (
+            self._compute_queue_usage() if self.queue_slots is not None else None
+        )
+
+    def end_pass(self) -> None:
+        """Close a supervisor pass: solo syncs (foreground ``wait()``) must
+        not admit against the pass's stale reservations or queue cache."""
+        self._in_pass = False
+
+    def _compute_queue_usage(self) -> dict:
+        """{queue: active replica count} over every job in the store — the
+        ONE implementation of queue accounting (begin_pass caches it for a
+        pass; solo syncs compute it fresh)."""
         used: dict = {}
         for key in self.store.keys():
             job = self.store.get(key)
@@ -136,7 +148,7 @@ class Reconciler:
             n = sum(1 for h in self.runner.list_for_job(key) if h.is_active())
             if n:
                 used[q] = used.get(q, 0) + n
-        self._pass_queue_used = used
+        return used
 
     def _queue_free(self, job: TPUJob, key: str) -> Optional[int]:
         """Free replica slots in the job's queue (volcano queue analog):
@@ -148,20 +160,11 @@ class Reconciler:
         cap = self.queue_slots.get(qname)
         if cap is None:
             return None
-        if self._pass_queue_used is not None:
+        if self._in_pass and self._pass_queue_used is not None:
             used = self._pass_queue_used.get(qname, 0)
         else:
-            # Solo sync (foreground run): compute directly.
-            used = 0
-            for other_key in self.store.keys():
-                other = self.store.get(other_key)
-                if other is None:
-                    continue
-                oq = other.spec.run_policy.scheduling_policy.queue or "default"
-                if oq == qname:
-                    used += sum(
-                        1 for h in self.runner.list_for_job(other_key) if h.is_active()
-                    )
+            # Solo sync (foreground run): compute fresh.
+            used = self._compute_queue_usage().get(qname, 0)
         return max(0, cap - used)
 
     def _fail_job(self, job: TPUJob, key: str, reason: str, message: str, now: float):
@@ -401,10 +404,12 @@ class Reconciler:
             min_needed = max(0, min_avail - active_now) if gang_on else 1
             min_needed = max(1, min(min_needed, len(missing)))
             slots = self.runner.schedulable_slots()
-            if slots is not None:
+            if slots is not None and self._in_pass:
                 # Capacity claimed by OTHER (higher-priority, synced
                 # earlier) held gangs is off-limits — no starvation by
                 # small jobs; a job's own reservation never blocks it.
+                # Solo syncs (foreground wait) ignore reservations: they
+                # are meaningful only within a priority-ordered pass.
                 reserved_others = sum(
                     v
                     for k2, v in list(self._pass_reservations.items())
@@ -432,17 +437,19 @@ class Reconciler:
                     )
                 # Reserve this gang's demand against lower-priority jobs
                 # synced later in the pass.
-                self._pass_reservations[key] = len(missing)
+                if self._in_pass:
+                    self._pass_reservations[key] = len(missing)
                 self.store.update(job)
                 return True
             self._unschedulable_warned.discard(key)
-            if n_admit < len(missing):
-                # Stragglers of a partially-admitted gang keep their claim.
-                self._pass_reservations[key] = len(missing) - n_admit
-            else:
-                self._pass_reservations.pop(key, None)
+            if self._in_pass:
+                if n_admit < len(missing):
+                    # Stragglers of a partially-admitted gang keep their claim.
+                    self._pass_reservations[key] = len(missing) - n_admit
+                else:
+                    self._pass_reservations.pop(key, None)
             missing = missing[:n_admit]
-            if self._pass_queue_used is not None:
+            if self._in_pass and self._pass_queue_used is not None:
                 qname = policy.queue or "default"
                 self._pass_queue_used[qname] = (
                     self._pass_queue_used.get(qname, 0) + n_admit

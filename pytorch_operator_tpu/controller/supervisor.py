@@ -195,14 +195,18 @@ class Supervisor:
             )
         )
         # Reset the pass-scoped scheduling state (priority reservations,
-        # queue-usage cache) before admitting in priority order.
+        # queue-usage cache) before admitting in priority order; close the
+        # pass afterwards so solo syncs never see its stale state.
         self.reconciler.begin_pass()
-        for key, job in jobs:
-            if job.is_finished():
-                self._gc_ttl(job, key, now)
-                continue
-            if self.reconciler.sync(key, now=now):
-                any_active = True
+        try:
+            for key, job in jobs:
+                if job.is_finished():
+                    self._gc_ttl(job, key, now)
+                    continue
+                if self.reconciler.sync(key, now=now):
+                    any_active = True
+        finally:
+            self.reconciler.end_pass()
         return any_active
 
     def _gc_ttl(self, job: TPUJob, key: str, now: float) -> None:

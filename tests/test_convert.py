@@ -1,0 +1,267 @@
+"""PyTorchJob → TPUJob conversion (the migration shim, api/convert.py).
+
+A user of the reference submits ``kind: PyTorchJob`` manifests
+(kubeflow.org/v1, camelCase, pod templates); these must load, default,
+validate, and run through the supervisor unchanged.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pytorch_operator_tpu.api import (
+    CleanPodPolicy,
+    ReplicaType,
+    RestartPolicy,
+    ValidationError,
+    loads_job,
+    set_defaults,
+    validate,
+)
+from pytorch_operator_tpu.api.convert import CONVERTED_FROM_ANNOTATION
+
+MNIST_PYTORCHJOB = """
+apiVersion: kubeflow.org/v1
+kind: PyTorchJob
+metadata:
+  name: mnist
+  namespace: team-a
+  labels: {app: mnist}
+spec:
+  runPolicy:
+    cleanPodPolicy: All
+    ttlSecondsAfterFinished: 120
+    backoffLimit: 3
+    schedulingPolicy:
+      minAvailable: 2
+      queue: training
+      priorityClass: high
+  pytorchReplicaSpecs:
+    Master:
+      replicas: 1
+      restartPolicy: OnFailure
+      template:
+        spec:
+          containers:
+            - name: pytorch
+              image: gcr.io/kubeflow/mnist:latest
+              command: [python, /opt/mnist.py]
+              args: ["--epochs", "2"]
+              env:
+                - name: LR
+                  value: "0.01"
+                - name: SECRET
+                  valueFrom: {secretKeyRef: {name: s, key: k}}
+              ports:
+                - name: pytorchjob-port
+                  containerPort: 23456
+              resources:
+                limits: {google.com/tpu: 4}
+    Worker:
+      replicas: 2
+      restartPolicy: ExitCode
+      template:
+        spec:
+          containers:
+            - name: pytorch
+              command: [python, /opt/mnist.py]
+"""
+
+
+class TestConvert:
+    def test_full_manifest_maps(self):
+        job = loads_job(MNIST_PYTORCHJOB)
+        assert job.kind == "TPUJob"
+        assert job.metadata.name == "mnist"
+        assert job.metadata.namespace == "team-a"
+        assert job.metadata.labels == {"app": "mnist"}
+        assert "kubeflow.org/v1 PyTorchJob" in job.metadata.annotations[
+            CONVERTED_FROM_ANNOTATION
+        ]
+
+        master = job.spec.replica_specs[ReplicaType.MASTER]
+        assert master.replicas == 1
+        assert master.restart_policy == RestartPolicy.ON_FAILURE
+        assert master.template.command == ["python", "/opt/mnist.py"]
+        assert master.template.args == ["--epochs", "2"]
+        assert master.template.env == {"LR": "0.01"}
+        assert master.template.resources.tpu_chips == 4
+
+        worker = job.spec.replica_specs[ReplicaType.WORKER]
+        assert worker.replicas == 2
+        assert worker.restart_policy == RestartPolicy.EXIT_CODE
+
+        rp = job.spec.run_policy
+        assert rp.clean_pod_policy == CleanPodPolicy.ALL
+        assert rp.ttl_seconds_after_finished == 120
+        assert rp.backoff_limit == 3
+        assert rp.scheduling_policy.min_available == 2
+        assert rp.scheduling_policy.queue == "training"
+        assert job.spec.port == 23456
+
+        # What cannot map is surfaced as annotations, not dropped silently.
+        ann = job.metadata.annotations
+        assert ann["tpujob.dev/converted-image-master"].startswith("gcr.io/")
+        assert ann["tpujob.dev/converted-env-dropped-master"] == "SECRET"
+        assert ann["tpujob.dev/converted-priority-class"] == "high"
+
+        # The converted job passes the normal defaulting + validation path.
+        set_defaults(job)
+        validate(job)
+
+    def test_v1beta2_spec_level_run_policy(self):
+        job = loads_job(
+            """
+apiVersion: kubeflow.org/v1beta2
+kind: PyTorchJob
+metadata: {name: old}
+spec:
+  cleanPodPolicy: None
+  ttlSecondsAfterFinished: 60
+  pytorchReplicaSpecs:
+    Master:
+      replicas: 1
+      template:
+        spec:
+          containers:
+            - {name: pytorch, command: [sh, -c, "exit 0"]}
+"""
+        )
+        assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.NONE
+        assert job.spec.run_policy.ttl_seconds_after_finished == 60
+
+    def test_elastic_policy_maps(self):
+        job = loads_job(
+            """
+kind: PyTorchJob
+metadata: {name: el}
+spec:
+  elasticPolicy: {minReplicas: 1, maxReplicas: 4, maxRestarts: 7, nProcPerNode: 2}
+  pytorchReplicaSpecs:
+    Master:
+      template:
+        spec:
+          containers: [{name: pytorch, command: [sh, -c, "exit 0"]}]
+    Worker:
+      replicas: 2
+      template:
+        spec:
+          containers: [{name: pytorch, command: [sh, -c, "exit 0"]}]
+"""
+        )
+        ep = job.spec.elastic_policy
+        assert (ep.min_replicas, ep.max_replicas, ep.max_restarts) == (1, 4, 7)
+        assert job.metadata.annotations["tpujob.dev/converted-nproc-per-node"] == "2"
+
+    def test_image_without_command_is_a_clear_error(self):
+        with pytest.raises(ValueError, match="no command"):
+            loads_job(
+                """
+kind: PyTorchJob
+metadata: {name: img}
+spec:
+  pytorchReplicaSpecs:
+    Master:
+      template:
+        spec:
+          containers: [{name: pytorch, image: gcr.io/x/entrypoint-only}]
+"""
+            )
+
+    def test_converted_job_runs_end_to_end(self, tmp_path):
+        """A PyTorchJob manifest drives the real supervisor to completion."""
+        from pytorch_operator_tpu.controller.supervisor import Supervisor
+
+        job = loads_job(
+            """
+kind: PyTorchJob
+metadata: {name: converted-e2e}
+spec:
+  pytorchReplicaSpecs:
+    Master:
+      restartPolicy: OnFailure
+      template:
+        spec:
+          containers:
+            - name: pytorch
+              command: [sh, -c, "echo converted; exit 0"]
+"""
+        )
+        sup = Supervisor(state_dir=tmp_path / "state")
+        final = sup.run(job, timeout=30)
+        assert final.is_succeeded()
+        sup.shutdown()
+
+    def test_example_manifest_loads(self):
+        from pytorch_operator_tpu.api import load_job
+
+        job = load_job("examples/pytorchjob-migration.yaml")
+        set_defaults(job)
+        validate(job)
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 1
+
+    def test_all_dropped_env_vars_surfaced(self):
+        job = loads_job(
+            """
+kind: PyTorchJob
+metadata: {name: secrets}
+spec:
+  pytorchReplicaSpecs:
+    Master:
+      template:
+        spec:
+          containers:
+            - name: pytorch
+              command: [sh, -c, "exit 0"]
+              env:
+                - {name: DB_PASS, valueFrom: {secretKeyRef: {name: s, key: a}}}
+                - {name: API_KEY, valueFrom: {secretKeyRef: {name: s, key: b}}}
+"""
+        )
+        assert (
+            job.metadata.annotations["tpujob.dev/converted-env-dropped-master"]
+            == "DB_PASS,API_KEY"
+        )
+
+    def test_master_port_wins_over_worker(self):
+        job = loads_job(
+            """
+kind: PyTorchJob
+metadata: {name: ports}
+spec:
+  pytorchReplicaSpecs:
+    Worker:
+      replicas: 1
+      template:
+        spec:
+          containers:
+            - name: pytorch
+              command: [sh, -c, "exit 0"]
+              ports: [{name: pytorchjob-port, containerPort: 29500}]
+    Master:
+      template:
+        spec:
+          containers:
+            - name: pytorch
+              command: [sh, -c, "exit 0"]
+              ports: [{name: pytorchjob-port, containerPort: 23456}]
+"""
+        )
+        assert job.spec.port == 23456
+
+    def test_missing_replica_specs_rejected(self):
+        with pytest.raises(ValueError, match="pytorchReplicaSpecs"):
+            loads_job("kind: PyTorchJob\nmetadata: {name: x}\nspec: {}")
+
+    def test_native_tpujob_yaml_unaffected(self):
+        job = loads_job(
+            """
+api_version: tpujob.dev/v1
+kind: TPUJob
+metadata: {name: plain}
+spec:
+  replica_specs:
+    Master: {replicas: 1, template: {module: pytorch_operator_tpu.workloads.noop}}
+"""
+        )
+        assert CONVERTED_FROM_ANNOTATION not in job.metadata.annotations

@@ -213,6 +213,25 @@ class TestReservation:
         assert "queue" not in ev[0].message
 
 
+class TestSoloSyncIsolation:
+    def test_foreground_wait_ignores_stale_pass_reservations(self, tmp_path):
+        """A held gang's reservation from a daemon-style sync_once pass must
+        not starve a later foreground run(): solo syncs ignore pass state."""
+        sup = make_sup(capacity=2)
+        big = new_job(name="big", workers=3)  # gang of 4 > 2 → held, reserves
+        sup.submit(big)
+        sup.sync_once()
+        small_key = sup.submit(new_job(name="small", workers=0))
+        # Foreground wait() path = solo reconciler.sync calls, no pass.
+        sup.reconciler.sync(small_key)
+        assert len(sup.runner.list_for_job(small_key)) == 1  # admitted
+        # A daemon pass still honors the reservation: nothing for big, and
+        # a THIRD job submitted at prio 0 is blocked by big's claim.
+        third_key = sup.submit(new_job(name="third", workers=0))
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(third_key)) == 0
+
+
 class TestCLIQueueSlots:
     def test_parse_and_reject(self):
         import pytest
