@@ -80,6 +80,15 @@ def convert_pytorchjob(data: Dict[str, Any]) -> Dict[str, Any]:
         if legacy_key not in rp_in and legacy_key in spec:
             rp_in[legacy_key] = spec[legacy_key]
     run_policy: Dict[str, Any] = {}
+    if rp_in.get("suspend"):
+        # Real field (training-operator / Kueue): create-but-don't-run.
+        run_policy["suspend"] = True
+    if rp_in.get("schedulingPolicy", {}) and (
+        rp_in["schedulingPolicy"].get("scheduleTimeoutSeconds") is not None
+    ):
+        annotations["tpujob.dev/converted-schedule-timeout-seconds"] = str(
+            rp_in["schedulingPolicy"]["scheduleTimeoutSeconds"]
+        )
     if rp_in.get("cleanPodPolicy") is not None:
         run_policy["clean_pod_policy"] = rp_in["cleanPodPolicy"]
     for camel, snake in (

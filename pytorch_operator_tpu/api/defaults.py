@@ -21,6 +21,7 @@ from __future__ import annotations
 from .types import (
     DEFAULT_PORT,
     CleanPodPolicy,
+    ReplicaType,
     RestartPolicy,
     TPUJob,
 )
@@ -31,6 +32,12 @@ from .types import (
 # submission path funnels through — so CLI-queued and API-submitted jobs
 # behave identically.
 AUTO_PORT_ANNOTATION = "tpujob.dev/auto-port"
+
+# Elastic jobs remember the worker count the user ASKED for: under capacity
+# pressure the world launches smaller (down to min_replicas, torchelastic
+# rendezvous-min semantics) and the reconciler grows it back toward this
+# target as capacity frees. Manual `tpujob scale` re-pins it.
+ELASTIC_TARGET_ANNOTATION = "tpujob.dev/elastic-target-workers"
 
 
 def set_defaults(job: TPUJob) -> TPUJob:
@@ -50,6 +57,18 @@ def set_defaults(job: TPUJob) -> TPUJob:
     rp = spec.run_policy
     if rp.clean_pod_policy is None:
         rp.clean_pod_policy = CleanPodPolicy.RUNNING
+    if spec.elastic_policy is not None:
+        workers = spec.replica_specs.get(ReplicaType.WORKER)
+        if workers is not None:
+            job.metadata.annotations.setdefault(
+                ELASTIC_TARGET_ANNOTATION, str(workers.replicas)
+            )
+        # Elastic gang floor: master + min_replicas may start (torchelastic
+        # rendezvous min), not the full desired world.
+        if rp.scheduling_policy.min_available is None:
+            rp.scheduling_policy.min_available = min(
+                spec.total_replicas(), 1 + spec.elastic_policy.min_replicas
+            )
     if rp.scheduling_policy.min_available is None:
         rp.scheduling_policy.min_available = spec.total_replicas()
 

@@ -116,6 +116,7 @@ class ConditionType(str, enum.Enum):
     CREATED = "Created"
     RUNNING = "Running"
     RESTARTING = "Restarting"
+    SUSPENDED = "Suspended"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
 
@@ -301,9 +302,15 @@ class RunPolicy:
     active_deadline_seconds: Optional[int] = None
     backoff_limit: Optional[int] = None  # max total restarts before Failed
     scheduling_policy: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+    # Create-but-don't-run (reference: training-operator RunPolicy.suspend,
+    # the Kueue integration point): while True, no replicas run — a live
+    # world is torn down — and the job waits in Suspended until resumed.
+    suspend: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"scheduling_policy": self.scheduling_policy.to_dict()}
+        if self.suspend:
+            d["suspend"] = True
         if self.clean_pod_policy is not None:
             d["clean_pod_policy"] = self.clean_pod_policy.value
         for k in ("ttl_seconds_after_finished", "active_deadline_seconds", "backoff_limit"):
@@ -329,6 +336,7 @@ class RunPolicy:
             ),
             backoff_limit=_parse_opt_int(d, "backoff_limit", "run_policy.backoff_limit"),
             scheduling_policy=SchedulingPolicy.from_dict(d.get("scheduling_policy") or {}),
+            suspend=bool(d.get("suspend", False)),
         )
 
 
@@ -611,15 +619,27 @@ class TPUJob:
 
         if status:
             exclusive: Dict[ConditionType, List[ConditionType]] = {
-                ConditionType.RUNNING: [ConditionType.RESTARTING],
-                ConditionType.RESTARTING: [ConditionType.RUNNING],
+                ConditionType.RUNNING: [
+                    ConditionType.RESTARTING,
+                    ConditionType.SUSPENDED,
+                ],
+                ConditionType.RESTARTING: [
+                    ConditionType.RUNNING,
+                    ConditionType.SUSPENDED,
+                ],
+                ConditionType.SUSPENDED: [
+                    ConditionType.RUNNING,
+                    ConditionType.RESTARTING,
+                ],
                 ConditionType.SUCCEEDED: [
                     ConditionType.RUNNING,
                     ConditionType.RESTARTING,
+                    ConditionType.SUSPENDED,
                 ],
                 ConditionType.FAILED: [
                     ConditionType.RUNNING,
                     ConditionType.RESTARTING,
+                    ConditionType.SUSPENDED,
                 ],
             }
             for other in exclusive.get(ctype, []):

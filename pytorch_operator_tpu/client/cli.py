@@ -54,6 +54,7 @@ def _phase_of(job) -> str:
     for ct in (
         ConditionType.SUCCEEDED,
         ConditionType.FAILED,
+        ConditionType.SUSPENDED,
         ConditionType.RESTARTING,
         ConditionType.RUNNING,
         ConditionType.CREATED,
@@ -233,6 +234,7 @@ def cmd_supervisor(args) -> int:
             sup.store.rescan()
             sup.process_deletion_markers()
             sup.process_scale_markers()
+            sup.process_suspend_markers()
             sup.sync_once()
             sup.write_metrics_file()
             time.sleep(args.interval)
@@ -436,6 +438,32 @@ def cmd_scale(args) -> int:
     return 0
 
 
+def _cmd_set_suspend(args, flag: bool) -> int:
+    """Suspend/resume: leave a marker for the owning supervisor (it owns
+    the replica processes, so it performs the teardown/relaunch)."""
+    state = _state_dir(args)
+    key = _resolve_key(args)
+    store = JobStore(persist_dir=state / "jobs")
+    job = store.get(key)
+    if job is None:
+        print(f"error: tpujob {key} not found", file=sys.stderr)
+        return 1
+    if job.is_finished():
+        print(f"error: tpujob {key} already finished", file=sys.stderr)
+        return 2
+    store.mark_suspend(key, flag)
+    print(f"tpujob {key} {'suspend' if flag else 'resume'} requested")
+    return 0
+
+
+def cmd_suspend(args) -> int:
+    return _cmd_set_suspend(args, True)
+
+
+def cmd_resume(args) -> int:
+    return _cmd_set_suspend(args, False)
+
+
 def cmd_metrics(args) -> int:
     path = _state_dir(args) / "metrics.prom"
     if not path.exists():
@@ -526,6 +554,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--workers", type=int, required=True)
     add_ns(sp)
     sp.set_defaults(func=cmd_scale)
+
+    sp = sub.add_parser(
+        "suspend", help="suspend a job (tear down replicas, keep the job)"
+    )
+    sp.add_argument("name")
+    add_ns(sp)
+    sp.set_defaults(func=cmd_suspend)
+
+    sp = sub.add_parser("resume", help="resume a suspended job")
+    sp.add_argument("name")
+    add_ns(sp)
+    sp.set_defaults(func=cmd_resume)
 
     sp = sub.add_parser("metrics", help="print supervisor metrics")
     sp.set_defaults(func=cmd_metrics)

@@ -19,7 +19,11 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
-from ..api.defaults import AUTO_PORT_ANNOTATION, set_defaults
+from ..api.defaults import (
+    AUTO_PORT_ANNOTATION,
+    ELASTIC_TARGET_ANNOTATION,
+    set_defaults,
+)
 from ..api.types import (
     CleanPodPolicy,
     ConditionType,
@@ -167,6 +171,65 @@ class Reconciler:
             used = self._compute_queue_usage().get(qname, 0)
         return max(0, cap - used)
 
+    def _sync_suspended(self, job: TPUJob, key: str, now: float) -> bool:
+        """Hold a suspended job: kill live replicas, keep the job object.
+
+        The deadline clock resets (start_time cleared) so a later resume
+        gets its full activeDeadlineSeconds — k8s suspend semantics.
+        """
+        self._delete_replicas(
+            h for h in self.runner.list_for_job(key) if h.is_active()
+        )
+        if not job.has_condition(ConditionType.SUSPENDED):
+            job.set_condition(
+                ConditionType.SUSPENDED, reason="TPUJobSuspended",
+                message=f"TPUJob {key} is suspended.", now=now,
+            )
+            self.events.normal(key, "TPUJobSuspended", f"TPUJob {key} is suspended.")
+        job.status.start_time = None
+        update_replica_statuses(job, self.runner.list_for_job(key))
+        self.store.update(job)
+        return True
+
+    def restart_world(
+        self,
+        job: TPUJob,
+        key: str,
+        handles: List[ReplicaHandle],
+        reason: str,
+        message: str,
+        now: Optional[float] = None,
+        warning: bool = True,
+    ) -> None:
+        """Tear down the whole gang for a re-rendezvous: delete every
+        replica, spend one restart, set RESTARTING, record the event. The
+        ONE implementation shared by failure restarts, elastic grow-back,
+        and manual scale."""
+        self._delete_replicas(handles)
+        job.status.restart_count += 1
+        self.metrics.jobs_restarted.inc()
+        job.set_condition(
+            ConditionType.RESTARTING, reason=reason, message=message, now=now
+        )
+        (self.events.warning if warning else self.events.normal)(key, reason, message)
+
+    def _delete_replicas(self, handles) -> None:
+        """Teardown accounting in one place: delete + metric per replica."""
+        for h in handles:
+            self.runner.delete(h.name)
+            self.metrics.replicas_deleted.inc()
+
+    def _slots_minus_reserved(self, key: str) -> Optional[int]:
+        """Free runner slots, excluding capacity claimed by OTHER held
+        gangs in the current pass (a job's own claim never blocks it)."""
+        slots = self.runner.schedulable_slots()
+        if slots is not None and self._in_pass:
+            reserved_others = sum(
+                v for k2, v in list(self._pass_reservations.items()) if k2 != key
+            )
+            slots = max(0, slots - reserved_others)
+        return slots
+
     def _fail_job(self, job: TPUJob, key: str, reason: str, message: str, now: float):
         job.set_condition(
             ConditionType.FAILED, reason=reason, message=message, now=now
@@ -184,13 +247,13 @@ class Reconciler:
         """
         policy = job.spec.run_policy.clean_pod_policy or CleanPodPolicy.RUNNING
         handles = self.runner.list_for_job(key)
-        for h in handles:
-            if policy == CleanPodPolicy.NONE:
-                break
-            if policy == CleanPodPolicy.RUNNING and not h.is_active():
-                continue  # leave finished replicas' records/logs in place
-            self.runner.delete(h.name)
-            self.metrics.replicas_deleted.inc()
+        if policy != CleanPodPolicy.NONE:
+            self._delete_replicas(
+                h
+                for h in handles
+                # RUNNING leaves finished replicas' records/logs in place.
+                if not (policy == CleanPodPolicy.RUNNING and not h.is_active())
+            )
         self.gang.delete_group(key)
         self.expectations.delete_expectations(key)
         self._unschedulable_warned.discard(key)
@@ -304,6 +367,17 @@ class Reconciler:
             # schedule-to-first-step latency.
             self._reset_status_dir(key)
 
+        # Suspend (reference: training-operator RunPolicy.suspend): tear
+        # down any live world, mark Suspended, and wait for a resume.
+        if job.spec.run_policy.suspend:
+            return self._sync_suspended(job, key, now)
+        if job.has_condition(ConditionType.SUSPENDED):
+            job.set_condition(
+                ConditionType.SUSPENDED, status=False,
+                reason="TPUJobResumed", message=f"TPUJob {key} resumed.", now=now,
+            )
+            self.events.normal(key, "TPUJobResumed", f"TPUJob {key} resumed.")
+
         # ActiveDeadlineSeconds (reference: RunPolicy deadline → Failed).
         deadline = job.spec.run_policy.active_deadline_seconds
         if (
@@ -403,19 +477,7 @@ class Reconciler:
             gang_on = self.gang.enabled and policy.gang
             min_needed = max(0, min_avail - active_now) if gang_on else 1
             min_needed = max(1, min(min_needed, len(missing)))
-            slots = self.runner.schedulable_slots()
-            if slots is not None and self._in_pass:
-                # Capacity claimed by OTHER (higher-priority, synced
-                # earlier) held gangs is off-limits — no starvation by
-                # small jobs; a job's own reservation never blocks it.
-                # Solo syncs (foreground wait) ignore reservations: they
-                # are meaningful only within a priority-ordered pass.
-                reserved_others = sum(
-                    v
-                    for k2, v in list(self._pass_reservations.items())
-                    if k2 != key
-                )
-                slots = max(0, slots - reserved_others)
+            slots = self._slots_minus_reserved(key)
             queue_free = self._queue_free(job, key)
             n_admit = self.gang.admissible(len(missing), min_needed, slots, queue_free)
             if n_admit == 0:
@@ -442,6 +504,34 @@ class Reconciler:
                 self.store.update(job)
                 return True
             self._unschedulable_warned.discard(key)
+            # Elastic capacity adaptation (torchelastic rendezvous-min
+            # semantics): rather than launching a partial world that blocks
+            # at rendezvous, SHRINK the desired world to what was admitted
+            # (>= master + min_replicas, guaranteed by the admission floor)
+            # and run it; _maybe_grow_elastic restores it as capacity frees.
+            if (
+                job.spec.elastic_policy is not None
+                and gang_on
+                and not handles
+                and n_admit < len(missing)
+            ):
+                workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+                if workers is not None and n_admit - 1 >= (
+                    job.spec.elastic_policy.min_replicas
+                ):
+                    workers.replicas = n_admit - 1  # master takes one slot
+                    msg = (
+                        f"elastic launch shrunk to {workers.replicas} "
+                        f"worker(s) to fit available capacity (target "
+                        f"{job.metadata.annotations.get(ELASTIC_TARGET_ANNOTATION)})."
+                    )
+                    self.events.warning(key, "ElasticScaledDown", msg)
+                    missing = [
+                        (rt, i)
+                        for rt in job.spec.replica_specs
+                        for i in range(self._desired_replicas(job, rt))
+                        if self.runner.get(replica_name(key, rt, i)) is None
+                    ]
             if self._in_pass:
                 if n_admit < len(missing):
                     # Stragglers of a partially-admitted gang keep their claim.
@@ -495,6 +585,11 @@ class Reconciler:
                 )
             handles = self.runner.list_for_job(key)
 
+        # ---- elastic grow-back toward the submitted target ----
+        if self._maybe_grow_elastic(job, key, handles, now):
+            self.store.update(job)
+            return True
+
         # ---- Running condition ----
         master = master_handle(handles)
         if master is not None and master.phase == ReplicaPhase.RUNNING:
@@ -513,6 +608,85 @@ class Reconciler:
 
     def _desired_replicas(self, job: TPUJob, rtype: ReplicaType) -> int:
         return job.spec.replica_specs[rtype].replicas or 0
+
+    def _maybe_grow_elastic(
+        self, job: TPUJob, key: str, handles: List[ReplicaHandle], now: float
+    ) -> bool:
+        """Grow a capacity-shrunk elastic world back toward its submitted
+        target when slots free up (the reverse of ElasticScaledDown).
+
+        Growth is a membership change: the whole gang re-rendezvouses, so
+        it spends one restart from the elastic budget — and is skipped when
+        the budget is exhausted (growth must never fail the job).
+        """
+        elastic = job.spec.elastic_policy
+        if elastic is None:
+            return False
+        workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+        if workers is None:
+            return False
+        try:
+            target = int(
+                job.metadata.annotations.get(ELASTIC_TARGET_ANNOTATION, "")
+            )
+        except ValueError:
+            return False
+        # The annotation is user-writable: never grow past the validated
+        # elastic bound.
+        target = min(target, elastic.max_replicas)
+        cur = workers.replicas or 0
+        if target <= cur:
+            return False
+        backoff = job.spec.run_policy.backoff_limit
+        if job.status.restart_count + 1 > elastic.max_restarts or (
+            # Growth must never fail the job NOR spend the failure budget
+            # down to the point where the next real failure kills it: after
+            # growing, at least one failure-restart must remain.
+            backoff is not None
+            and job.status.restart_count + 2 > backoff
+        ):
+            return False
+        # Only grow a stable, fully-running world (not one mid-launch).
+        desired_total = sum(
+            self._desired_replicas(job, rt) for rt in job.spec.replica_specs
+        )
+        master = master_handle(handles)
+        if (
+            len([h for h in handles if h.is_active()]) < desired_total
+            or master is None
+            or master.phase != ReplicaPhase.RUNNING
+        ):
+            return False
+        slots = self._slots_minus_reserved(key)
+        queue_free = self._queue_free(job, key)
+        bounds = [b for b in (slots, queue_free) if b is not None]
+        grow = min([target - cur] + bounds) if bounds else target - cur
+        if grow <= 0:
+            return False
+        workers.replicas = cur + grow
+        msg = (
+            f"elastic grow-back to {workers.replicas} worker(s) toward "
+            f"target {target} (restart #{job.status.restart_count + 1})."
+        )
+        # Membership change → tear down the world; next sync relaunches it
+        # at the new size (same path as Supervisor.scale).
+        self.restart_world(
+            job, key, handles, "ElasticScaledUp", msg, now=now, warning=False
+        )
+        if self._in_pass:
+            # The torn-down world's slots are spoken for: the grown gang
+            # relaunches next sync. Without this claim, jobs synced later
+            # in the pass steal the capacity and the restart was wasted.
+            new_total = sum(
+                self._desired_replicas(job, rt) for rt in job.spec.replica_specs
+            )
+            self._pass_reservations[key] = new_total
+            if self._pass_queue_used is not None:
+                qname = job.spec.run_policy.scheduling_policy.queue or "default"
+                self._pass_queue_used[qname] = (
+                    self._pass_queue_used.get(qname, 0) + grow
+                )
+        return True
 
     def _handle_restarts(
         self,
@@ -558,16 +732,14 @@ class Reconciler:
                 self.store.update(job)
                 return False
             # Gang re-rendezvous: tear down the whole world.
-            for h in handles:
-                self.runner.delete(h.name)
-                self.metrics.replicas_deleted.inc()
-            job.status.restart_count += 1
-            self.metrics.jobs_restarted.inc()
-            reason = "TPUJobRestarting"
             msg = (
                 f"elastic re-rendezvous: membership change "
-                f"(restart #{job.status.restart_count})."
+                f"(restart #{job.status.restart_count + 1})."
             )
+            self.restart_world(job, key, handles, "TPUJobRestarting", msg, now=now)
+            update_replica_statuses(job, self.runner.list_for_job(key))
+            self.store.update(job)
+            return True
         else:
             for h in restarts:
                 self.runner.delete(h.name)

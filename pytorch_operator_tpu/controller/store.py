@@ -175,6 +175,33 @@ class JobStore:
             return
         self._marker_path(key, "delete").unlink(missing_ok=True)
 
+    def mark_suspend(self, key: str, suspend: bool) -> None:
+        """Leave a cross-process suspend/resume request."""
+        if self.persist_dir is None:
+            return
+        self._marker_path(key, "suspend").write_text("1" if suspend else "0")
+
+    def take_suspend_markers(self) -> List[tuple]:
+        """Atomically claim pending suspend/resume requests: (key, bool).
+        Claim-by-rename, same contract as take_scale_markers."""
+        if self.persist_dir is None:
+            return []
+        out = []
+        for p in sorted(self.persist_dir.glob("*.suspend")):
+            claimed = p.with_name(p.name + "-claimed")
+            try:
+                p.rename(claimed)
+            except OSError:
+                continue
+            try:
+                flag = claimed.read_text().strip() == "1"
+            except OSError:
+                flag = None
+            claimed.unlink(missing_ok=True)
+            if flag is not None:
+                out.append((p.stem.replace("_", "/", 1), flag))
+        return out
+
     def mark_scale(self, key: str, workers: int) -> None:
         """Leave a cross-process elastic resize request."""
         if self.persist_dir is None:

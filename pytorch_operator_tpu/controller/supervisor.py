@@ -14,7 +14,7 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
-from ..api.defaults import set_defaults
+from ..api.defaults import ELASTIC_TARGET_ANNOTATION, set_defaults
 from ..api.types import ConditionType, ReplicaType, TPUJob
 from ..api.validation import ValidationError, validate
 from .events import EventRecorder
@@ -147,26 +147,25 @@ class Supervisor:
             workers = job.spec.replica_specs.get(ReplicaType.WORKER)
             if workers is None:
                 raise ValidationError(["scale: job has no Worker replicas"])
+            # Manual resize re-pins the elastic grow-back target: the
+            # operator's explicit choice must not be undone by the
+            # reconciler growing back to the original submit-time count.
+            job.metadata.annotations[ELASTIC_TARGET_ANNOTATION] = str(worker_replicas)
             if workers.replicas == worker_replicas:
+                self.store.update(job)
                 return job
             workers.replicas = worker_replicas
             # Membership change → tear down the world; next sync re-creates
             # it with the new WORLD_SIZE (elastic re-rendezvous).
             handles = self.runner.list_for_job(key)
             if handles and not job.is_finished():
-                for h in handles:
-                    self.runner.delete(h.name)
-                    self.metrics.replicas_deleted.inc()
-                job.status.restart_count += 1
-                self.metrics.jobs_restarted.inc()
                 msg = (
                     f"elastic resize to {worker_replicas} workers "
-                    f"(restart #{job.status.restart_count})."
+                    f"(restart #{job.status.restart_count + 1})."
                 )
-                job.set_condition(
-                    ConditionType.RESTARTING, reason="TPUJobScaled", message=msg
+                self.reconciler.restart_world(
+                    job, key, handles, "TPUJobScaled", msg, warning=False
                 )
-                self.events.normal(key, "TPUJobScaled", msg)
             self.store.update(job)
             return job
 
@@ -251,6 +250,17 @@ class Supervisor:
             purge = self.store.marker_requests_purge(key)
             self.delete_job(key, purge_artifacts=purge)
             self.store.clear_deletion_marker(key)
+
+    def process_suspend_markers(self) -> None:
+        """Act on cross-process ``tpujob suspend``/``resume`` requests."""
+        for key, flag in self.store.take_suspend_markers():
+            with self.reconciler.key_lock(key):
+                job = self.store.get(key)
+                if job is None or job.is_finished():
+                    continue
+                if job.spec.run_policy.suspend != flag:
+                    job.spec.run_policy.suspend = flag
+                    self.store.update(job)
 
     def process_scale_markers(self) -> None:
         """Act on cross-process ``tpujob scale`` requests (elastic resize)."""

@@ -1,0 +1,187 @@
+"""Capacity-adaptive elastic worlds (torchelastic rendezvous-min semantics).
+
+Reference: torchelastic runs with whatever worker count is available in
+[min_replicas, max_replicas] and re-rendezvouses on membership change
+(SURVEY.md §2 "Elastic", examples/elastic). Here: an elastic job under
+capacity pressure launches SHRUNK (master + >= min_replicas workers) with a
+correspondingly smaller WORLD_SIZE, then grows back toward the submitted
+target as slots free — each growth a gang re-rendezvous spending one
+restart from the elastic budget.
+"""
+
+from __future__ import annotations
+
+from pytorch_operator_tpu.api.defaults import ELASTIC_TARGET_ANNOTATION
+from pytorch_operator_tpu.api.types import ElasticPolicy, ReplicaPhase, ReplicaType
+from pytorch_operator_tpu.controller.runner import FakeRunner, replica_name
+from pytorch_operator_tpu.controller.supervisor import Supervisor
+from tests.testutil import new_job
+
+
+def make_sup(capacity):
+    return Supervisor(
+        state_dir=None, runner=FakeRunner(capacity=capacity), persist=False
+    )
+
+
+def elastic_job(name="el", workers=3, min_replicas=1, max_restarts=8):
+    return new_job(
+        name=name,
+        workers=workers,
+        elastic=ElasticPolicy(
+            min_replicas=min_replicas, max_replicas=workers, max_restarts=max_restarts
+        ),
+    )
+
+
+class TestElasticShrink:
+    def test_launches_shrunk_under_capacity_pressure(self):
+        sup = make_sup(capacity=2)
+        key = sup.submit(elastic_job(workers=3))  # wants 4 total, fits 2
+        sup.sync_once()
+        handles = sup.runner.list_for_job(key)
+        assert len(handles) == 2  # master + 1 worker
+        # WORLD_SIZE must match the SHRUNK world, not the submitted one —
+        # otherwise rendezvous blocks forever waiting for ghosts.
+        env = sup.runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
+        assert env["TPUJOB_NUM_PROCESSES"] == "2"
+        assert any(
+            e.reason == "ElasticScaledDown" for e in sup.events.for_job(key)
+        )
+        # The submitted target is remembered.
+        job = sup.get(key)
+        assert job.metadata.annotations[ELASTIC_TARGET_ANNOTATION] == "3"
+
+    def test_below_min_replicas_holds(self):
+        sup = make_sup(capacity=2)
+        key = sup.submit(elastic_job(workers=4, min_replicas=3))  # floor 4 > 2
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 0
+        assert any(e.reason == "Unschedulable" for e in sup.events.for_job(key))
+
+    def test_non_elastic_jobs_keep_partial_world_semantics(self):
+        sup = make_sup(capacity=2)
+        job = new_job(name="plain", workers=2)  # total 3
+        job.spec.run_policy.scheduling_policy.min_available = 2
+        key = sup.submit(job)
+        sup.sync_once()
+        # Partial world launched at full WORLD_SIZE (waits at rendezvous).
+        env = sup.runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
+        assert env["TPUJOB_NUM_PROCESSES"] == "3"
+
+
+class TestElasticGrowBack:
+    def grow_ready(self, sup, key):
+        sup.runner.set_all_running(key)
+
+    def test_grows_back_when_capacity_frees(self):
+        sup = make_sup(capacity=2)
+        key = sup.submit(elastic_job(workers=3))
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 2
+        self.grow_ready(sup, key)
+        sup.runner.capacity = 4
+        sup.sync_once()  # growth: tears down, bumps desired to 3 workers
+        job = sup.get(key)
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 3
+        assert job.status.restart_count == 1
+        assert any(e.reason == "ElasticScaledUp" for e in sup.events.for_job(key))
+        sup.sync_once()  # relaunch at the grown size
+        handles = sup.runner.list_for_job(key)
+        assert len(handles) == 4
+        env = sup.runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
+        assert env["TPUJOB_NUM_PROCESSES"] == "4"
+
+    def test_growth_is_capped_by_free_capacity(self):
+        sup = make_sup(capacity=2)
+        key = sup.submit(elastic_job(workers=5))
+        sup.sync_once()
+        self.grow_ready(sup, key)
+        sup.runner.capacity = 3  # room for ONE more, target still further
+        sup.sync_once()
+        job = sup.get(key)
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 2
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 3
+
+    def test_growth_respects_backoff_limit(self):
+        """Auto-growth must not spend the failure budget: with
+        backoff_limit=1, growing once would make the next real failure
+        fatal — so growth is skipped."""
+        sup = make_sup(capacity=2)
+        job = elastic_job(workers=3)
+        job.spec.run_policy.backoff_limit = 1
+        key = sup.submit(job)
+        sup.sync_once()
+        self.grow_ready(sup, key)
+        sup.runner.capacity = 4
+        sup.sync_once()
+        j = sup.get(key)
+        assert j.spec.replica_specs[ReplicaType.WORKER].replicas == 1  # no growth
+        assert j.status.restart_count == 0
+
+    def test_growth_skipped_when_restart_budget_exhausted(self):
+        sup = make_sup(capacity=2)
+        key = sup.submit(elastic_job(workers=3, max_restarts=0))
+        sup.sync_once()
+        self.grow_ready(sup, key)
+        sup.runner.capacity = 4
+        sup.sync_once()
+        job = sup.get(key)
+        # No growth, and crucially no MaxRestartsExceeded failure.
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 1
+        assert not job.is_failed()
+        assert len(sup.runner.list_for_job(key)) == 2
+
+    def test_growth_does_not_fire_mid_launch(self):
+        """A world still PENDING must not be torn down for growth."""
+        sup = make_sup(capacity=2)
+        key = sup.submit(elastic_job(workers=3))
+        sup.sync_once()
+        sup.runner.capacity = 4  # capacity frees before the world is up
+        sup.sync_once()
+        job = sup.get(key)
+        assert job.status.restart_count == 0  # master not RUNNING yet
+
+    def test_growth_reserves_relaunch_capacity_within_pass(self):
+        """Growth tears the world down mid-pass; jobs synced later must not
+        steal the freed slots out from under the relaunch (which would
+        waste the spent restart and shrink the world right back)."""
+        sup = make_sup(capacity=3)
+        key = sup.submit(elastic_job(workers=3))  # FIFO-first
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 3  # master + 2
+        self.grow_ready(sup, key)
+        thief = sup.submit(new_job(name="thief", workers=0))
+        sup.runner.capacity = 4
+        sup.sync_once()  # growth fires for el; thief synced later
+        assert len(sup.runner.list_for_job(thief)) == 0  # slots reserved
+        sup.sync_once()  # relaunch at 4
+        assert len(sup.runner.list_for_job(key)) == 4
+
+    def test_growth_target_clamped_to_max_replicas(self):
+        """The target annotation is user-writable; growth must never exceed
+        the validated elastic bound."""
+        sup = make_sup(capacity=16)
+        job = elastic_job(workers=2)
+        job.metadata.annotations[ELASTIC_TARGET_ANNOTATION] = "50"
+        key = sup.submit(job)
+        sup.sync_once()
+        self.grow_ready(sup, key)
+        sup.sync_once()
+        j = sup.get(key)
+        assert j.spec.replica_specs[ReplicaType.WORKER].replicas == 2
+        assert j.status.restart_count == 0  # no growth at all (already max)
+
+    def test_manual_scale_repins_target(self):
+        sup = make_sup(capacity=8)
+        key = sup.submit(elastic_job(workers=3))
+        sup.sync_once()
+        self.grow_ready(sup, key)
+        sup.scale(key, 1)  # operator explicitly shrinks
+        sup.sync_once()
+        self.grow_ready(sup, key)
+        sup.sync_once()  # plenty of capacity — must NOT grow back to 3
+        job = sup.get(key)
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 1
+        assert job.metadata.annotations[ELASTIC_TARGET_ANNOTATION] == "1"

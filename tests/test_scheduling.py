@@ -188,10 +188,12 @@ class TestReservation:
             name="elastic", workers=4,
             elastic=ElasticPolicy(min_replicas=1, max_replicas=4, max_restarts=4),
         )
-        key = sup.submit(job)  # total 5 > capacity 3 → held
+        # Explicit all-or-nothing threshold (overrides the elastic floor).
+        job.spec.run_policy.scheduling_policy.min_available = 5
+        key = sup.submit(job)  # needs 5 at once > capacity 3 → held
         sup.sync_once()
         assert len(sup.runner.list_for_job(key)) == 0
-        sup.scale(key, 1)  # now total 2 <= 3
+        sup.scale(key, 1)  # now total 2; the stale threshold 5 must cap to 2
         sup.sync_once()
         assert len(sup.runner.list_for_job(key)) == 2
 

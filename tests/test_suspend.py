@@ -1,0 +1,125 @@
+"""RunPolicy.suspend — create-but-don't-run (reference: training-operator
+RunPolicy.suspend, the Kueue integration point). Suspending a live job
+tears its world down but keeps the job; resuming relaunches it, with the
+activeDeadlineSeconds clock reset.
+"""
+
+from __future__ import annotations
+
+from pytorch_operator_tpu.api.types import ConditionType, ReplicaPhase, ReplicaType, RunPolicy
+from pytorch_operator_tpu.controller.runner import FakeRunner, replica_name
+from pytorch_operator_tpu.controller.supervisor import Supervisor
+from tests.testutil import new_job
+
+
+def make_sup():
+    return Supervisor(state_dir=None, runner=FakeRunner(), persist=False)
+
+
+class TestSuspend:
+    def test_suspended_job_creates_no_replicas(self):
+        sup = make_sup()
+        job = new_job(name="s1", workers=1)
+        job.spec.run_policy.suspend = True
+        key = sup.submit(job)
+        sup.sync_once()
+        assert sup.runner.list_for_job(key) == []
+        j = sup.get(key)
+        assert j.has_condition(ConditionType.SUSPENDED)
+        assert not j.is_finished()
+
+    def test_suspend_live_job_tears_down_world(self):
+        sup = make_sup()
+        key = sup.submit(new_job(name="s2", workers=2))
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 3
+        j = sup.get(key)
+        j.spec.run_policy.suspend = True
+        sup.store.update(j)
+        sup.sync_once()
+        assert sup.runner.list_for_job(key) == []
+        j = sup.get(key)
+        assert j.has_condition(ConditionType.SUSPENDED)
+        assert j.status.start_time is None  # deadline clock reset
+
+    def test_resume_relaunches_and_clears_condition(self):
+        sup = make_sup()
+        job = new_job(name="s3", workers=1)
+        job.spec.run_policy.suspend = True
+        key = sup.submit(job)
+        sup.sync_once()
+        j = sup.get(key)
+        j.spec.run_policy.suspend = False
+        sup.store.update(j)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 2
+        j = sup.get(key)
+        assert not j.has_condition(ConditionType.SUSPENDED)
+        assert any(e.reason == "TPUJobResumed" for e in sup.events.for_job(key))
+        # Running clears Suspended for good once the master is up.
+        sup.runner.set_all_running(key)
+        sup.sync_once()
+        assert sup.get(key).has_condition(ConditionType.RUNNING)
+
+    def test_suspended_job_can_still_complete_normally_after_resume(self):
+        sup = make_sup()
+        job = new_job(name="s4", workers=0)
+        job.spec.run_policy.suspend = True
+        key = sup.submit(job)
+        sup.sync_once()
+        j = sup.get(key)
+        j.spec.run_policy.suspend = False
+        sup.store.update(j)
+        sup.sync_once()
+        sup.runner.set_all_running(key)
+        sup.runner.set_phase(
+            replica_name(key, ReplicaType.MASTER, 0),
+            ReplicaPhase.SUCCEEDED,
+            exit_code=0,
+        )
+        sup.sync_once()
+        assert sup.get(key).is_succeeded()
+
+    def test_suspend_markers_cross_process(self, tmp_path):
+        sup = Supervisor(state_dir=tmp_path, runner=FakeRunner(), persist=True)
+        key = sup.submit(new_job(name="s5", workers=0))
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 1
+        # Another process (the CLI) leaves a suspend marker.
+        sup.store.mark_suspend(key, True)
+        sup.process_suspend_markers()
+        sup.sync_once()
+        assert sup.runner.list_for_job(key) == []
+        sup.store.mark_suspend(key, False)
+        sup.process_suspend_markers()
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 1
+
+    def test_round_trip(self):
+        rp = RunPolicy(suspend=True)
+        assert RunPolicy.from_dict(rp.to_dict()).suspend is True
+        assert RunPolicy.from_dict({}).suspend is False
+
+    def test_pytorchjob_suspend_converts(self):
+        from pytorch_operator_tpu.api import loads_job
+
+        job = loads_job(
+            """
+kind: PyTorchJob
+metadata: {name: kueue}
+spec:
+  runPolicy:
+    suspend: true
+    schedulingPolicy: {scheduleTimeoutSeconds: 300}
+  pytorchReplicaSpecs:
+    Master:
+      template:
+        spec:
+          containers: [{name: pytorch, command: [sh, -c, "exit 0"]}]
+"""
+        )
+        assert job.spec.run_policy.suspend is True
+        assert (
+            job.metadata.annotations["tpujob.dev/converted-schedule-timeout-seconds"]
+            == "300"
+        )
