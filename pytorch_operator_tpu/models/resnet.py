@@ -9,7 +9,8 @@ the MLPerf convention).
 TPU-first choices:
 - NHWC layout (XLA's native conv layout on TPU),
 - bfloat16 compute / float32 params and batch-norm statistics (MXU-friendly
-  without accuracy loss),
+  without accuracy loss; ``bn_f32_stats=False`` is an experimental knob that
+  drops BN stats AND BN scale/bias to bf16 — BASELINE.md A/B),
 - no data-dependent control flow — the whole step is one XLA program.
 """
 
@@ -85,6 +86,13 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     block_cls: ModuleDef = BottleneckBlock
+    # Batch-norm precision. f32 (default) is the numerically safe choice
+    # for convergence runs. False computes the BN reductions in bf16 AND
+    # (a flax constraint: stats are stored in param_dtype) downcasts the
+    # learnable scale/bias to bf16 — so their SGD updates quantize to an
+    # 8-bit mantissa too. Measured throughput-neutral on this hardware
+    # (BASELINE.md A/B); kept as an experiment knob only.
+    bn_f32_stats: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -100,7 +108,10 @@ class ResNet(nn.Module):
             momentum=0.9,
             epsilon=1e-5,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
+            # flax computes stats in max(param_dtype, f32) unless
+            # force_float32_reductions; bf16 stats need both relaxed.
+            param_dtype=jnp.float32 if self.bn_f32_stats else self.dtype,
+            force_float32_reductions=self.bn_f32_stats,
         )
         act = nn.relu
 

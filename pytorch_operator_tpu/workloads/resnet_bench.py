@@ -157,6 +157,7 @@ def run_benchmark(
     windows: int = 1,
     data_file: str | None = None,
     profile_dir: str | None = None,
+    bn_f32_stats: bool = True,
     log=print,
 ) -> dict:
     """The ONE benchmark harness (bench.py and the workload both use it).
@@ -209,7 +210,7 @@ def run_benchmark(
         101: resnet_lib.ResNet101,
         152: resnet_lib.ResNet152,
     }[depth]
-    model = model_cls(num_classes=classes)
+    model = model_cls(num_classes=classes, bn_f32_stats=bn_f32_stats)
 
     n_dev = jax.device_count()
     mesh = make_mesh({"dp": n_dev})
@@ -368,6 +369,12 @@ def main(argv=None) -> int:
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--depth", type=int, default=50, choices=[18, 34, 50, 101, 152])
+    p.add_argument(
+        "--bn-bf16-stats", action="store_true",
+        help="EXPERIMENTAL: batch-norm statistics AND learnable "
+        "scale/bias in bf16 (flax stores stats in param_dtype); less "
+        "precise normalization and BN weight updates; default f32",
+    )
     p.add_argument("--classes", type=int, default=1000)
     p.add_argument(
         "--windows", type=int, default=1,
@@ -399,6 +406,7 @@ def main(argv=None) -> int:
         windows=args.windows,
         data_file=args.data_file,
         profile_dir=args.profile_dir,
+        bn_f32_stats=not args.bn_bf16_stats,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"
             if world.num_processes > 1

@@ -24,6 +24,34 @@ class TestResNetModel:
         assert logits.dtype == jnp.float32  # head stays f32 for stable loss
         assert "batch_stats" in variables
 
+    def test_bf16_bn_stats_mode_trains_finite(self):
+        """The experimental bn_f32_stats=False path (bf16 BN reductions,
+        BASELINE.md A/B note) must produce finite logits and stats."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pytorch_operator_tpu.models.resnet import ResNet
+
+        model = ResNet(
+            stage_sizes=[1, 1], num_filters=8, num_classes=10, bn_f32_stats=False
+        )
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((4, 32, 32, 3)),
+            jnp.float32,
+        )
+        variables = model.init(jax.random.key(0), x, train=False)
+        logits, updates = model.apply(
+            variables, x, train=True, mutable=["batch_stats"]
+        )
+        assert bool(jnp.isfinite(logits).all())
+        mean_leaf = jax.tree.leaves(updates["batch_stats"])[0]
+        assert mean_leaf.dtype == jnp.bfloat16  # stats really are bf16
+        assert all(
+            bool(jnp.isfinite(leaf.astype(jnp.float32)).all())
+            for leaf in jax.tree.leaves(updates["batch_stats"])
+        )
+
     def test_train_step_updates_params_and_stats(self):
         import jax
         import jax.numpy as jnp
