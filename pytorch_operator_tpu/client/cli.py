@@ -182,6 +182,7 @@ def cmd_supervisor(args) -> int:
         max_slots=args.max_slots,
         leader_elect=not args.no_leader_elect,
         queue_slots=_parse_queue_slots(getattr(args, "queue_slots", None)),
+        preempt=getattr(args, "preempt", False),
     )
     # Monitoring comes up BEFORE the lease wait: a standby must answer
     # /healthz while blocked (it reports is_leader=false), or liveness
@@ -505,6 +506,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="per-queue replica-slot caps, e.g. 'default=4,batch=2' "
         "(jobs pick a queue via scheduling_policy.queue; unlisted "
         "queues are unbounded)",
+    )
+    sp.add_argument(
+        "--preempt",
+        action="store_true",
+        help="allow a held high-priority gang to evict lower-priority "
+        "running worlds (they relaunch when capacity frees; their "
+        "restart budget is untouched)",
     )
     sp.add_argument(
         "--monitoring-port",

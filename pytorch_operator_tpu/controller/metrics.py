@@ -65,6 +65,10 @@ class MetricsRegistry:
         self.jobs_restarted = self.counter(
             "tpujob_jobs_restarted_total", "Replica restarts across all TPUJobs"
         )
+        self.jobs_preempted = self.counter(
+            "tpujob_jobs_preempted_total",
+            "TPUJob worlds evicted for higher-priority gangs",
+        )
         self.replicas_created = self.counter(
             "tpujob_replicas_created_total", "Replica processes launched"
         )

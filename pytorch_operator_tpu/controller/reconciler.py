@@ -85,6 +85,9 @@ class Reconciler:
         # O(jobs) not O(jobs²) in queue accounting.
         self._pass_reservations: dict = {}
         self._pass_queue_used = None
+        # Gangs held this pass: {key: (min_needed, priority)} — the input
+        # to the supervisor's optional preemption step (volcano `preempt`).
+        self._pass_held: dict = {}
         self._in_pass = False
         self._unschedulable_warned = set()
         # Per-file byte offsets for incremental status-report scanning.
@@ -129,6 +132,7 @@ class Reconciler:
         the Unschedulable event is the operator's signal.
         """
         self._pass_reservations = {}
+        self._pass_held = {}
         self._in_pass = True
         self._pass_queue_used = (
             self._compute_queue_usage() if self.queue_slots is not None else None
@@ -212,6 +216,34 @@ class Reconciler:
             ConditionType.RESTARTING, reason=reason, message=message, now=now
         )
         (self.events.warning if warning else self.events.normal)(key, reason, message)
+
+    def held_gangs(self) -> dict:
+        """Gangs held Unschedulable this pass: {key: (min_needed, priority)}
+        — consumed by the supervisor's optional preemption step."""
+        return dict(self._pass_held)
+
+    def preempt_world(
+        self,
+        job: TPUJob,
+        key: str,
+        handles: List[ReplicaHandle],
+        preemptor_key: str,
+        now: Optional[float] = None,
+    ) -> None:
+        """Evict a lower-priority job's world for a pending gang (volcano
+        ``preempt``). Unlike restart_world this does NOT spend the victim's
+        restart/backoff budget — preemption is the cluster's choice, not
+        the job's failure — so priority churn can never fail a victim."""
+        self._delete_replicas(handles)
+        self.metrics.jobs_preempted.inc()
+        msg = (
+            f"world preempted by higher-priority {preemptor_key}; "
+            "will relaunch when capacity frees."
+        )
+        job.set_condition(
+            ConditionType.RESTARTING, reason="TPUJobPreempted", message=msg, now=now
+        )
+        self.events.warning(key, "TPUJobPreempted", msg)
 
     def _delete_replicas(self, handles) -> None:
         """Teardown accounting in one place: delete + metric per replica."""
@@ -481,11 +513,11 @@ class Reconciler:
             queue_free = self._queue_free(job, key)
             n_admit = self.gang.admissible(len(missing), min_needed, slots, queue_free)
             if n_admit == 0:
+                queue_bound = queue_free is not None and queue_free < min_needed and (
+                    slots is None or queue_free <= slots
+                )
                 if key not in self._unschedulable_warned:
                     self._unschedulable_warned.add(key)
-                    queue_bound = queue_free is not None and queue_free < min_needed and (
-                        slots is None or queue_free <= slots
-                    )
                     where = (
                         f"queue '{policy.queue or 'default'}'"
                         if queue_bound
@@ -501,6 +533,10 @@ class Reconciler:
                 # synced later in the pass.
                 if self._in_pass:
                     self._pass_reservations[key] = len(missing)
+                    if not queue_bound:
+                        # Only slot-bound holds may preempt: evicting
+                        # other jobs' worlds cannot lift a QUEUE cap.
+                        self._pass_held[key] = (min_needed, policy.priority)
                 self.store.update(job)
                 return True
             self._unschedulable_warned.discard(key)

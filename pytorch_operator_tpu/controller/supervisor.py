@@ -48,6 +48,7 @@ class Supervisor:
         persist: bool = True,
         leader_elect: bool = False,
         queue_slots: Optional[dict] = None,
+        preempt: bool = False,
     ):
         self.state_dir = Path(state_dir) if state_dir is not None else default_state_dir()
         self.state_dir.mkdir(parents=True, exist_ok=True)
@@ -65,6 +66,8 @@ class Supervisor:
             self.state_dir, max_slots=max_slots
         )
         self.gang = GangScheduler(enabled=gang_enabled)
+        # volcano `preempt` action analog; opt-in (--preempt).
+        self.preempt_enabled = preempt
         self.expectations = ControllerExpectations()
         self.reconciler = Reconciler(
             store=self.store,
@@ -204,9 +207,76 @@ class Supervisor:
                     continue
                 if self.reconciler.sync(key, now=now):
                     any_active = True
+            if self.preempt_enabled:
+                self._maybe_preempt(jobs, now)
         finally:
             self.reconciler.end_pass()
         return any_active
+
+    def _maybe_preempt(self, jobs, now: float) -> None:
+        """volcano ``preempt``: evict lower-priority running worlds so the
+        highest-priority held gang can fit next pass.
+
+        Victims are chosen strictly below the preemptor's priority, lowest
+        priority first and newest submission first within a class, whole
+        worlds at a time, and only if evicting them actually covers the
+        shortfall (no pointless evictions). Victims relaunch later behind
+        the preemptor's reservation; their restart budget is untouched.
+        """
+        held = self.reconciler.held_gangs()
+        if not held:
+            return
+        slots = self.runner.schedulable_slots()
+        if slots is None:
+            return  # unbounded capacity: holds are not capacity-driven
+        by_key = dict(jobs)
+        # The single highest-priority held gang preempts (FIFO tie-break).
+        key = min(
+            held,
+            key=lambda k: (
+                -held[k][1],
+                (by_key[k].status.submit_time or 0.0) if k in by_key else 0.0,
+            ),
+        )
+        need, prio = held[key]
+        shortfall = need - slots
+        if shortfall <= 0:
+            return
+        victims = []
+        freed = 0
+        candidates = [
+            (k, j)
+            for k, j in jobs
+            if k != key
+            and not j.is_finished()
+            and j.spec.run_policy.scheduling_policy.priority < prio
+        ]
+        # Lowest priority first; newest first within a class.
+        candidates.sort(
+            key=lambda kj: (
+                kj[1].spec.run_policy.scheduling_policy.priority,
+                -(kj[1].status.submit_time or 0.0),
+            )
+        )
+        for vkey, vjob in candidates:
+            active = [h for h in self.runner.list_for_job(vkey) if h.is_active()]
+            if not active:
+                continue
+            victims.append((vkey, vjob, active))
+            freed += len(active)
+            if freed >= shortfall:
+                break
+        if freed < shortfall:
+            return  # even evicting every lower class would not fit the gang
+        for vkey, _, active in victims:
+            with self.reconciler.key_lock(vkey):
+                # Re-fetch under the lock: a concurrent delete_job must not
+                # be resurrected by store.update on a stale snapshot.
+                vjob = self.store.get(vkey)
+                if vjob is None or vjob.is_finished():
+                    continue
+                self.reconciler.preempt_world(vjob, vkey, active, key, now=now)
+                self.store.update(vjob)
 
     def _gc_ttl(self, job: TPUJob, key: str, now: float) -> None:
         """TTLSecondsAfterFinished → delete the job object (SURVEY.md §3.4)."""

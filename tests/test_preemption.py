@@ -1,0 +1,117 @@
+"""Priority preemption (volcano ``preempt`` action, opt-in --preempt):
+a held high-priority gang may evict strictly-lower-priority running
+worlds; victims relaunch later and their restart/backoff budget is
+untouched.
+"""
+
+from __future__ import annotations
+
+from pytorch_operator_tpu.api.types import ReplicaPhase, ReplicaType
+from pytorch_operator_tpu.controller.runner import FakeRunner, replica_name
+from pytorch_operator_tpu.controller.supervisor import Supervisor
+from tests.testutil import new_job
+
+
+def make_sup(capacity, preempt=True):
+    return Supervisor(
+        state_dir=None,
+        runner=FakeRunner(capacity=capacity),
+        persist=False,
+        preempt=preempt,
+    )
+
+
+def finish_master(sup, key):
+    sup.runner.set_phase(
+        replica_name(key, ReplicaType.MASTER, 0), ReplicaPhase.SUCCEEDED, exit_code=0
+    )
+
+
+class TestPreemption:
+    def submit_lo_then_hi(self, sup, lo_workers=1, hi_workers=1, hi_prio=10):
+        lo_key = sup.submit(new_job(name="lo", workers=lo_workers))
+        sup.sync_once()  # lo's world occupies the capacity
+        sup.runner.set_all_running(lo_key)
+        hi = new_job(name="hi", workers=hi_workers)
+        hi.spec.run_policy.scheduling_policy.priority = hi_prio
+        hi_key = sup.submit(hi)
+        return lo_key, hi_key
+
+    def test_held_gang_evicts_lower_priority_world(self):
+        sup = make_sup(capacity=2)
+        lo_key, hi_key = self.submit_lo_then_hi(sup)
+        sup.sync_once()  # hi held → lo preempted at end of pass
+        assert sup.runner.list_for_job(lo_key) == []
+        lo = sup.get(lo_key)
+        assert lo.status.restart_count == 0  # budget untouched
+        assert any(
+            e.reason == "TPUJobPreempted" for e in sup.events.for_job(lo_key)
+        )
+        sup.sync_once()  # hi claims the freed slots; lo blocked behind it
+        assert len(sup.runner.list_for_job(hi_key)) == 2
+        assert sup.runner.list_for_job(lo_key) == []
+        # hi finishes → lo relaunches.
+        sup.runner.set_all_running(hi_key)
+        finish_master(sup, hi_key)
+        sup.sync_once()
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(lo_key)) == 2
+
+    def test_no_preemption_when_disabled(self):
+        sup = make_sup(capacity=2, preempt=False)
+        lo_key, hi_key = self.submit_lo_then_hi(sup)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(lo_key)) == 2  # untouched
+
+    def test_equal_priority_never_preempted(self):
+        sup = make_sup(capacity=2)
+        lo_key, hi_key = self.submit_lo_then_hi(sup, hi_prio=0)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(lo_key)) == 2
+
+    def test_no_pointless_eviction_when_gang_can_never_fit(self):
+        """Evicting every lower-priority world still would not fit the
+        gang → evict nothing."""
+        sup = make_sup(capacity=2)
+        lo_key, hi_key = self.submit_lo_then_hi(sup, hi_workers=4)  # needs 5 > 2
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(lo_key)) == 2  # spared
+
+    def test_queue_bound_hold_does_not_preempt(self):
+        """A gang held by its QUEUE cap must not evict other queues' worlds
+        — freeing global slots cannot lift a queue cap."""
+        sup = Supervisor(
+            state_dir=None,
+            runner=FakeRunner(capacity=4),
+            persist=False,
+            preempt=True,
+            queue_slots={"a": 1},
+        )
+        lo_key = sup.submit(new_job(name="lo", workers=0))  # queue default
+        sup.sync_once()
+        sup.runner.set_all_running(lo_key)
+        hi = new_job(name="hi", workers=1)  # gang of 2 > queue cap 1
+        hi.spec.run_policy.scheduling_policy.priority = 10
+        hi.spec.run_policy.scheduling_policy.queue = "a"
+        sup.submit(hi)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(lo_key)) == 1  # spared
+
+    def test_victims_chosen_lowest_priority_newest_first(self):
+        sup = make_sup(capacity=3)
+        a = new_job(name="mid", workers=0)
+        a.spec.run_policy.scheduling_policy.priority = 5
+        mid_key = sup.submit(a)
+        lo1_key = sup.submit(new_job(name="lo1", workers=0))
+        lo2_key = sup.submit(new_job(name="lo2", workers=0))
+        sup.sync_once()
+        for k in (mid_key, lo1_key, lo2_key):
+            sup.runner.set_all_running(k)
+        hi = new_job(name="hi", workers=0)  # needs 1 slot
+        hi.spec.run_policy.scheduling_policy.priority = 10
+        sup.submit(hi)
+        sup.sync_once()
+        # One slot shortfall → exactly one victim: the NEWEST lowest-prio.
+        assert len(sup.runner.list_for_job(lo2_key)) == 0
+        assert len(sup.runner.list_for_job(lo1_key)) == 1
+        assert len(sup.runner.list_for_job(mid_key)) == 1
