@@ -488,7 +488,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("file")
     sp.add_argument("--timeout", type=float, default=None)
     sp.add_argument("--no-gang", action="store_true", help="disable gang scheduling")
-    sp.add_argument("--max-slots", type=int, default=None, help="replica capacity")
+    sp.add_argument(
+        "--max-slots", type=int, default=None,
+        help="device-slot capacity (a replica requesting N chips/devices "
+        "occupies N slots)",
+    )
     sp.set_defaults(func=cmd_run)
 
     sp = sub.add_parser("submit", help="queue a job for a running supervisor")
@@ -498,7 +502,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("supervisor", help="run the reconcile daemon")
     sp.add_argument("--interval", type=float, default=0.2)
     sp.add_argument("--no-gang", action="store_true")
-    sp.add_argument("--max-slots", type=int, default=None)
+    sp.add_argument(
+        "--max-slots", type=int, default=None,
+        help="device-slot capacity (a replica requesting N chips/devices "
+        "occupies N slots)",
+    )
     sp.add_argument(
         "--queue-slots",
         default=None,

@@ -58,17 +58,19 @@ class GangScheduler:
         slots: Optional[int],
         queue_free: Optional[int] = None,
     ) -> int:
-        """How many of ``needed_now`` missing replicas may start right now.
+        """Device-slot budget this gang may claim right now (0 = hold).
 
-        ``min_needed`` is the gang threshold: the count that must fit at
-        once for ANY replica to start (volcano ``minMember`` semantics —
-        the all-or-nothing default sets it to the whole remaining gang;
-        ``min_available`` below the total allows a partial world that
-        waits at rendezvous for stragglers). Non-gang admission passes
-        ``min_needed=1`` (piecewise). ``slots`` is free runner capacity
-        (minus any higher-priority reservation); ``queue_free`` caps
-        admission to the job's queue capacity (volcano queue analog);
-        None = unbounded.
+        EVERY argument is a device-slot WEIGHT, not a replica count (a
+        replica requesting N chips weighs N — replica_slots): ``needed_now``
+        is the weight of all missing replicas, ``min_needed`` the weight of
+        the minMember prefix that must fit at once for ANY replica to start
+        (volcano semantics — the all-or-nothing default covers the whole
+        remaining gang; ``min_available`` below the total allows a partial
+        world that waits at rendezvous). Non-gang admission passes the
+        first missing replica's weight. ``slots`` is free runner capacity (minus
+        any higher-priority reservation); ``queue_free`` caps admission to
+        the job's queue capacity; None = unbounded. The caller turns the
+        returned budget into a replica prefix.
         """
         bounds = [b for b in (slots, queue_free) if b is not None]
         if not bounds:

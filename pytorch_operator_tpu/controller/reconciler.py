@@ -37,7 +37,7 @@ from .events import EventRecorder
 from .expectations import ControllerExpectations
 from .gang import GangScheduler
 from .metrics import MetricsRegistry
-from .runner import ProcessRunner, ReplicaHandle, replica_name
+from .runner import ProcessRunner, ReplicaHandle, replica_name, replica_slots
 from .status import (
     ACTION_FAIL_JOB,
     ACTION_NONE,
@@ -144,16 +144,16 @@ class Reconciler:
         self._in_pass = False
 
     def _compute_queue_usage(self) -> dict:
-        """{queue: active replica count} over every job in the store — the
-        ONE implementation of queue accounting (begin_pass caches it for a
-        pass; solo syncs compute it fresh)."""
+        """{queue: active device-slot usage} over every job in the store —
+        the ONE implementation of queue accounting (begin_pass caches it
+        for a pass; solo syncs compute it fresh)."""
         used: dict = {}
         for key in self.store.keys():
             job = self.store.get(key)
             if job is None:
                 continue
             q = job.spec.run_policy.scheduling_policy.queue or "default"
-            n = sum(1 for h in self.runner.list_for_job(key) if h.is_active())
+            n = sum(h.slots for h in self.runner.list_for_job(key) if h.is_active())
             if n:
                 used[q] = used.get(q, 0) + n
         return used
@@ -427,6 +427,13 @@ class Reconciler:
 
         self.runner.sync()
         handles = self.runner.list_for_job(key)
+        # The template is the source of truth for a replica's device-slot
+        # weight: heal records written before the weight existed (adopted
+        # from an older supervisor) or with a stale value.
+        for h in handles:
+            rt_spec = job.spec.replica_specs.get(h.replica_type)
+            if rt_spec is not None:
+                h.slots = replica_slots(rt_spec.template)
         self._scan_first_step(job, key)
 
         # ---- completion: job Succeeded ⇔ Master succeeded (status.go) ----
@@ -509,11 +516,23 @@ class Reconciler:
             gang_on = self.gang.enabled and policy.gang
             min_needed = max(0, min_avail - active_now) if gang_on else 1
             min_needed = max(1, min(min_needed, len(missing)))
+            # Capacity is counted in device SLOTS (replica_slots: a 4-chip
+            # replica weighs 4), while minMember stays a MEMBER count —
+            # converted here to the weight of the first min_needed missing
+            # replicas (master first, deterministic order).
+            weights = {
+                rt: replica_slots(job.spec.replica_specs[rt].template)
+                for rt in job.spec.replica_specs
+            }
+            missing_w = [weights[rt] for rt, _ in missing]
+            min_needed_w = sum(missing_w[:min_needed])
             slots = self._slots_minus_reserved(key)
             queue_free = self._queue_free(job, key)
-            n_admit = self.gang.admissible(len(missing), min_needed, slots, queue_free)
-            if n_admit == 0:
-                queue_bound = queue_free is not None and queue_free < min_needed and (
+            budget = self.gang.admissible(
+                sum(missing_w), min_needed_w, slots, queue_free
+            )
+            if budget <= 0:
+                queue_bound = queue_free is not None and queue_free < min_needed_w and (
                     slots is None or queue_free <= slots
                 )
                 if key not in self._unschedulable_warned:
@@ -525,21 +544,29 @@ class Reconciler:
                     )
                     self.events.warning(
                         key, "Unschedulable",
-                        f"gang needs {min_needed} slot(s) at once in "
-                        f"{where}; holding replicas "
-                        f"(min_available={min_avail} of {total}).",
+                        f"gang needs {min_needed_w} device slot(s) at once "
+                        f"in {where}; holding replicas "
+                        f"(min_available={min_avail} of {total} members).",
                     )
                 # Reserve this gang's demand against lower-priority jobs
                 # synced later in the pass.
                 if self._in_pass:
-                    self._pass_reservations[key] = len(missing)
+                    self._pass_reservations[key] = sum(missing_w)
                     if not queue_bound:
                         # Only slot-bound holds may preempt: evicting
                         # other jobs' worlds cannot lift a QUEUE cap.
-                        self._pass_held[key] = (min_needed, policy.priority)
+                        self._pass_held[key] = (min_needed_w, policy.priority)
                 self.store.update(job)
                 return True
             self._unschedulable_warned.discard(key)
+            # Largest prefix of missing replicas whose weight fits budget
+            # (>= the min_needed prefix, guaranteed by admissible()).
+            n_admit, acc = 0, 0
+            for w in missing_w:
+                if acc + w > budget:
+                    break
+                acc += w
+                n_admit += 1
             # Elastic capacity adaptation (torchelastic rendezvous-min
             # semantics): rather than launching a partial world that blocks
             # at rendezvous, SHRINK the desired world to what was admitted
@@ -555,7 +582,7 @@ class Reconciler:
                 if workers is not None and n_admit - 1 >= (
                     job.spec.elastic_policy.min_replicas
                 ):
-                    workers.replicas = n_admit - 1  # master takes one slot
+                    workers.replicas = n_admit - 1  # master admitted first
                     msg = (
                         f"elastic launch shrunk to {workers.replicas} "
                         f"worker(s) to fit available capacity (target "
@@ -568,18 +595,19 @@ class Reconciler:
                         for i in range(self._desired_replicas(job, rt))
                         if self.runner.get(replica_name(key, rt, i)) is None
                     ]
+                    missing_w = [weights[rt] for rt, _ in missing]
             if self._in_pass:
                 if n_admit < len(missing):
                     # Stragglers of a partially-admitted gang keep their claim.
-                    self._pass_reservations[key] = len(missing) - n_admit
+                    self._pass_reservations[key] = sum(missing_w[n_admit:])
                 else:
                     self._pass_reservations.pop(key, None)
             missing = missing[:n_admit]
             if self._in_pass and self._pass_queue_used is not None:
                 qname = policy.queue or "default"
-                self._pass_queue_used[qname] = (
-                    self._pass_queue_used.get(qname, 0) + n_admit
-                )
+                self._pass_queue_used[qname] = self._pass_queue_used.get(
+                    qname, 0
+                ) + sum(missing_w[:n_admit])
             # Auto-port jobs get a freshly-probed coordinator port for each
             # new world (first launch or gang restart): probing at spawn
             # time keeps the free-probe → coordinator-bind window tiny, and
@@ -695,7 +723,10 @@ class Reconciler:
             return False
         slots = self._slots_minus_reserved(key)
         queue_free = self._queue_free(job, key)
-        bounds = [b for b in (slots, queue_free) if b is not None]
+        # Free capacity is in device slots; one extra worker costs its
+        # replica weight.
+        w = replica_slots(workers.template)
+        bounds = [b // w for b in (slots, queue_free) if b is not None]
         grow = min([target - cur] + bounds) if bounds else target - cur
         if grow <= 0:
             return False
@@ -713,14 +744,15 @@ class Reconciler:
             # The torn-down world's slots are spoken for: the grown gang
             # relaunches next sync. Without this claim, jobs synced later
             # in the pass steal the capacity and the restart was wasted.
-            new_total = sum(
-                self._desired_replicas(job, rt) for rt in job.spec.replica_specs
+            self._pass_reservations[key] = sum(
+                self._desired_replicas(job, rt)
+                * replica_slots(job.spec.replica_specs[rt].template)
+                for rt in job.spec.replica_specs
             )
-            self._pass_reservations[key] = new_total
             if self._pass_queue_used is not None:
                 qname = job.spec.run_policy.scheduling_policy.queue or "default"
                 self._pass_queue_used[qname] = (
-                    self._pass_queue_used.get(qname, 0) + grow
+                    self._pass_queue_used.get(qname, 0) + grow * w
                 )
         return True
 

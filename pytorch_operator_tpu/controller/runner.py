@@ -117,6 +117,16 @@ _EXIT_CAPTURE_SH = (
 )
 
 
+def replica_slots(template: ProcessTemplate) -> int:
+    """Scheduling weight of one replica in device slots (reference: pods
+    request resource QUANTITIES — ``google.com/tpu: N`` — and the
+    scheduler sums them; a replica asking for 4 chips occupies 4 slots of
+    ``--max-slots`` capacity). Minimum 1: even a device-less control
+    process occupies a scheduling slot."""
+    r = template.resources
+    return max(1, r.tpu_chips, r.cpu_devices)
+
+
 def normalize_exit_code(code: Optional[int]) -> Optional[int]:
     """Map Popen's signal encoding (-N) to the container convention (128+N)
     the ExitCode restart policy is defined against — so SIGKILL surfaces as
@@ -140,6 +150,7 @@ class ReplicaHandle:
     created_at: float = 0.0
     finished_at: Optional[float] = None
     log_path: Optional[str] = None
+    slots: int = 1  # device-slot weight (replica_slots of the template)
 
     def is_active(self) -> bool:
         return self.phase in (ReplicaPhase.PENDING, ReplicaPhase.RUNNING)
@@ -159,6 +170,7 @@ class ReplicaHandle:
             "created_at": self.created_at,
             "finished_at": self.finished_at,
             "log_path": self.log_path,
+            "slots": self.slots,
         }
 
 
@@ -227,6 +239,7 @@ class FakeRunner(ProcessRunner):
                 index=index,
                 phase=ReplicaPhase.PENDING,
                 created_at=time.time(),
+                slots=replica_slots(template),
             )
             self.handles[name] = h
             self.envs[name] = dict(env)
@@ -261,7 +274,7 @@ class FakeRunner(ProcessRunner):
         with self._lock:
             if self.capacity is None:
                 return None
-            used = sum(1 for h in self.handles.values() if h.is_active())
+            used = sum(h.slots for h in self.handles.values() if h.is_active())
             return max(0, self.capacity - used)
 
     # --- test helpers ---
@@ -287,8 +300,9 @@ class SubprocessRunner(ProcessRunner):
 
     stdout+stderr of each replica goes to
     ``<state_dir>/logs/<ns>_<job>-<type>-<index>.log`` (kubectl-logs analog).
-    ``max_slots`` bounds concurrently active replicas — the "cluster
-    capacity" that gang admission checks against.
+    ``max_slots`` bounds concurrently active DEVICE SLOTS — the "cluster
+    capacity" gang admission checks against; each replica occupies
+    ``replica_slots(template)`` of it (a 4-chip replica weighs 4).
     """
 
     def __init__(self, state_dir: Path, max_slots: Optional[int] = None):
@@ -363,6 +377,7 @@ class SubprocessRunner(ProcessRunner):
                     created_at=rec.get("created_at", 0.0),
                     finished_at=rec.get("finished_at"),
                     log_path=rec.get("log_path"),
+                    slots=int(rec.get("slots", 1)),
                 )
             except Exception:
                 # A corrupt/foreign-schema record must not brick every
@@ -448,6 +463,7 @@ class SubprocessRunner(ProcessRunner):
                     created_at=time.time(),
                     finished_at=time.time(),
                     log_path=str(log_path),
+                    slots=replica_slots(template),
                 )
                 self.handles[name] = h
                 self._save(h)
@@ -461,6 +477,7 @@ class SubprocessRunner(ProcessRunner):
                 pid=proc.pid,
                 created_at=time.time(),
                 log_path=str(log_path),
+                slots=replica_slots(template),
             )
             self.handles[name] = h
             self._procs[name] = proc
@@ -627,7 +644,7 @@ class SubprocessRunner(ProcessRunner):
         if self.max_slots is None:
             return None
         with self._lock:
-            used = sum(1 for h in self.handles.values() if h.is_active())
+            used = sum(h.slots for h in self.handles.values() if h.is_active())
         return max(0, self.max_slots - used)
 
     def shutdown(self):

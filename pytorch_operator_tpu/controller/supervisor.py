@@ -263,7 +263,7 @@ class Supervisor:
             if not active:
                 continue
             victims.append((vkey, vjob, active))
-            freed += len(active)
+            freed += sum(h.slots for h in active)  # device-slot weights
             if freed >= shortfall:
                 break
         if freed < shortfall:

@@ -1,0 +1,161 @@
+"""Resource-weighted capacity: a replica requesting N devices occupies N
+slots of --max-slots / --queue-slots (reference: pods request resource
+QUANTITIES — google.com/tpu: N — and the scheduler sums them).
+"""
+
+from __future__ import annotations
+
+from pytorch_operator_tpu.api.types import (
+    ElasticPolicy,
+    ReplicaPhase,
+    ReplicaType,
+    Resources,
+)
+from pytorch_operator_tpu.controller.runner import FakeRunner, replica_name
+from pytorch_operator_tpu.controller.supervisor import Supervisor
+from tests.testutil import new_job
+
+
+def make_sup(capacity, **kw):
+    return Supervisor(
+        state_dir=None, runner=FakeRunner(capacity=capacity), persist=False, **kw
+    )
+
+
+def set_chips(job, rtype, chips):
+    job.spec.replica_specs[rtype].template.resources = Resources(tpu_chips=chips)
+
+
+class TestWeightedAdmission:
+    def test_heavy_replica_occupies_its_weight(self):
+        sup = make_sup(capacity=4)
+        a = new_job(name="a", workers=0)
+        set_chips(a, ReplicaType.MASTER, 4)
+        b = new_job(name="b", workers=0)
+        ka, kb = sup.submit(a), sup.submit(b)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(ka)) == 1  # fills all 4 slots
+        assert len(sup.runner.list_for_job(kb)) == 0  # held
+        assert any(e.reason == "Unschedulable" for e in sup.events.for_job(kb))
+
+    def test_gang_weight_sums_across_replica_types(self):
+        sup = make_sup(capacity=4)
+        job = new_job(name="g", workers=2)  # master 1 + 2 workers x 2 chips = 5
+        set_chips(job, ReplicaType.WORKER, 2)
+        key = sup.submit(job)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 0  # 5 > 4, all-or-nothing
+        sup.runner.capacity = 5
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 3
+
+    def test_queue_caps_count_device_slots(self):
+        sup = make_sup(capacity=None, queue_slots={"q": 4})
+        a = new_job(name="a", workers=0)
+        set_chips(a, ReplicaType.MASTER, 3)
+        a.spec.run_policy.scheduling_policy.queue = "q"
+        b = new_job(name="b", workers=0)
+        set_chips(b, ReplicaType.MASTER, 2)
+        b.spec.run_policy.scheduling_policy.queue = "q"
+        ka, kb = sup.submit(a), sup.submit(b)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(ka)) == 1  # 3 of 4 used
+        assert len(sup.runner.list_for_job(kb)) == 0  # 2 > 1 free
+
+    def test_elastic_shrink_respects_worker_weight(self):
+        """Capacity 5, master 1 chip + workers 2 chips each, target 4:
+        master + 2 workers (1+2+2=5) fit → shrink to 2 workers."""
+        sup = make_sup(capacity=5)
+        job = new_job(
+            name="el", workers=4,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=4, max_restarts=8),
+        )
+        set_chips(job, ReplicaType.WORKER, 2)
+        key = sup.submit(job)
+        sup.sync_once()
+        j = sup.get(key)
+        assert j.spec.replica_specs[ReplicaType.WORKER].replicas == 2
+        assert len(sup.runner.list_for_job(key)) == 3
+
+    def test_elastic_growth_costs_worker_weight(self):
+        sup = make_sup(capacity=5)
+        job = new_job(
+            name="el", workers=4,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=4, max_restarts=8),
+        )
+        set_chips(job, ReplicaType.WORKER, 2)
+        key = sup.submit(job)
+        sup.sync_once()  # shrunk to 2 workers (5 slots used)
+        sup.runner.set_all_running(key)
+        sup.runner.capacity = 7  # room for exactly ONE more 2-chip worker
+        sup.sync_once()
+        j = sup.get(key)
+        assert j.spec.replica_specs[ReplicaType.WORKER].replicas == 3
+
+    def test_preemption_frees_weighted_slots(self):
+        sup = make_sup(capacity=4, preempt=True)
+        lo = new_job(name="lo", workers=0)
+        set_chips(lo, ReplicaType.MASTER, 4)
+        lo_key = sup.submit(lo)
+        sup.sync_once()
+        sup.runner.set_all_running(lo_key)
+        hi = new_job(name="hi", workers=0)
+        set_chips(hi, ReplicaType.MASTER, 3)
+        hi.spec.run_policy.scheduling_policy.priority = 10
+        hi_key = sup.submit(hi)
+        sup.sync_once()  # hi held (0 free < 3) → lo (4 slots) evicted
+        assert sup.runner.list_for_job(lo_key) == []
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(hi_key)) == 1
+
+    def test_stale_record_weight_healed_from_template(self, tmp_path):
+        """Records written before the weight existed (or with a stale
+        value) default to slots=1 at adoption; the first reconcile heals
+        them from the job's template — no capacity overcommit."""
+        import json
+
+        from pytorch_operator_tpu.controller.runner import SubprocessRunner
+
+        sup = Supervisor(
+            state_dir=tmp_path,
+            runner=SubprocessRunner(tmp_path, max_slots=8),
+            persist=True,
+        )
+        job = new_job(name="heal", workers=0)
+        set_chips(job, ReplicaType.MASTER, 4)
+        job.spec.replica_specs[ReplicaType.MASTER].template.command = ["sleep", "30"]
+        job.spec.replica_specs[ReplicaType.MASTER].template.module = None
+        key = sup.submit(job)
+        sup.sync_once()
+        rec_file = next((tmp_path / "replicas").glob("*.json"))
+        rec = json.loads(rec_file.read_text())
+        del rec["slots"]  # simulate a pre-upgrade record
+        rec_file.write_text(json.dumps(rec))
+
+        s2 = Supervisor(
+            state_dir=tmp_path,
+            runner=SubprocessRunner(tmp_path, max_slots=8),
+            persist=True,
+        )
+        assert s2.runner.schedulable_slots() == 7  # stale: undercounted
+        s2.sync_once()  # heals from the template
+        assert s2.runner.schedulable_slots() == 4
+        s2.shutdown()
+        sup.shutdown()
+
+    def test_handle_records_weight_for_adoption(self, tmp_path):
+        from pytorch_operator_tpu.api.types import ProcessTemplate
+        from pytorch_operator_tpu.controller.runner import SubprocessRunner
+
+        a = SubprocessRunner(tmp_path, max_slots=8)
+        t = ProcessTemplate(
+            command=["sleep", "30"], resources=Resources(tpu_chips=4)
+        )
+        h = a.create("default/w", ReplicaType.MASTER, 0, t, {})
+        assert h.slots == 4
+        assert a.schedulable_slots() == 4
+        b = SubprocessRunner(tmp_path, max_slots=8)  # adopts
+        assert b.get(h.name).slots == 4
+        assert b.schedulable_slots() == 4
+        b.delete(h.name, grace_seconds=1.0)
+        a.shutdown()
