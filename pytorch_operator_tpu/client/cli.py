@@ -511,9 +511,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--queue-slots",
         default=None,
         dest="queue_slots",
-        help="per-queue replica-slot caps, e.g. 'default=4,batch=2' "
-        "(jobs pick a queue via scheduling_policy.queue; unlisted "
-        "queues are unbounded)",
+        help="per-queue DEVICE-slot caps, e.g. 'default=4,batch=2' — a "
+        "replica requesting N chips/devices occupies N of them (jobs "
+        "pick a queue via scheduling_policy.queue; unlisted queues are "
+        "unbounded)",
     )
     sp.add_argument(
         "--preempt",

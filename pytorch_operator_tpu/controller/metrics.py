@@ -12,6 +12,14 @@ import threading
 from typing import Dict, Tuple
 
 
+def _fmt_labels(key: Tuple[Tuple[str, str], ...]) -> str:
+    """Prometheus exposition label block with the spec's escaping (a queue
+    name is arbitrary user text; an unescaped quote would invalidate the
+    whole scrape)."""
+    esc = lambda v: str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")  # noqa: E731
+    return ",".join(f'{k}="{esc(v)}"' for k, v in key)
+
+
 class Counter:
     """A labeled monotonic counter."""
 
@@ -41,8 +49,47 @@ class Counter:
                 lines.append(f"{self.name} 0")
             for key, value in sorted(self._values.items()):
                 if key:
-                    label_str = ",".join(f'{k}="{v}"' for k, v in key)
-                    lines.append(f"{self.name}{{{label_str}}} {value:g}")
+                    lines.append(f"{self.name}{{{_fmt_labels(key)}}} {value:g}")
+                else:
+                    lines.append(f"{self.name} {value:g}")
+        return "\n".join(lines)
+
+
+class Gauge:
+    """A labeled settable gauge (point-in-time scheduler state)."""
+
+    def __init__(self, name: str, help_text: str = ""):
+        self.name = name
+        self.help = help_text
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = float(value)
+
+    def clear(self) -> None:
+        """Drop all series (stale labeled values must not linger)."""
+        with self._lock:
+            self._values.clear()
+
+    def get(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def render(self) -> str:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} gauge")
+        with self._lock:
+            if not self._values:
+                lines.append(f"{self.name} 0")
+            for key, value in sorted(self._values.items()):
+                if key:
+                    lines.append(f"{self.name}{{{_fmt_labels(key)}}} {value:g}")
                 else:
                     lines.append(f"{self.name} {value:g}")
         return "\n".join(lines)
@@ -78,11 +125,40 @@ class MetricsRegistry:
         self.replicas_failed = self.counter(
             "tpujob_replicas_failed_total", "Replica processes that exited nonzero"
         )
+        self._gauges: Dict[str, Gauge] = {}
+        self.jobs_active = self.gauge(
+            "tpujob_jobs_active", "Unfinished TPUJobs in the store"
+        )
+        self.replicas_active = self.gauge(
+            "tpujob_replicas_active", "Live replica processes"
+        )
+        self.slots_used = self.gauge(
+            "tpujob_slots_used", "Device slots occupied by live replicas"
+        )
+        self.slots_capacity = self.gauge(
+            "tpujob_slots_capacity", "Device-slot capacity (--max-slots; 0 = unbounded)"
+        )
+        self.gangs_held = self.gauge(
+            "tpujob_gangs_held", "Gangs held Unschedulable in the last pass"
+        )
+        self.queue_slots_used = self.gauge(
+            "tpujob_queue_slots_used", "Device slots in use per queue"
+        )
+        self.queue_slots_capacity = self.gauge(
+            "tpujob_queue_slots_capacity", "Per-queue device-slot caps (--queue-slots)"
+        )
 
     def counter(self, name: str, help_text: str = "") -> Counter:
         if name not in self._counters:
             self._counters[name] = Counter(name, help_text)
         return self._counters[name]
 
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        if name not in self._gauges:
+            self._gauges[name] = Gauge(name, help_text)
+        return self._gauges[name]
+
     def render_text(self) -> str:
-        return "\n".join(c.render() for c in self._counters.values()) + "\n"
+        parts = [c.render() for c in self._counters.values()]
+        parts += [g.render() for g in self._gauges.values()]
+        return "\n".join(parts) + "\n"

@@ -138,10 +138,14 @@ class Reconciler:
             self._compute_queue_usage() if self.queue_slots is not None else None
         )
 
-    def end_pass(self) -> None:
+    def end_pass(self) -> Optional[dict]:
         """Close a supervisor pass: solo syncs (foreground ``wait()``) must
-        not admit against the pass's stale reservations or queue cache."""
+        not admit against the pass's stale reservations or queue cache.
+        Returns the pass's final {queue: device-slot usage} (None when
+        queues are unconfigured) so the caller can reuse the accounting
+        instead of rescanning every job."""
         self._in_pass = False
+        return self._pass_queue_used
 
     def _compute_queue_usage(self) -> dict:
         """{queue: active device-slot usage} over every job in the store —
@@ -429,11 +433,14 @@ class Reconciler:
         handles = self.runner.list_for_job(key)
         # The template is the source of truth for a replica's device-slot
         # weight: heal records written before the weight existed (adopted
-        # from an older supervisor) or with a stale value.
+        # from an older supervisor) or with a stale value. Persisted by
+        # the runner so a later restart adopts the corrected weight.
         for h in handles:
             rt_spec = job.spec.replica_specs.get(h.replica_type)
             if rt_spec is not None:
-                h.slots = replica_slots(rt_spec.template)
+                w = replica_slots(rt_spec.template)
+                if h.slots != w:
+                    self.runner.set_slots(h.name, w)
         self._scan_first_step(job, key)
 
         # ---- completion: job Succeeded ⇔ Master succeeded (status.go) ----

@@ -207,6 +207,13 @@ class ProcessRunner:
         """Free scheduling slots, or None for unlimited (gang admission input)."""
         return None
 
+    def set_slots(self, name: str, slots: int) -> None:
+        """Correct a replica's device-slot weight (template is the source
+        of truth; records from pre-weight supervisors need healing)."""
+        h = self.get(name)
+        if h is not None:
+            h.slots = slots
+
 
 class FakeRunner(ProcessRunner):
     """In-memory runner for controller tests (fake clientset analog).
@@ -639,6 +646,15 @@ class SubprocessRunner(ProcessRunner):
             self.handles.pop(name, None)
             self._pid_starts.pop(name, None)
             self._forget_files(name)
+
+    def set_slots(self, name, slots):
+        """Heal a stale weight AND persist it — an in-memory-only heal
+        would re-open the overcommit window on every supervisor restart."""
+        with self._lock:
+            h = self.handles.get(name)
+            if h is not None and h.slots != slots:
+                h.slots = slots
+                self._save(h, only_if_tracked=True)
 
     def schedulable_slots(self):
         if self.max_slots is None:

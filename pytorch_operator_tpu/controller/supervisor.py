@@ -210,8 +210,30 @@ class Supervisor:
             if self.preempt_enabled:
                 self._maybe_preempt(jobs, now)
         finally:
-            self.reconciler.end_pass()
+            queue_usage = self.reconciler.end_pass()
+        self._update_gauges(jobs, queue_usage)
         return any_active
+
+    def _update_gauges(self, jobs, queue_usage: Optional[dict]) -> None:
+        """Point-in-time scheduler state for /metrics, refreshed per pass
+        from the pass's own accounting (no rescans)."""
+        m = self.metrics
+        m.jobs_active.set(sum(1 for _, j in jobs if not j.is_finished()))
+        handles = list(getattr(self.runner, "handles", {}).values())
+        active = [h for h in handles if h.is_active()]
+        m.replicas_active.set(len(active))
+        m.slots_used.set(sum(h.slots for h in active))
+        capacity = getattr(self.runner, "max_slots", None) or getattr(
+            self.runner, "capacity", None
+        )
+        m.slots_capacity.set(capacity or 0)
+        m.gangs_held.set(len(self.reconciler.held_gangs()))
+        m.queue_slots_used.clear()
+        m.queue_slots_capacity.clear()
+        if self.reconciler.queue_slots and queue_usage is not None:
+            for qname, cap in self.reconciler.queue_slots.items():
+                m.queue_slots_capacity.set(cap, queue=qname)
+                m.queue_slots_used.set(queue_usage.get(qname, 0), queue=qname)
 
     def _maybe_preempt(self, jobs, now: float) -> None:
         """volcano ``preempt``: evict lower-priority running worlds so the

@@ -59,6 +59,43 @@ class TestMonitoringServer:
             srv.stop()
             sup.shutdown()
 
+    def test_scheduler_gauges_reflect_pass_state(self, tmp_path):
+        """Gauges (active jobs/replicas, slot usage, queue usage, held
+        gangs) refresh every supervisor pass."""
+        from pytorch_operator_tpu.controller.runner import FakeRunner
+
+        sup = Supervisor(
+            state_dir=None,
+            runner=FakeRunner(capacity=3),
+            persist=False,
+            queue_slots={"q": 2},
+        )
+        a = new_job(name="a", workers=1)  # 2 replicas
+        a.spec.run_policy.scheduling_policy.queue = "q"
+        big = new_job(name="big", workers=4)  # gang of 5 > 3 → held
+        sup.submit(a)
+        sup.submit(big)
+        sup.sync_once()
+        m = sup.metrics
+        assert m.jobs_active.get() == 2
+        assert m.replicas_active.get() == 2
+        assert m.slots_used.get() == 2
+        assert m.slots_capacity.get() == 3
+        assert m.gangs_held.get() == 1
+        assert m.queue_slots_used.get(queue="q") == 2
+        assert m.queue_slots_capacity.get(queue="q") == 2
+        text = m.render_text()
+        assert 'tpujob_queue_slots_used{queue="q"} 2' in text
+        assert "tpujob_gangs_held 1" in text
+
+    def test_label_values_escaped(self):
+        from pytorch_operator_tpu.controller.metrics import Gauge
+
+        g = Gauge("g")
+        g.set(1, queue='we"ird\\q\nx')
+        rendered = g.render()
+        assert 'queue="we\\"ird\\\\q\\nx"' in rendered
+
     def test_unknown_path_404(self, tmp_path):
         sup = Supervisor(state_dir=tmp_path, persist=False)
         srv = MonitoringServer(

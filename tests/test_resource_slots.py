@@ -138,8 +138,16 @@ class TestWeightedAdmission:
             persist=True,
         )
         assert s2.runner.schedulable_slots() == 7  # stale: undercounted
-        s2.sync_once()  # heals from the template
+        s2.sync_once()  # heals from the template AND persists
         assert s2.runner.schedulable_slots() == 4
+        # A third restart adopts the healed weight directly — no window.
+        s3 = Supervisor(
+            state_dir=tmp_path,
+            runner=SubprocessRunner(tmp_path, max_slots=8),
+            persist=True,
+        )
+        assert s3.runner.schedulable_slots() == 4
+        s3.shutdown()
         s2.shutdown()
         sup.shutdown()
 
