@@ -1,0 +1,109 @@
+"""Llama-3-8B sharding-plan validation on a virtual v5p-64 topology.
+
+The BASELINE.json:10 target ("Llama-3-8B multi-host, sharding config
+validated, scaled down") — validated here ABSTRACTLY: ``jax.eval_shape``
+of the full 8B init + AdamW state costs only metadata, so the real
+config's logical-axis plan is checked against a dp=2,fsdp=8,tp=2 mesh
+(32 chips — a v5p-64 slice: slice names count TensorCores, two per chip)
+without any devices: every large tensor must shard, no tensor may use a
+mesh axis twice (the error jit would raise on real hardware), the
+per-chip footprint must fit v5p HBM, and the parameter count must be the
+real model's. Specs come from the PRODUCTION resolution path
+(``logical_to_spec`` over the default rule table), so a rule change is
+validated, not a copy of the policy.
+"""
+
+from __future__ import annotations
+
+import math
+
+import tests.jaxenv  # noqa: F401
+
+# v5p-64 slice = 32 chips (64 TensorCores): dp=2 x fsdp=8 x tp=2.
+MESH_EXTENTS = {"dp": 2, "fsdp": 8, "tp": 2}
+V5P_HBM_BYTES = 95 * 2**30  # 95 GiB per chip
+
+
+def _per_device_bytes(shape, itemsize, mesh_spec):
+    """(bytes per device, sharded?) for one tensor under the virtual mesh.
+
+    Rejects a mesh axis appearing twice in one tensor's spec — exactly the
+    plan error jit raises on real devices.
+    """
+    used = set()
+    divisor = 1
+    entries = tuple(mesh_spec) + (None,) * (len(shape) - len(tuple(mesh_spec)))
+    for dim, entry in zip(shape, entries):
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        d = 1
+        for a in axes:
+            assert a not in used, f"mesh axis {a!r} used twice in {mesh_spec}"
+            used.add(a)
+            d *= MESH_EXTENTS.get(a, 1)
+        if d > 1 and dim % d == 0:
+            divisor *= d
+    return math.prod(shape) * itemsize / divisor, divisor > 1
+
+
+class TestLlama8BPlan:
+    def test_plan_shards_everything_large_and_fits_hbm(self):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from pytorch_operator_tpu.models import llama as llama_lib
+        from pytorch_operator_tpu.parallel.sharding import logical_to_spec
+
+        cfg = llama_lib.llama3_8b()
+        model = llama_lib.Llama(cfg)
+        tx = optax.adamw(1e-4)
+
+        def abstract_state(key):
+            variables = model.init(key, np.zeros((1, 32), np.int32))
+            params = variables["params"]
+            return {"params": params, "opt_state": tx.init(params)}
+
+        abstract = jax.eval_shape(abstract_state, jax.random.key(0))
+        # Logical specs from flax, resolved to MESH specs by the
+        # production rule-resolution path.
+        logical_specs = nn.get_partition_spec(abstract)
+        flat_abs, _ = jax.tree.flatten(abstract)
+        flat_logical, _ = jax.tree.flatten(logical_specs)
+        assert len(flat_abs) == len(flat_logical)
+
+        n_params = sum(
+            math.prod(x.shape) for x in jax.tree.leaves(abstract["params"])
+        )
+        assert 7.5e9 < n_params < 8.5e9, f"param count {n_params/1e9:.2f}B"
+
+        total_per_dev = 0.0
+        unsharded_large = []
+        for x, lspec in zip(flat_abs, flat_logical):
+            mesh_spec = logical_to_spec(tuple(lspec))
+            b, sharded = _per_device_bytes(
+                x.shape, jnp.dtype(x.dtype).itemsize, mesh_spec
+            )
+            total_per_dev += b
+            nbytes = math.prod(x.shape) * jnp.dtype(x.dtype).itemsize
+            if nbytes > 2**24 and not sharded:  # >16 MiB replicated
+                unsharded_large.append((x.shape, tuple(lspec), nbytes))
+        assert not unsharded_large, (
+            f"large tensors left replicated: {unsharded_large[:5]}"
+        )
+        # Params + AdamW mu/nu per chip; v5p HBM with generous headroom for
+        # activations (remat + chunked loss keep those small).
+        assert total_per_dev < 0.25 * V5P_HBM_BYTES, (
+            f"per-chip state {total_per_dev/2**30:.1f} GiB too large"
+        )
+
+    def test_plan_covers_fsdp_and_tp(self):
+        """The q projection must shard over BOTH fsdp (embed) and tp
+        (heads) under the rule table — the FSDP+TP recipe of the target."""
+        from pytorch_operator_tpu.parallel.sharding import logical_to_spec
+
+        spec = logical_to_spec(("embed", "heads", "head_dim"))
+        assert tuple(spec) == ("fsdp", "tp")
