@@ -207,6 +207,14 @@ class ProcessRunner:
         """Free scheduling slots, or None for unlimited (gang admission input)."""
         return None
 
+    def capacity_slots(self) -> Optional[int]:
+        """Total device-slot capacity, or None for unbounded."""
+        return None
+
+    def list_all(self) -> List[ReplicaHandle]:
+        """Every tracked replica handle (all jobs)."""
+        raise NotImplementedError
+
     def set_slots(self, name: str, slots: int) -> None:
         """Correct a replica's device-slot weight (template is the source
         of truth; records from pre-weight supervisors need healing)."""
@@ -283,6 +291,13 @@ class FakeRunner(ProcessRunner):
                 return None
             used = sum(h.slots for h in self.handles.values() if h.is_active())
             return max(0, self.capacity - used)
+
+    def capacity_slots(self):
+        return self.capacity
+
+    def list_all(self):
+        with self._lock:
+            return list(self.handles.values())
 
     # --- test helpers ---
 
@@ -575,12 +590,12 @@ class SubprocessRunner(ProcessRunner):
         elif adopted_pid is not None:
             # Adopted replica: not our child — poll /proc for termination
             # instead of waitpid, with the same TERM→KILL escalation.
-            self._signal_adopted(name, adopted_pid, grace_seconds)
+            self._signal_group(name, adopted_pid, grace_seconds)
         elif h is not None and h.pid is not None:
             # Neither our child nor adopted-live: a replica already
             # classified finished. Its wrapper is gone, but a TERM-trapping
             # descendant may survive — reap any remaining group members.
-            self._signal_adopted(name, h.pid, grace_seconds)
+            self._signal_group(name, h.pid, grace_seconds)
         with self._lock:
             proc = self._procs.pop(name, None)
             if proc is not None and h is not None:
@@ -595,7 +610,10 @@ class SubprocessRunner(ProcessRunner):
             self.handles.pop(name, None)
             self._forget_files(name)
 
-    def _signal_adopted(self, name: str, pid: int, grace_seconds: float) -> None:
+    def _signal_group(self, name: str, pid: int, grace_seconds: float) -> None:
+        """TERM→KILL a replica's process group we hold no Popen for —
+        adopted replicas AND group survivors of already-finished wrappers
+        (the name is the group id; pid-reuse strangers are never signaled)."""
         start = self._pid_starts.get(name)
         stat = _proc_stat(pid)
         if (
@@ -662,6 +680,13 @@ class SubprocessRunner(ProcessRunner):
         with self._lock:
             used = sum(h.slots for h in self.handles.values() if h.is_active())
         return max(0, self.max_slots - used)
+
+    def capacity_slots(self):
+        return self.max_slots
+
+    def list_all(self):
+        with self._lock:
+            return list(self.handles.values())
 
     def shutdown(self):
         """Terminate replicas THIS incarnation spawned (supervisor exit).

@@ -219,14 +219,10 @@ class Supervisor:
         from the pass's own accounting (no rescans)."""
         m = self.metrics
         m.jobs_active.set(sum(1 for _, j in jobs if not j.is_finished()))
-        handles = list(getattr(self.runner, "handles", {}).values())
-        active = [h for h in handles if h.is_active()]
+        active = [h for h in self.runner.list_all() if h.is_active()]
         m.replicas_active.set(len(active))
         m.slots_used.set(sum(h.slots for h in active))
-        capacity = getattr(self.runner, "max_slots", None) or getattr(
-            self.runner, "capacity", None
-        )
-        m.slots_capacity.set(capacity or 0)
+        m.slots_capacity.set(self.runner.capacity_slots() or 0)
         m.gangs_held.set(len(self.reconciler.held_gangs()))
         m.queue_slots_used.clear()
         m.queue_slots_capacity.clear()
