@@ -303,6 +303,17 @@ def cmd_describe(args) -> int:
         print("Timeline:")
         for name, seconds in spans:
             print(f"  {name:<28} {seconds:.3f}s")
+    sp = job.spec.run_policy.scheduling_policy
+    sched = [f"gang={'on' if sp.gang else 'off'}"]
+    if sp.min_available is not None:
+        sched.append(f"min_available={sp.min_available}")
+    if sp.queue:
+        sched.append(f"queue={sp.queue}")
+    if sp.priority:
+        sched.append(f"priority={sp.priority}")
+    if job.spec.run_policy.suspend:
+        sched.append("SUSPENDED")
+    print("Scheduling: " + ", ".join(sched))
     print("Replicas:")
     for rtype, rs in job.spec.replica_specs.items():
         status = job.status.replica_statuses.get(rtype)
