@@ -6,8 +6,10 @@ untouched.
 
 from __future__ import annotations
 
-from pytorch_operator_tpu.api.types import ReplicaPhase, ReplicaType
-from pytorch_operator_tpu.controller.runner import FakeRunner, replica_name
+import time
+
+from pytorch_operator_tpu.api.types import ProcessTemplate, ReplicaPhase, ReplicaType
+from pytorch_operator_tpu.controller.runner import FakeRunner, SubprocessRunner, replica_name
 from pytorch_operator_tpu.controller.supervisor import Supervisor
 from tests.testutil import new_job
 
@@ -115,3 +117,59 @@ class TestPreemption:
         assert len(sup.runner.list_for_job(lo2_key)) == 0
         assert len(sup.runner.list_for_job(lo1_key)) == 1
         assert len(sup.runner.list_for_job(mid_key)) == 1
+
+
+class TestPreemptionE2E:
+    def test_real_world_evicted_and_relaunched(self, tmp_path):
+        """Real subprocess worlds: a high-priority job evicts a running
+        low-priority sleeper, runs to completion, then the victim
+        relaunches and completes — restart budget untouched throughout."""
+        sup = Supervisor(
+            state_dir=tmp_path,
+            runner=SubprocessRunner(tmp_path, max_slots=1),
+            persist=True,
+            preempt=True,
+        )
+        try:
+            self._run_scenario(sup)
+        finally:
+            sup.shutdown()
+
+    def _run_scenario(self, sup):
+        lo = new_job(name="lo", workers=0)
+        lo.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            command=["sh", "-c", "sleep 2; echo lo-done"]
+        )
+        lo_key = sup.submit(lo)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            sup.sync_once()
+            hs = sup.runner.list_for_job(lo_key)
+            if hs and all(h.phase == ReplicaPhase.RUNNING for h in hs):
+                break
+            time.sleep(0.05)
+        hs = sup.runner.list_for_job(lo_key)
+        assert hs and all(h.phase == ReplicaPhase.RUNNING for h in hs), (
+            "lo world failed to launch — preemption scenario never started"
+        )
+        hi = new_job(name="hi", workers=0)
+        hi.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            command=["sh", "-c", "echo hi-done"]
+        )
+        hi.spec.run_policy.scheduling_policy.priority = 10
+        hi_key = sup.submit(hi)
+
+        deadline = time.time() + 40
+        while time.time() < deadline:
+            sup.sync_once()
+            hi_job, lo_job = sup.get(hi_key), sup.get(lo_key)
+            if hi_job.is_succeeded() and lo_job.is_succeeded():
+                break
+            time.sleep(0.05)
+        assert sup.get(hi_key).is_succeeded()
+        lo_job = sup.get(lo_key)
+        assert lo_job.is_succeeded()  # relaunched after eviction
+        assert lo_job.status.restart_count == 0  # budget untouched
+        assert any(
+            e.reason == "TPUJobPreempted" for e in sup.events.for_job(lo_key)
+        )
