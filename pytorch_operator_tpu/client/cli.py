@@ -122,8 +122,10 @@ def cmd_run(args) -> int:
     return 0 if j.is_succeeded() else 1
 
 
-def cmd_submit(args) -> int:
-    job = load_job(args.file)
+def _load_validated_job(path):
+    """Load + default + validate a spec file, or None after printing the
+    errors (shared by submit/apply)."""
+    job = load_job(path)
     set_defaults(job)
     try:
         validate(job)
@@ -131,6 +133,13 @@ def cmd_submit(args) -> int:
         print("error: invalid TPUJob spec:", file=sys.stderr)
         for msg in e.errors:
             print(f"  - {msg}", file=sys.stderr)
+        return None
+    return job
+
+
+def cmd_submit(args) -> int:
+    job = _load_validated_job(args.file)
+    if job is None:
         return 2
     store = JobStore(persist_dir=_state_dir(args) / "jobs")
     try:
@@ -236,6 +245,7 @@ def cmd_supervisor(args) -> int:
             sup.process_deletion_markers()
             sup.process_scale_markers()
             sup.process_suspend_markers()
+            sup.process_apply_markers()
             sup.sync_once()
             sup.write_metrics_file()
             time.sleep(args.interval)
@@ -450,6 +460,34 @@ def cmd_scale(args) -> int:
     return 0
 
 
+def cmd_apply(args) -> int:
+    """kubectl apply analog: create or update. A new job is stored
+    directly; an update to an existing job is left as a marker for the
+    owning supervisor (it may need to restart the world at the new
+    shape)."""
+    from ..controller.store import job_key as _job_key
+
+    job = _load_validated_job(args.file)
+    if job is None:
+        return 2
+    store = JobStore(persist_dir=_state_dir(args) / "jobs")
+    key = _job_key(job)
+    if store.get(key) is None:
+        try:
+            store.add(job)
+        except ValueError:
+            # Lost a create race with a concurrent apply — fall through to
+            # the update path.
+            store.mark_apply(key, job.to_dict())
+            print(f"tpujob {key} update requested")
+            return 0
+        print(f"tpujob {key} created (run 'tpujob supervisor' to reconcile)")
+    else:
+        store.mark_apply(key, job.to_dict())
+        print(f"tpujob {key} update requested")
+    return 0
+
+
 def _cmd_set_suspend(args, flag: bool) -> int:
     """Suspend/resume: leave a marker for the owning supervisor (it owns
     the replica processes, so it performs the teardown/relaunch)."""
@@ -582,6 +620,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--workers", type=int, required=True)
     add_ns(sp)
     sp.set_defaults(func=cmd_scale)
+
+    sp = sub.add_parser(
+        "apply", help="create or update a job from a spec file (kubectl apply)"
+    )
+    sp.add_argument("file")
+    sp.set_defaults(func=cmd_apply)
 
     sp = sub.add_parser(
         "suspend", help="suspend a job (tear down replicas, keep the job)"

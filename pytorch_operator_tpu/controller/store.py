@@ -175,6 +175,43 @@ class JobStore:
             return
         self._marker_path(key, "delete").unlink(missing_ok=True)
 
+    def mark_apply(self, key: str, job_dict: dict) -> None:
+        """Leave a cross-process spec-update request (kubectl-apply analog):
+        the owning supervisor applies it (it may need to restart the world)."""
+        if self.persist_dir is None:
+            return
+        import json as _json
+
+        # tmp-write + rename (the _persist pattern): the daemon polls and
+        # claims markers by rename — it must never see a half-written one.
+        path = self._marker_path(key, "apply")
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(_json.dumps(job_dict))
+        tmp.replace(path)
+
+    def take_apply_markers(self) -> List[tuple]:
+        """Atomically claim pending apply requests: (key, job_dict).
+        Claim-by-rename, same contract as take_scale_markers."""
+        if self.persist_dir is None:
+            return []
+        import json as _json
+
+        out = []
+        for p in sorted(self.persist_dir.glob("*.apply")):
+            claimed = p.with_name(p.name + "-claimed")
+            try:
+                p.rename(claimed)
+            except OSError:
+                continue
+            try:
+                job_dict = _json.loads(claimed.read_text())
+            except (OSError, ValueError):
+                job_dict = None
+            claimed.unlink(missing_ok=True)
+            if job_dict is not None:
+                out.append((p.stem.replace("_", "/", 1), job_dict))
+        return out
+
     def mark_suspend(self, key: str, suspend: bool) -> None:
         """Leave a cross-process suspend/resume request."""
         if self.persist_dir is None:

@@ -14,7 +14,11 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
-from ..api.defaults import ELASTIC_TARGET_ANNOTATION, set_defaults
+from ..api.defaults import (
+    AUTO_PORT_ANNOTATION,
+    ELASTIC_TARGET_ANNOTATION,
+    set_defaults,
+)
 from ..api.types import ConditionType, ReplicaType, TPUJob
 from ..api.validation import ValidationError, validate
 from .events import EventRecorder
@@ -126,6 +130,89 @@ class Supervisor:
         # job churn would otherwise leak one Lock per key ever seen).
         self.reconciler.drop_key_lock(key)
         return True
+
+    def apply(self, job: TPUJob) -> str:
+        """kubectl-apply semantics: create the job if absent, update the
+        spec in place if active, or start a fresh incarnation if finished.
+
+        An active job whose WORLD SHAPE changed (replica specs or port)
+        gets a gang restart at the new shape — the pod-template-change
+        semantics; run-policy-only changes (TTL, deadline, scheduling,
+        suspend) take effect without touching the running world.
+        """
+        set_defaults(job)
+        validate(job)
+        key = job_key(job)
+        with self.reconciler.key_lock(key):
+            cur = self.store.get(key)
+            if cur is None:
+                return self.submit(job)
+            if cur.is_finished():
+                # Fresh incarnation: the old record (and its terminal
+                # status) is replaced; checkpoints/artifacts survive, as
+                # on resubmission.
+                for h in self.runner.list_for_job(key):
+                    self.runner.delete(h.name)
+                self.store.delete(key)
+                self.events.normal(
+                    key, "TPUJobReplaced", "finished job replaced by apply."
+                )
+                return self.submit(job)
+            # Auto-port jobs carry a freshly-probed port per world launch;
+            # comparing those would flag every apply as a world change.
+            both_auto = (
+                cur.metadata.annotations.get(AUTO_PORT_ANNOTATION) == "true"
+                and job.metadata.annotations.get(AUTO_PORT_ANNOTATION) == "true"
+            )
+            world_changed = cur.spec.replica_specs != job.spec.replica_specs or (
+                not both_auto and cur.spec.port != job.spec.port
+            )
+            if both_auto:
+                job.spec.port = cur.spec.port  # keep the live probed port
+            cur.spec = job.spec
+            # New metadata wins; system identity (uid/creation/submit) stays.
+            cur.metadata.labels.update(job.metadata.labels)
+            cur.metadata.annotations.update(job.metadata.annotations)
+            if job.metadata.annotations.get(AUTO_PORT_ANNOTATION) != "true":
+                # The incoming spec pinned an explicit port: drop the stale
+                # auto-port marker or the reconciler would re-probe a
+                # random port at relaunch and ignore the user's choice.
+                cur.metadata.annotations.pop(AUTO_PORT_ANNOTATION, None)
+            if job.spec.elastic_policy is not None:
+                workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+                if workers is not None:
+                    # Apply re-pins the grow-back target like manual scale.
+                    cur.metadata.annotations[ELASTIC_TARGET_ANNOTATION] = str(
+                        workers.replicas
+                    )
+            handles = self.runner.list_for_job(key)
+            if world_changed and handles:
+                msg = (
+                    f"spec update changed the world shape "
+                    f"(restart #{cur.status.restart_count + 1})."
+                )
+                self.reconciler.restart_world(
+                    cur, key, handles, "TPUJobUpdated", msg, warning=False
+                )
+            else:
+                self.events.normal(
+                    key, "TPUJobUpdated", "spec updated in place."
+                )
+            self.store.update(cur)
+            return key
+
+    def process_apply_markers(self) -> None:
+        """Act on cross-process ``tpujob apply`` requests."""
+        from ..api.serialization import job_from_dict
+
+        for key, job_dict in self.store.take_apply_markers():
+            try:
+                self.apply(job_from_dict(job_dict))
+            except Exception as e:  # noqa: BLE001 — a malformed marker
+                # (arbitrary user JSON) must never kill the daemon loop.
+                self.events.warning(
+                    key, "TPUJobApplyRejected", f"apply rejected: {e}"
+                )
 
     def scale(self, key: str, worker_replicas: int) -> TPUJob:
         """Elastic resize: change the Worker count and re-rendezvous the gang.

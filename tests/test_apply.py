@@ -1,0 +1,143 @@
+"""kubectl-apply semantics (Supervisor.apply / tpujob apply): create if
+absent, in-place spec update if active (gang restart only when the world
+shape changed), fresh incarnation if finished.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from pytorch_operator_tpu.api.defaults import ELASTIC_TARGET_ANNOTATION
+from pytorch_operator_tpu.api.types import (
+    ElasticPolicy,
+    ReplicaPhase,
+    ReplicaType,
+)
+from pytorch_operator_tpu.controller.runner import FakeRunner, replica_name
+from pytorch_operator_tpu.controller.supervisor import Supervisor
+from tests.testutil import new_job
+
+
+def make_sup(**kw):
+    return Supervisor(state_dir=None, runner=FakeRunner(), persist=False, **kw)
+
+
+def finish_master(sup, key):
+    sup.runner.set_phase(
+        replica_name(key, ReplicaType.MASTER, 0), ReplicaPhase.SUCCEEDED, exit_code=0
+    )
+
+
+class TestApply:
+    def test_apply_creates_when_absent(self):
+        sup = make_sup()
+        key = sup.apply(new_job(name="a", workers=1))
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 2
+
+    def test_run_policy_update_does_not_restart_world(self):
+        sup = make_sup()
+        key = sup.apply(new_job(name="a", workers=1))
+        sup.sync_once()
+        sup.runner.set_all_running(key)
+        updated = new_job(name="a", workers=1)
+        updated.spec.run_policy.ttl_seconds_after_finished = 123
+        sup.apply(updated)
+        sup.sync_once()
+        j = sup.get(key)
+        assert j.spec.run_policy.ttl_seconds_after_finished == 123
+        assert j.status.restart_count == 0  # world untouched
+        pids = sup.runner.list_for_job(key)
+        assert len(pids) == 2
+
+    def test_world_shape_change_restarts_gang(self):
+        sup = make_sup()
+        key = sup.apply(new_job(name="a", workers=1))
+        sup.sync_once()
+        sup.runner.set_all_running(key)
+        updated = new_job(name="a", workers=3)  # world shape changed
+        sup.apply(updated)
+        j = sup.get(key)
+        assert j.status.restart_count == 1
+        assert any(e.reason == "TPUJobUpdated" for e in sup.events.for_job(key))
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 4
+
+    def test_apply_to_finished_job_starts_fresh_incarnation(self):
+        sup = make_sup()
+        key = sup.apply(new_job(name="a", workers=0))
+        sup.sync_once()
+        sup.runner.set_all_running(key)
+        finish_master(sup, key)
+        sup.sync_once()
+        assert sup.get(key).is_succeeded()
+        key2 = sup.apply(new_job(name="a", workers=0))
+        assert key2 == key
+        j = sup.get(key)
+        assert not j.is_finished()  # fresh status
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 1
+
+    def test_apply_repins_elastic_target(self):
+        sup = make_sup()
+        job = new_job(
+            name="el", workers=3,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=4, max_restarts=8),
+        )
+        key = sup.apply(job)
+        sup.sync_once()
+        sup.runner.set_all_running(key)
+        updated = new_job(
+            name="el", workers=2,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=4, max_restarts=8),
+        )
+        sup.apply(updated)
+        j = sup.get(key)
+        assert j.metadata.annotations[ELASTIC_TARGET_ANNOTATION] == "2"
+
+    def test_apply_explicit_port_clears_auto_port(self):
+        """Pinning a port over a previously auto-port job must stick: the
+        stale auto-port annotation would make the reconciler re-probe a
+        random port at relaunch."""
+        from pytorch_operator_tpu.api.defaults import AUTO_PORT_ANNOTATION
+
+        sup = make_sup()
+        key = sup.apply(new_job(name="p", workers=0))  # auto-port
+        sup.sync_once()
+        sup.runner.set_all_running(key)
+        # defaulted=False: a real user YAML with an explicit port never
+        # carries the auto-port annotation.
+        updated = new_job(name="p", workers=0, defaulted=False)
+        updated.spec.port = 29501  # explicit pin
+        sup.apply(updated)
+        j = sup.get(key)
+        assert j.spec.port == 29501
+        assert AUTO_PORT_ANNOTATION not in j.metadata.annotations
+        sup.sync_once()  # relaunched world must use the pinned port
+        env = sup.runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
+        assert env["MASTER_PORT"] == "29501"
+        assert ":29501" in env["TPUJOB_COORDINATOR_ADDRESS"]
+
+    def test_apply_marker_cross_process(self, tmp_path):
+        sup = Supervisor(state_dir=tmp_path, runner=FakeRunner(), persist=True)
+        key = sup.apply(new_job(name="m", workers=0))
+        sup.sync_once()
+        updated = new_job(name="m", workers=0)
+        updated.spec.run_policy.backoff_limit = 9
+        # CLI process leaves the marker; the daemon claims it.
+        sup.store.mark_apply(key, updated.to_dict())
+        sup.process_apply_markers()
+        assert sup.get(key).spec.run_policy.backoff_limit == 9
+
+    def test_invalid_apply_rejected_via_marker(self, tmp_path):
+        sup = Supervisor(state_dir=tmp_path, runner=FakeRunner(), persist=True)
+        key = sup.apply(new_job(name="m", workers=0))
+        bad = new_job(name="m", workers=0, defaulted=False).to_dict()
+        del bad["spec"]["replica_specs"]["Master"]  # no Master → invalid
+        sup.store.mark_apply(key, bad)
+        sup.process_apply_markers()
+        assert any(
+            e.reason == "TPUJobApplyRejected" for e in sup.events.for_job(key)
+        )
+        # Original spec untouched.
+        assert ReplicaType.MASTER in sup.get(key).spec.replica_specs
