@@ -134,46 +134,8 @@ class TestValidation:
             validate(job)
 
 
-class TestConditions:
-    def test_created_then_running(self):
-        job = new_job()
-        job.set_condition(ConditionType.CREATED, reason="TPUJobCreated")
-        job.set_condition(ConditionType.RUNNING, reason="TPUJobRunning")
-        assert job.has_condition(ConditionType.CREATED)
-        assert job.has_condition(ConditionType.RUNNING)
-        assert not job.is_finished()
-
-    def test_restarting_clears_running(self):
-        job = new_job()
-        job.set_condition(ConditionType.RUNNING)
-        job.set_condition(ConditionType.RESTARTING)
-        assert job.has_condition(ConditionType.RESTARTING)
-        assert not job.has_condition(ConditionType.RUNNING)
-        # and back
-        job.set_condition(ConditionType.RUNNING)
-        assert not job.has_condition(ConditionType.RESTARTING)
-
-    def test_terminal_clears_running(self):
-        job = new_job()
-        job.set_condition(ConditionType.RUNNING)
-        job.set_condition(ConditionType.SUCCEEDED)
-        assert job.is_succeeded()
-        assert job.is_finished()
-        assert not job.has_condition(ConditionType.RUNNING)
-
-    def test_transition_times(self):
-        job = new_job()
-        job.set_condition(ConditionType.RUNNING, now=100.0)
-        c = job.get_condition(ConditionType.RUNNING)
-        assert c.last_transition_time == 100.0
-        # same status, later update: transition time unchanged
-        job.set_condition(ConditionType.RUNNING, now=200.0)
-        assert c.last_transition_time == 100.0
-        assert c.last_update_time == 200.0
-        # flip: transition time moves
-        job.set_condition(ConditionType.FAILED, now=300.0)
-        assert c.last_transition_time == 300.0
-        assert c.status is False
+# Condition state-machine semantics (exclusivity matrix, timestamps) live
+# in tests/test_conditions.py — the single home for that coverage.
 
 
 class TestSerialization:

@@ -1,0 +1,88 @@
+"""Condition state-machine matrix — the behavioral subtlety SURVEY.md §7
+flags ("getting the condition state machine exactly faithful ... is where
+the reference's behavioral subtlety lives"). Explicit exclusivity matrix
+and timestamp semantics, mirroring the reference's updateJobConditions.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pytorch_operator_tpu.api.types import ConditionType, TPUJob
+
+C = ConditionType
+CURRENT_STATE = (C.RUNNING, C.RESTARTING, C.SUSPENDED)
+TERMINAL = (C.SUCCEEDED, C.FAILED)
+
+
+class TestExclusivityMatrix:
+    @pytest.mark.parametrize("new", CURRENT_STATE)
+    @pytest.mark.parametrize("old", CURRENT_STATE)
+    def test_current_state_conditions_are_mutually_exclusive(self, old, new):
+        if old == new:
+            pytest.skip("same condition")
+        job = TPUJob()
+        job.set_condition(old)
+        job.set_condition(new)
+        assert job.has_condition(new)
+        assert not job.has_condition(old)
+
+    @pytest.mark.parametrize("terminal", TERMINAL)
+    @pytest.mark.parametrize("state", CURRENT_STATE)
+    def test_terminal_clears_every_current_state(self, state, terminal):
+        job = TPUJob()
+        job.set_condition(state)
+        job.set_condition(terminal)
+        assert job.has_condition(terminal)
+        assert not job.has_condition(state)
+        assert job.is_finished()
+
+    def test_created_survives_everything(self):
+        job = TPUJob()
+        job.set_condition(C.CREATED)
+        for ct in CURRENT_STATE + TERMINAL:
+            job.set_condition(ct)
+        assert job.has_condition(C.CREATED)
+
+    def test_cleared_condition_keeps_history_entry(self):
+        """Clearing flips status to False but keeps the entry (the
+        reference keeps the full condition list with status flags)."""
+        job = TPUJob()
+        job.set_condition(C.RUNNING)
+        job.set_condition(C.RESTARTING)
+        running = job.get_condition(C.RUNNING)
+        assert running is not None and running.status is False
+
+
+class TestTimestamps:
+    def test_transition_time_only_moves_on_status_flip(self):
+        job = TPUJob()
+        job.set_condition(C.RUNNING, reason="a", now=100.0)
+        c = job.get_condition(C.RUNNING)
+        assert c.last_transition_time == 100.0
+        # Same status, later update: update time moves, transition stays.
+        job.set_condition(C.RUNNING, reason="b", now=200.0)
+        c = job.get_condition(C.RUNNING)
+        assert c.last_update_time == 200.0
+        assert c.last_transition_time == 100.0
+        # Flip off (via RESTARTING) and back on: transition moves.
+        job.set_condition(C.RESTARTING, now=300.0)
+        job.set_condition(C.RUNNING, now=400.0)
+        c = job.get_condition(C.RUNNING)
+        assert c.last_transition_time == 400.0
+
+    def test_exclusive_clear_stamps_both_times(self):
+        job = TPUJob()
+        job.set_condition(C.RUNNING, now=100.0)
+        job.set_condition(C.SUSPENDED, now=250.0)
+        running = job.get_condition(C.RUNNING)
+        assert running.status is False
+        assert running.last_transition_time == 250.0
+        assert running.last_update_time == 250.0
+
+    def test_reason_and_message_persist_unless_replaced(self):
+        job = TPUJob()
+        job.set_condition(C.RUNNING, reason="r1", message="m1", now=1.0)
+        job.set_condition(C.RUNNING, now=2.0)  # empty reason/message
+        c = job.get_condition(C.RUNNING)
+        assert c.reason == "r1" and c.message == "m1"
