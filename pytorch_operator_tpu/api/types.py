@@ -283,7 +283,9 @@ class SchedulingPolicy:
             min_available=_parse_opt_int(
                 d, "min_available", "scheduling_policy.min_available"
             ),
-            queue=d.get("queue"),
+            # Coerced at parse time: a numeric YAML queue name must not
+            # surface as an int to consumers (display, queue-cap lookup).
+            queue=str(d["queue"]) if d.get("queue") is not None else None,
             priority=(
                 _parse_int(d["priority"], "scheduling_policy.priority")
                 if d.get("priority") is not None

@@ -267,17 +267,29 @@ def cmd_get(args) -> int:
         if not jobs:
             print(f"error: tpujob {_resolve_key(args)} not found", file=sys.stderr)
             return 1
-    rows = [("NAME", "NAMESPACE", "STATE", "RESTARTS", "AGE")]
+    # QUEUE/PRIORITY columns appear only when some job sets them — the
+    # default listing stays as terse as kubectl's.
+    show_sched = any(
+        j.spec.run_policy.scheduling_policy.queue
+        or j.spec.run_policy.scheduling_policy.priority
+        for j in jobs
+    )
+    header = ("NAME", "NAMESPACE", "STATE", "RESTARTS", "AGE")
+    if show_sched:
+        header += ("QUEUE", "PRIORITY")
+    rows = [header]
     for j in sorted(jobs, key=lambda j: j.metadata.creation_timestamp or 0):
-        rows.append(
-            (
-                j.metadata.name,
-                j.metadata.namespace,
-                _phase_of(j),
-                str(j.status.restart_count),
-                _age(j.metadata.creation_timestamp),
-            )
+        row = (
+            j.metadata.name,
+            j.metadata.namespace,
+            _phase_of(j),
+            str(j.status.restart_count),
+            _age(j.metadata.creation_timestamp),
         )
+        if show_sched:
+            sp = j.spec.run_policy.scheduling_policy
+            row += (sp.queue or "default", str(sp.priority))
+        rows.append(row)
     widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
     for r in rows:
         print("  ".join(c.ljust(w) for c, w in zip(r, widths)))

@@ -257,6 +257,10 @@ class TestAPI:
         assert SchedulingPolicy.from_dict({}).priority == 0
         assert SchedulingPolicy.from_dict({"priority": None}).priority == 0
 
+    def test_numeric_queue_name_coerced_to_string(self):
+        sp = SchedulingPolicy.from_dict({"queue": 5})
+        assert sp.queue == "5"
+
     def test_priority_bad_value_names_field(self):
         import pytest
 
