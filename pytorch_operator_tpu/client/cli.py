@@ -296,6 +296,47 @@ def cmd_get(args) -> int:
     return 0
 
 
+def cmd_events(args) -> int:
+    """kubectl get events analog: merged per-job event logs, oldest first,
+    bounded by --tail."""
+    ev_dir = _state_dir(args) / "events"
+    records = []
+    if ev_dir.is_dir():
+        for p in sorted(ev_dir.glob("*.events.jsonl")):
+            obj = p.name[: -len(".events.jsonl")].replace("_", "/", 1)
+            for line in p.read_text().splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                    ts = float(rec.get("timestamp", 0.0))
+                except (ValueError, TypeError, AttributeError):
+                    continue  # skip torn/foreign lines, not the whole command
+                records.append((ts, obj, rec))
+    records.sort(key=lambda r: r[0])
+    if args.tail > 0:
+        records = records[-args.tail :]
+    if not records:
+        print("no events")
+        return 0
+    rows = [("AGE", "TYPE", "OBJECT", "REASON", "MESSAGE")]
+    for ts, obj, rec in records:
+        rows.append(
+            (
+                _age(ts),
+                str(rec.get("type", "?")),
+                obj,
+                str(rec.get("reason", "?")),
+                str(rec.get("message", "")),
+            )
+        )
+    widths = [max(len(r[i]) for r in rows) for i in range(4)]
+    for r in rows:
+        lead = "  ".join(c.ljust(w) for c, w in zip(r[:4], widths))
+        print(f"{lead}  {r[4]}")
+    return 0
+
+
 def cmd_describe(args) -> int:
     state = _state_dir(args)
     store = JobStore(persist_dir=state / "jobs")
@@ -632,6 +673,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--workers", type=int, required=True)
     add_ns(sp)
     sp.set_defaults(func=cmd_scale)
+
+    sp = sub.add_parser(
+        "events", help="merged event log across jobs (kubectl get events)"
+    )
+    sp.add_argument(
+        "--tail", type=int, default=50, help="show the last N events (0 = all)"
+    )
+    sp.set_defaults(func=cmd_events)
 
     sp = sub.add_parser(
         "apply", help="create or update a job from a spec file (kubectl apply)"

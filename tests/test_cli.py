@@ -168,3 +168,27 @@ spec:
         state = tmp_path / "state"
         assert run_cli("--state-dir", state, "submit", job_yaml) == 0
         assert run_cli("--state-dir", state, "scale", "cli-job", "--workers", "2") == 2
+
+
+class TestEvents:
+    def test_events_merged_across_jobs(self, tmp_path, job_yaml, capsys):
+        state = tmp_path / "state"
+        assert run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30") == 0
+        capsys.readouterr()
+        assert run_cli("--state-dir", state, "events") == 0
+        out = capsys.readouterr().out
+        assert "TPUJobSubmitted" in out
+        assert "TPUJobSucceeded" in out
+        assert "REASON" in out  # header
+
+    def test_events_tail_bounds_output(self, tmp_path, job_yaml, capsys):
+        state = tmp_path / "state"
+        assert run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30") == 0
+        capsys.readouterr()
+        assert run_cli("--state-dir", state, "events", "--tail", "1") == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 2  # header + exactly one event
+
+    def test_events_empty_state(self, tmp_path, capsys):
+        assert run_cli("--state-dir", tmp_path / "fresh", "events") == 0
+        assert "no events" in capsys.readouterr().out
