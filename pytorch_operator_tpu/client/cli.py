@@ -235,6 +235,10 @@ def cmd_supervisor(args) -> int:
             )
             sup.lease.acquire()  # blocks until the leader exits or crashes
             print("tpujob supervisor: acquired leader lease", flush=True)
+            # Takeover: adopt the worlds the dead leader left running —
+            # this runner loaded (empty) records at startup, before the
+            # leader launched anything.
+            sup.runner.rescan()
         if args.monitoring_port is not None and monitoring is None:
             # The dead leader's exit freed its port; best effort rebind.
             start_monitoring()

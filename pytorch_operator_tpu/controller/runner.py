@@ -207,6 +207,10 @@ class ProcessRunner:
         """Free scheduling slots, or None for unlimited (gang admission input)."""
         return None
 
+    def rescan(self) -> None:
+        """Adopt state left by another incarnation (hot-standby takeover);
+        no-op for runners without persistence."""
+
     def capacity_slots(self) -> Optional[int]:
         """Total device-slot capacity, or None for unbounded."""
         return None
@@ -381,13 +385,38 @@ class SubprocessRunner(ProcessRunner):
         except (OSError, ValueError):
             return None
 
-    def _load_records(self) -> None:
+    def rescan(self) -> None:
+        """Adopt the worlds another incarnation left behind — the
+        hot-standby takeover step. The standby's startup snapshot (taken
+        while the old leader was still mutating records) is DISCARDED for
+        every replica that is not this runner's own live child: the disk
+        records the dead leader wrote are strictly fresher (it may have
+        restarted replicas under new pids since we loaded). Own children
+        (``self._procs``) keep their live Popen state."""
+        with self._lock:
+            for name in list(self.handles):
+                if name not in self._procs:
+                    self.handles.pop(name)
+                    self._adopted.pop(name, None)
+                    self._pid_starts.pop(name, None)
+            self._load_records(persist_classification=True)
+
+    def _load_records(self, persist_classification: bool = False) -> None:
         """Adopt persisted replicas: live pids (same /proc start time) come
         back RUNNING; dead ones get their exit code from the exit-capture
-        file, or 137 (signal death, retryable) if none was written."""
+        file, or 137 (signal death, retryable) if none was written.
+
+        Already-tracked names are skipped (this runner's live knowledge
+        wins over its own earlier records). ``persist_classification`` is
+        False at construction: a daemon may be a mere STANDBY whose leader
+        still owns these records — classifying dead replicas must not
+        write state to disk until this incarnation holds the lease
+        (rescan) or actively reconciles (sync)."""
         for rec_file in sorted(self.replica_dir.glob("*.json")):
             try:
                 rec = json.loads(rec_file.read_text())
+                if rec.get("name") in self.handles:
+                    continue
                 h = ReplicaHandle(
                     name=rec["name"],
                     job_key=rec["job_key"],
@@ -416,25 +445,28 @@ class SubprocessRunner(ProcessRunner):
                 # replica's MAIN process exits, so its presence means done
                 # even if a stray background child keeps the group alive.
                 if self._read_exit_file(h.name) is not None:
-                    self._finish_dead_adopted(h)
+                    self._finish_dead_adopted(h, save=persist_classification)
                 elif _replica_alive(h.pid, pid_start):
                     h.phase = ReplicaPhase.RUNNING
                     self._adopted[h.name] = h.pid
                 else:
-                    self._finish_dead_adopted(h)
+                    self._finish_dead_adopted(h, save=persist_classification)
             self.handles[h.name] = h
 
-    def _finish_dead_adopted(self, h: ReplicaHandle) -> None:
+    def _finish_dead_adopted(self, h: ReplicaHandle, save: bool = True) -> None:
         """Classify a replica found dead without a waitpid: exit-capture file
         if written, else 137 (group signal killed the wrapper too —
-        the preemption case, retryable under ExitCode policy)."""
+        the preemption case, retryable under ExitCode policy).
+        ``save=False`` keeps the classification in memory only (a standby
+        must not write records another incarnation owns)."""
         code = self._read_exit_file(h.name)
         h.exit_code = 137 if code is None else code
         h.phase = (
             ReplicaPhase.SUCCEEDED if h.exit_code == 0 else ReplicaPhase.FAILED
         )
         h.finished_at = time.time()
-        self._save(h, only_if_tracked=True)
+        if save:
+            self._save(h, only_if_tracked=True)
 
     def _argv(self, template: ProcessTemplate, exit_path: Path) -> List[str]:
         if template.command:
