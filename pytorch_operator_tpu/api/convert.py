@@ -12,9 +12,14 @@ all map; the pod template's first container becomes the process template.
 
 What cannot map is surfaced, not silently dropped: a container with no
 ``command`` is an error (there is no container runtime to run an image's
-entrypoint), and the image name / valueFrom env / priorityClassName are
+entrypoint); the image name / valueFrom env / priorityClassName, dropped
+pod-level fields (nodeSelector, tolerations, volumes, initContainers,
+affinity, ...), non-TPU resource requests, and sidecar commands are all
 recorded as ``tpujob.dev/converted-*`` annotations for the operator to
-see in ``tpujob describe``.
+see in ``tpujob describe``. (The reference's one operator-injected
+initContainer — the wait-for-master DNS gate, SURVEY.md §2 "Pod
+management" — needs no analog: coordinator connect-retry is built into
+the rendezvous.)
 """
 
 from __future__ import annotations
@@ -161,8 +166,30 @@ def _convert_replica_spec(rtype: str, rs: Dict[str, Any], annotations: Dict[str,
         raise ValueError(f"{path}.template.spec.containers: missing or empty")
     c = containers[0]
     if len(containers) > 1:
-        annotations[f"tpujob.dev/converted-sidecars-{rtype.lower()}"] = ",".join(
-            str(x.get("name", "?")) for x in containers[1:]
+        # Sidecars cannot run (no container runtime); keep name AND command
+        # visible so the operator can reconstruct what the pod did.
+        annotations[f"tpujob.dev/converted-sidecars-{rtype.lower()}"] = ";".join(
+            "{}={}".format(
+                x.get("name", "?"),
+                " ".join(str(a) for a in (x.get("command") or [])) or "<image entrypoint>",
+            )
+            for x in containers[1:]
+        )
+    # Pod-level fields with no process analog (nodeSelector, tolerations,
+    # volumes, affinity, ...): record them rather than silently dropping.
+    dropped_pod = sorted(
+        k for k, v in pod.items() if k != "containers" and v not in (None, [], {})
+    )
+    if "initContainers" in dropped_pod:
+        # initContainers change execution semantics — call them out by name
+        # in their own annotation (the canonical wait-for-master DNS gate is
+        # subsumed by the rendezvous's built-in connect-retry).
+        annotations[f"tpujob.dev/converted-init-containers-{rtype.lower()}"] = ",".join(
+            str(x.get("name", "?")) for x in pod.get("initContainers") or []
+        )
+    if dropped_pod:
+        annotations[f"tpujob.dev/converted-dropped-{rtype.lower()}"] = ",".join(
+            dropped_pod
         )
     template: Dict[str, Any] = {}
     command = list(c.get("command") or [])
@@ -193,11 +220,26 @@ def _convert_replica_spec(rtype: str, rs: Dict[str, Any], annotations: Dict[str,
     if env:
         template["env"] = env
 
-    # google.com/tpu resource limits → tpu_chips (the env's device ask).
+    # google.com/tpu resources → tpu_chips (the env's device ask). Limits
+    # win; a requests-only ask (no limits block) still counts.
     limits = (c.get("resources") or {}).get("limits") or {}
-    tpu = limits.get("google.com/tpu") or limits.get("cloud-tpus.google.com/v5e")
+    requests = (c.get("resources") or {}).get("requests") or {}
+    TPU_KEYS = ("google.com/tpu", "cloud-tpus.google.com/v5e")
+    tpu = next(
+        (src[k] for src in (limits, requests) for k in TPU_KEYS if k in src),
+        None,
+    )
     if tpu is not None:
         template["resources"] = {"tpu_chips": int(tpu)}
+    # Non-TPU resource asks (cpu, memory, nvidia.com/gpu, ...) have no
+    # process-supervisor analog — surface what was dropped.
+    non_tpu = sorted(
+        k for k in set(limits) | set(requests) if k not in TPU_KEYS
+    )
+    if non_tpu:
+        annotations[
+            f"tpujob.dev/converted-resources-dropped-{rtype.lower()}"
+        ] = ",".join(non_tpu)
 
     port = None
     for p in c.get("ports") or []:

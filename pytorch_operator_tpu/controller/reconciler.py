@@ -250,10 +250,12 @@ class Reconciler:
         self.events.warning(key, "TPUJobPreempted", msg)
 
     def _delete_replicas(self, handles) -> None:
-        """Teardown accounting in one place: delete + metric per replica."""
-        for h in handles:
-            self.runner.delete(h.name)
-            self.metrics.replicas_deleted.inc()
+        """Teardown accounting in one place: batch delete (one shared
+        kill-escalation for the whole world) + metric per replica."""
+        names = [h.name for h in handles]
+        self.runner.delete_many(names)
+        if names:
+            self.metrics.replicas_deleted.inc(len(names))
 
     def _slots_minus_reserved(self, key: str) -> Optional[int]:
         """Free runner slots, excluding capacity claimed by OTHER held
@@ -505,6 +507,12 @@ class Reconciler:
             for index in range(desired):
                 if self.runner.get(replica_name(key, rtype, index)) is None:
                     missing.append((rtype, index))
+        # replica_specs preserves user YAML key order, which may list Worker
+        # before Master. Partial gang admission and elastic shrink both rely
+        # on the Master heading the admitted prefix (a worker-only world
+        # blocks at rendezvous forever, and the shrink arithmetic assumes
+        # "master admitted first") — enforce it with a stable sort.
+        missing.sort(key=lambda mi: mi[0] != ReplicaType.MASTER)
 
         if missing:
             total = sum(self._desired_replicas(job, rt) for rt in job.spec.replica_specs)
@@ -602,6 +610,7 @@ class Reconciler:
                         for i in range(self._desired_replicas(job, rt))
                         if self.runner.get(replica_name(key, rt, i)) is None
                     ]
+                    missing.sort(key=lambda mi: mi[0] != ReplicaType.MASTER)
                     missing_w = [weights[rt] for rt, _ in missing]
             if self._in_pass:
                 if n_admit < len(missing):
@@ -816,9 +825,7 @@ class Reconciler:
             self.store.update(job)
             return True
         else:
-            for h in restarts:
-                self.runner.delete(h.name)
-                self.metrics.replicas_deleted.inc()
+            self._delete_replicas(restarts)
             job.status.restart_count += n_new_restarts
             self.metrics.jobs_restarted.inc(n_new_restarts)
             reason = "TPUJobRestarting"

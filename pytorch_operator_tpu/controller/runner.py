@@ -190,6 +190,12 @@ class ProcessRunner:
     def delete(self, name: str, grace_seconds: float = 5.0) -> None:
         raise NotImplementedError
 
+    def delete_many(self, names: List[str], grace_seconds: float = 5.0) -> None:
+        """Tear down several replicas; runners with a real kill-escalation
+        wait override this to share one escalation across the batch."""
+        for name in names:
+            self.delete(name, grace_seconds)
+
     def sync(self) -> None:
         """Poll live processes and update phases (informer-refresh analog)."""
 
@@ -588,64 +594,84 @@ class SubprocessRunner(ProcessRunner):
                 self._finish_dead_adopted(self.handles[name])
 
     def delete(self, name, grace_seconds: float = 5.0):
-        with self._lock:
-            proc = self._procs.get(name)
-            h = self.handles.get(name)
-            adopted_pid = self._adopted.get(name)
-        if proc is not None:
-            if proc.poll() is None:
-                # SIGTERM the whole process group, escalate to SIGKILL.
-                try:
-                    os.killpg(proc.pid, signal.SIGTERM)
-                except (ProcessLookupError, PermissionError):
-                    pass
-                try:
-                    proc.wait(timeout=grace_seconds)
-                except subprocess.TimeoutExpired:
+        self.delete_many([name], grace_seconds)
+
+    def delete_many(self, names, grace_seconds: float = 5.0):
+        """Tear down a batch of replicas with ONE shared TERM→KILL
+        escalation: every group is signaled up front, then a single
+        /proc-scan loop waits for all of them together. A TERM-trapping
+        multi-replica world therefore costs ~grace+2s for the whole batch,
+        not per replica — the reconcile loop (which calls this serially
+        for suspends/preemptions) must not stall for minutes while other
+        jobs wait to be synced."""
+        pending = []  # (name, handle, pgid, wrapper Popen or None)
+        # One /proc snapshot covers the whole signaling phase (groups only
+        # lose members, so a group empty here stays empty); the wait loop
+        # below re-scans fresh each tick.
+        live_pgids = _live_pgids() if names else set()
+        for name in names:
+            with self._lock:
+                proc = self._procs.get(name)
+                h = self.handles.get(name)
+                adopted_pid = self._adopted.get(name)
+            if proc is not None:
+                if proc.poll() is None or proc.pid in live_pgids:
+                    # SIGTERM the whole group. proc is the exit-capture
+                    # wrapper, which dies on TERM even when the replica
+                    # traps it; if the wrapper pre-deceased the replica
+                    # (stray kill, OOM) the survivors still get the
+                    # graceful signal before the shared escalation.
                     try:
-                        os.killpg(proc.pid, signal.SIGKILL)
+                        os.killpg(proc.pid, signal.SIGTERM)
                     except (ProcessLookupError, PermissionError):
                         pass
-                    proc.wait()
-            elif _group_members_alive(proc.pid):
-                # Wrapper pre-deceased the replica (stray kill, OOM): the
-                # survivors never saw a TERM — give them the same graceful
-                # signal before the escalation below.
-                try:
-                    os.killpg(proc.pid, signal.SIGTERM)
-                except (ProcessLookupError, PermissionError):
-                    pass
-            # proc is the exit-capture wrapper, which dies on TERM even when
-            # the replica traps it — keep going until the whole group is
-            # gone or the grace budget forces a KILL.
-            self._ensure_group_dead(proc.pid, grace_seconds)
-        elif adopted_pid is not None:
-            # Adopted replica: not our child — poll /proc for termination
-            # instead of waitpid, with the same TERM→KILL escalation.
-            self._signal_group(name, adopted_pid, grace_seconds)
-        elif h is not None and h.pid is not None:
-            # Neither our child nor adopted-live: a replica already
-            # classified finished. Its wrapper is gone, but a TERM-trapping
-            # descendant may survive — reap any remaining group members.
-            self._signal_group(name, h.pid, grace_seconds)
-        with self._lock:
-            proc = self._procs.pop(name, None)
-            if proc is not None and h is not None:
-                h.exit_code = normalize_exit_code(proc.returncode)
-                h.phase = ReplicaPhase.FAILED if proc.returncode else ReplicaPhase.SUCCEEDED
-                h.finished_at = time.time()
-            f = self._log_files.pop(name, None)
-            if f is not None:
-                f.close()
-            self._adopted.pop(name, None)
-            self._pid_starts.pop(name, None)
-            self.handles.pop(name, None)
-            self._forget_files(name)
+                pending.append((name, h, proc.pid, proc))
+            elif adopted_pid is not None:
+                # Adopted replica: not our child — poll /proc for
+                # termination instead of waitpid, same TERM→KILL path.
+                if self._term_group(name, adopted_pid, live_pgids):
+                    pending.append((name, h, adopted_pid, None))
+            elif h is not None and h.pid is not None:
+                # Neither our child nor adopted-live: a replica already
+                # classified finished. Its wrapper is gone, but a TERM-
+                # trapping descendant may survive — reap group members.
+                if self._term_group(name, h.pid, live_pgids):
+                    pending.append((name, h, h.pid, None))
+        self._ensure_groups_dead([p[2] for p in pending], grace_seconds)
+        for name, h, pgid, proc in pending:
+            if proc is not None:
+                # Group is dead (or just SIGKILLed), so the wrapper is at
+                # worst a zombie — reap it.
+                proc.wait()
+        for name in names:
+            with self._lock:
+                h = self.handles.get(name)
+                proc = self._procs.pop(name, None)
+                if proc is not None and h is not None:
+                    h.exit_code = normalize_exit_code(proc.returncode)
+                    h.phase = (
+                        ReplicaPhase.FAILED
+                        if proc.returncode
+                        else ReplicaPhase.SUCCEEDED
+                    )
+                    h.finished_at = time.time()
+                f = self._log_files.pop(name, None)
+                if f is not None:
+                    f.close()
+                self._adopted.pop(name, None)
+                self._pid_starts.pop(name, None)
+                self.handles.pop(name, None)
+                self._forget_files(name)
 
-    def _signal_group(self, name: str, pid: int, grace_seconds: float) -> None:
-        """TERM→KILL a replica's process group we hold no Popen for —
-        adopted replicas AND group survivors of already-finished wrappers
-        (the name is the group id; pid-reuse strangers are never signaled)."""
+    def _term_group(self, name: str, pid: int, live_pgids=None) -> bool:
+        """SIGTERM a replica's process group we hold no Popen for — adopted
+        replicas AND group survivors of already-finished wrappers (the name
+        is the group id; pid-reuse strangers are never signaled). Returns
+        whether a signal was sent (i.e. the group needs a death-wait).
+        ``live_pgids`` lets a batch caller amortize the /proc pass."""
+        members_alive = (
+            pid in live_pgids if live_pgids is not None else _group_members_alive(pid)
+        )
         start = self._pid_starts.get(name)
         stat = _proc_stat(pid)
         if (
@@ -654,31 +680,38 @@ class SubprocessRunner(ProcessRunner):
             and start is not None
             and stat[0] != start
         ):
-            return  # pid reused by a stranger — never signal it
-        if not _pid_alive(pid, start) and not _group_members_alive(pid):
+            return False  # pid reused by a stranger — never signal it
+        if not _pid_alive(pid, start) and not members_alive:
             # Wrapper gone and no surviving group members (a pid stays
             # allocated while it is a live pgid, so members ⇒ ours).
-            return
+            return False
         try:
             os.killpg(pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
-            return
-        self._ensure_group_dead(pid, grace_seconds)
+            return False
+        return True
 
-    def _ensure_group_dead(self, pgid: int, grace_seconds: float) -> None:
-        """Wait for every member of the replica's process group to exit,
-        escalating to a group SIGKILL when the grace budget runs out."""
+    def _ensure_groups_dead(self, pgids, grace_seconds: float) -> None:
+        """Wait until every member of every listed process group has
+        exited, escalating to group SIGKILLs when the grace budget runs
+        out. One /proc scan per tick covers the whole batch."""
+        waiting = set(pgids)
+        if not waiting:
+            return
         deadline = time.time() + grace_seconds
-        while time.time() < deadline:
-            if not _group_members_alive(pgid):
+        while waiting and time.time() < deadline:
+            waiting &= _live_pgids()
+            if not waiting:
                 return
             time.sleep(0.05)
-        try:
-            os.killpg(pgid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            return
+        for pgid in list(waiting):
+            try:
+                os.killpg(pgid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                waiting.discard(pgid)
         kill_deadline = time.time() + 2.0
-        while time.time() < kill_deadline and _group_members_alive(pgid):
+        while waiting and time.time() < kill_deadline:
+            waiting &= _live_pgids()
             time.sleep(0.05)
 
     def list_for_job(self, job_key):
@@ -731,5 +764,4 @@ class SubprocessRunner(ProcessRunner):
         """
         with self._lock:
             names = list(self._procs.keys())
-        for name in names:
-            self.delete(name, grace_seconds=2.0)
+        self.delete_many(names, grace_seconds=2.0)

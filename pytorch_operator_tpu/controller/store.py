@@ -8,6 +8,7 @@ persisted as JSON files so the CLI can inspect state across processes.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import uuid
@@ -28,9 +29,23 @@ class JobStore:
         self.persist_dir = Path(persist_dir) if persist_dir else None
         if self.persist_dir is not None:
             self.persist_dir.mkdir(parents=True, exist_ok=True)
+            self._sweep_stale_tmp()
             self._load_all()
 
     # ---- persistence ----
+
+    def _sweep_stale_tmp(self) -> None:
+        """Remove orphaned ``*.tmp`` files left by writers killed between
+        tmp-write and rename (pid-unique tmp names never get overwritten,
+        so crashes would otherwise accumulate them forever). The age floor
+        keeps in-flight writes of live processes safe."""
+        cutoff = time.time() - 300.0
+        for p in self.persist_dir.glob("*.tmp"):
+            try:
+                if p.stat().st_mtime < cutoff:
+                    p.unlink(missing_ok=True)
+            except OSError:
+                continue
 
     def _path_for(self, key: str) -> Path:
         return self.persist_dir / (key.replace("/", "_") + ".json")
@@ -149,7 +164,12 @@ class JobStore:
         """Leave a cross-process deletion request for the owning supervisor."""
         if self.persist_dir is None:
             return
-        self._marker_path(key, "delete").write_text("purge" if purge else "")
+        # Atomic: the daemon checks existence first, then reads the purge
+        # flag — a plain write_text would expose a just-created empty file
+        # (purge silently read as False).
+        self._atomic_write(
+            self._marker_path(key, "delete"), "purge" if purge else ""
+        )
 
     def deletion_markers(self) -> List[str]:
         """Keys with a pending cross-process deletion request."""
@@ -175,6 +195,17 @@ class JobStore:
             return
         self._marker_path(key, "delete").unlink(missing_ok=True)
 
+    @staticmethod
+    def _atomic_write(path, content: str) -> None:
+        """tmp-write + rename: the daemon polls and claims markers by
+        rename — it must never see a half-written one. The tmp name is
+        writer-unique (pid): two concurrent CLIs writing the same marker
+        must not truncate each other's tmp file mid-write (last rename
+        wins, both markers intact)."""
+        tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+        tmp.write_text(content)
+        tmp.replace(path)
+
     def mark_apply(self, key: str, job_dict: dict) -> None:
         """Leave a cross-process spec-update request (kubectl-apply analog):
         the owning supervisor applies it (it may need to restart the world)."""
@@ -182,12 +213,7 @@ class JobStore:
             return
         import json as _json
 
-        # tmp-write + rename (the _persist pattern): the daemon polls and
-        # claims markers by rename — it must never see a half-written one.
-        path = self._marker_path(key, "apply")
-        tmp = path.with_name(path.name + ".tmp")
-        tmp.write_text(_json.dumps(job_dict))
-        tmp.replace(path)
+        self._atomic_write(self._marker_path(key, "apply"), _json.dumps(job_dict))
 
     def take_apply_markers(self) -> List[tuple]:
         """Atomically claim pending apply requests: (key, job_dict).
@@ -216,7 +242,12 @@ class JobStore:
         """Leave a cross-process suspend/resume request."""
         if self.persist_dir is None:
             return
-        self._marker_path(key, "suspend").write_text("1" if suspend else "0")
+        # Atomic like mark_apply: the daemon's rename-claim must never
+        # observe a just-created empty file ('' would otherwise be
+        # silently read as resume).
+        self._atomic_write(
+            self._marker_path(key, "suspend"), "1" if suspend else "0"
+        )
 
     def take_suspend_markers(self) -> List[tuple]:
         """Atomically claim pending suspend/resume requests: (key, bool).
@@ -231,9 +262,12 @@ class JobStore:
             except OSError:
                 continue
             try:
-                flag = claimed.read_text().strip() == "1"
+                content = claimed.read_text().strip()
             except OSError:
-                flag = None
+                content = None
+            # Content outside {'0','1'} is a torn/invalid request — skip it
+            # rather than mapping it to False (a silent resume).
+            flag = {"0": False, "1": True}.get(content)
             claimed.unlink(missing_ok=True)
             if flag is not None:
                 out.append((p.stem.replace("_", "/", 1), flag))
@@ -243,7 +277,9 @@ class JobStore:
         """Leave a cross-process elastic resize request."""
         if self.persist_dir is None:
             return
-        self._marker_path(key, "scale").write_text(str(workers))
+        # Atomic: a rename-claim racing a plain write_text would read a
+        # torn marker and drop the resize request.
+        self._atomic_write(self._marker_path(key, "scale"), str(workers))
 
     def take_scale_markers(self) -> List[tuple]:
         """Atomically claim pending elastic resize requests: (key, workers).

@@ -118,8 +118,9 @@ class Supervisor:
             job = self.store.get(key)
             if job is None:
                 return False
-            for h in self.runner.list_for_job(key):
-                self.runner.delete(h.name)
+            self.runner.delete_many(
+                [h.name for h in self.runner.list_for_job(key)]
+            )
             self.gang.delete_group(key)
             self.expectations.delete_expectations(key)
             self.store.delete(key)
@@ -151,8 +152,9 @@ class Supervisor:
                 # Fresh incarnation: the old record (and its terminal
                 # status) is replaced; checkpoints/artifacts survive, as
                 # on resubmission.
-                for h in self.runner.list_for_job(key):
-                    self.runner.delete(h.name)
+                self.runner.delete_many(
+                    [h.name for h in self.runner.list_for_job(key)]
+                )
                 self.store.delete(key)
                 self.events.normal(
                     key, "TPUJobReplaced", "finished job replaced by apply."

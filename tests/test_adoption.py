@@ -236,6 +236,48 @@ class TestAdoptionSafety:
         assert _wait(lambda: not r._group_members_alive(h.pid), timeout=5.0)
         a.shutdown()
 
+    def test_delete_many_shares_one_escalation_across_mixed_batch(self, tmp_path):
+        """delete_many must tear down a batch mixing every replica kind —
+        a live TERM-trapping wrapper, an adopted replica, and a dead-wrapper
+        survivor group — within ONE shared grace budget (~grace+2s total,
+        not per replica), and clean up every record."""
+        import pytorch_operator_tpu.controller.runner as r
+
+        a = SubprocessRunner(tmp_path)
+        t = ProcessTemplate(command=["sh", "-c", "trap '' TERM; sleep 30"])
+        live = a.create(KEY, ReplicaType.MASTER, 0, t, {})
+        adopted_src = a.create(KEY, ReplicaType.WORKER, 0, t, {})
+        orphan = a.create(KEY, ReplicaType.WORKER, 1, t, {})
+        time.sleep(0.3)  # let the traps install
+        os.kill(orphan.pid, signal.SIGKILL)  # wrapper only; group survives
+        assert _wait(lambda: _pid_gone_or_zombie(orphan.pid))
+
+        b = SubprocessRunner(tmp_path)  # adopts all three
+        assert b.get(adopted_src.name).phase == ReplicaPhase.RUNNING
+        # Delete from the ADOPTING runner for worker-0 (adopted path) but
+        # from the SPAWNING runner for the rest: a covers live-Popen and
+        # dead-wrapper-survivor paths, b covers the adopted path.
+        t0 = time.time()
+        b.delete_many([adopted_src.name], grace_seconds=0.5)
+        a.delete_many([live.name, orphan.name], grace_seconds=0.5)
+        elapsed = time.time() - t0
+        # Shared escalation: two batches, each ≤ grace(0.5)+2s + scan slop.
+        assert elapsed < 8.0
+        for h in (live, adopted_src, orphan):
+            assert _wait(
+                lambda h=h: not r._group_members_alive(h.pid), timeout=5.0
+            )
+        # The deleting runner forgets everything it tore down (the spawner
+        # may keep a stale Popen record for a replica another incarnation
+        # deleted — that is pre-existing adoption semantics, not a leak).
+        assert live.name not in a._procs and orphan.name not in a._procs
+        assert not a._adopted and adopted_src.name not in b._adopted
+        assert not b._procs
+        assert a.get(live.name) is None and a.get(orphan.name) is None
+        assert b.get(adopted_src.name) is None
+        a.shutdown()
+        b.shutdown()
+
     def test_exit_file_wins_over_lingering_group_member(self, tmp_path):
         """A replica whose MAIN process exited (wrapper wrote the exit
         file) is done, even if a stray background child keeps the process

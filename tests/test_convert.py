@@ -223,6 +223,75 @@ spec:
             == "DB_PASS,API_KEY"
         )
 
+    def test_dropped_pod_fields_and_resources_surfaced(self):
+        """nodeSelector/tolerations/volumes/initContainers/affinity and
+        non-TPU resource limits must land in converted-* annotations, not
+        vanish (the module docstring's 'surfaced, not silently dropped')."""
+        job = loads_job(
+            """
+kind: PyTorchJob
+metadata: {name: podfields}
+spec:
+  pytorchReplicaSpecs:
+    Master:
+      template:
+        spec:
+          nodeSelector: {cloud.google.com/gke-tpu-topology: 2x2}
+          tolerations: [{key: tpu, operator: Exists}]
+          volumes: [{name: data, emptyDir: {}}]
+          affinity: {nodeAffinity: {}}
+          initContainers:
+            - name: wait-for-master
+              command: [sh, -c, "until nslookup $MASTER_ADDR; do sleep 1; done"]
+          containers:
+            - name: pytorch
+              command: [sh, -c, "exit 0"]
+              resources:
+                limits: {google.com/tpu: 4, cpu: "8", memory: 16Gi}
+"""
+        )
+        ann = job.metadata.annotations
+        dropped = ann["tpujob.dev/converted-dropped-master"].split(",")
+        for k in (
+            "nodeSelector",
+            "tolerations",
+            "volumes",
+            "affinity",
+            "initContainers",
+        ):
+            assert k in dropped
+        assert (
+            ann["tpujob.dev/converted-init-containers-master"]
+            == "wait-for-master"
+        )
+        assert (
+            ann["tpujob.dev/converted-resources-dropped-master"]
+            == "cpu,memory"
+        )
+
+    def test_sidecar_commands_surfaced(self):
+        job = loads_job(
+            """
+kind: PyTorchJob
+metadata: {name: sidecars}
+spec:
+  pytorchReplicaSpecs:
+    Master:
+      template:
+        spec:
+          containers:
+            - name: pytorch
+              command: [sh, -c, "exit 0"]
+            - name: tensorboard
+              command: [tensorboard, --logdir, /logs]
+            - name: proxy
+"""
+        )
+        assert (
+            job.metadata.annotations["tpujob.dev/converted-sidecars-master"]
+            == "tensorboard=tensorboard --logdir /logs;proxy=<image entrypoint>"
+        )
+
     def test_master_port_wins_over_worker(self):
         job = loads_job(
             """

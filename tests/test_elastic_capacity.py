@@ -52,6 +52,27 @@ class TestElasticShrink:
         job = sup.get(key)
         assert job.metadata.annotations[ELASTIC_TARGET_ANNOTATION] == "3"
 
+    def test_shrunk_launch_with_worker_first_spec_order(self):
+        """Elastic shrink arithmetic (`workers.replicas = n_admit - 1`)
+        assumes the Master heads the admitted prefix; a Worker-first spec
+        order must not launch a masterless world or miscount workers."""
+        sup = make_sup(capacity=2)
+        job = elastic_job(workers=3)
+        specs = job.spec.replica_specs
+        job.spec.replica_specs = {
+            ReplicaType.WORKER: specs[ReplicaType.WORKER],
+            ReplicaType.MASTER: specs[ReplicaType.MASTER],
+        }
+        key = sup.submit(job)
+        sup.sync_once()
+        handles = sup.runner.list_for_job(key)
+        assert len(handles) == 2  # master + 1 worker
+        assert (
+            sup.runner.get(replica_name(key, ReplicaType.MASTER, 0)) is not None
+        )
+        env = sup.runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
+        assert env["TPUJOB_NUM_PROCESSES"] == "2"
+
     def test_below_min_replicas_holds(self):
         sup = make_sup(capacity=2)
         key = sup.submit(elastic_job(workers=4, min_replicas=3))  # floor 4 > 2

@@ -91,6 +91,25 @@ class TestMinAvailable:
         assert len(sup.runner.list_for_job(key)) == 0
         assert any(e.reason == "Unschedulable" for e in sup.events.for_job(key))
 
+    def test_master_admitted_first_regardless_of_spec_order(self, tmp_path):
+        """replica_specs preserves user YAML key order; a spec listing
+        Worker before Master must still put the Master in the admitted
+        prefix — a worker-only partial world blocks at rendezvous forever."""
+        sup = make_sup(capacity=2)
+        job = new_job(name="wfirst", workers=2)  # total 3
+        specs = job.spec.replica_specs
+        job.spec.replica_specs = {
+            ReplicaType.WORKER: specs[ReplicaType.WORKER],
+            ReplicaType.MASTER: specs[ReplicaType.MASTER],
+        }
+        job.spec.run_policy.scheduling_policy.min_available = 2
+        key = sup.submit(job)
+        sup.sync_once()
+        assert len(sup.runner.list_for_job(key)) == 2
+        assert (
+            sup.runner.get(replica_name(key, ReplicaType.MASTER, 0)) is not None
+        )
+
     def test_gang_disabled_per_job_admits_piecewise(self, tmp_path):
         sup = make_sup(capacity=1)
         job = new_job(name="piecewise", workers=2)  # total 3 > capacity
