@@ -1,0 +1,44 @@
+"""Schedule-to-first-step latency probe.
+
+The second north-star metric (BASELINE.json:2) is submit-accepted →
+first training step executed. This workload is the minimal honest
+version of "a training step": spawn under the real supervisor, bring up
+the JAX backend on the device the supervisor assigned, jit ONE tiny
+step, execute it, and report the first step through the same status
+channel every real workload uses (``rendezvous.report_first_step``).
+
+Kept tiny and fixed-shape on purpose: the jit's cache key must be
+stable so a warm resubmit (supervisor-injected compile cache) isolates
+the supervisor + process-spawn + backend-init cost from XLA compile
+time — the cold/warm split bench.py reports.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..runtime import rendezvous
+
+
+def main() -> int:
+    world = rendezvous.initialize_from_env()
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    float(jax.device_get(step(x)))
+    rendezvous.report_first_step(0)
+    print(
+        f"[latency-probe] rank {world.process_id}/{world.num_processes} "
+        f"first step done on {jax.devices()[0].platform}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

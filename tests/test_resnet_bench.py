@@ -87,9 +87,27 @@ class TestBench:
         import bench
 
         result = bench.run(["--smoke", "--steps", "2", "--warmup", "1"])
-        assert set(result) == {"metric", "value", "unit", "vs_baseline"}
+        assert set(result) == {
+            "metric",
+            "value",
+            "unit",
+            "vs_baseline",
+            "schedule_to_first_step_s",
+        }
         assert result["value"] > 0
         assert result["unit"] == "images/sec/chip"
+        # The latency probe runs REAL supervisor jobs even in smoke mode;
+        # both phases must come back measured, not None.
+        lat = result["schedule_to_first_step_s"]
+        assert lat["cold"] > 0 and lat["warm"] > 0
+
+    def test_bench_smoke_no_latency_flag(self):
+        import bench
+
+        result = bench.run(
+            ["--smoke", "--steps", "2", "--warmup", "1", "--no-latency"]
+        )
+        assert set(result) == {"metric", "value", "unit", "vs_baseline"}
 
 
 class TestDataFileMode:
