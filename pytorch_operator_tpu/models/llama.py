@@ -81,6 +81,28 @@ def llama3_8b(**over) -> LlamaConfig:
     return LlamaConfig(**{"attn_impl": "flash", "xent_impl": "chunked", **over})
 
 
+def llama_0_3b(**over) -> LlamaConfig:
+    """~0.32B-parameter Llama shape for single-chip benchmarking: the
+    largest config that trains comfortably on one v5e chip at long
+    sequence lengths. Same architecture and kernel defaults as
+    :func:`llama3_8b` (flash attention — head_dim stays 128, the kernel's
+    lane width — and chunked-vocab loss); the BASELINE.md "0.33B llama
+    variant" rows use this config.
+    """
+    return llama3_8b(
+        **{
+            "vocab_size": 32000,
+            "d_model": 1024,
+            "n_layers": 16,
+            "n_heads": 8,
+            "n_kv_heads": 4,
+            "head_dim": 128,
+            "d_ff": 4096,
+            **over,
+        }
+    )
+
+
 def llama_tiny(**over) -> LlamaConfig:
     """Scaled-down config for tests/dryruns: same architecture, tiny dims."""
     base = dict(
@@ -375,3 +397,103 @@ class Llama(nn.Module):
                 lm_head(x[:, :1])
             return x
         return lm_head(x)
+
+    @nn.nowrap
+    def pp_forward(self, params, tokens, *, mesh, microbatches, return_hidden=False):
+        """Model-owned pipeline-parallel forward (the hook
+        make_lm_train_step calls when the mesh has a pp axis — keeps
+        llama param naming out of shared trainer infrastructure, like
+        ``head_kernel``). ``nn.nowrap``: this is plain orchestration, not
+        a scoped module method — wrapping would make the in-function
+        ``Block``/``RMSNorm`` constructions claim ``self`` as parent."""
+        return forward_pp(
+            self, params, tokens,
+            mesh=mesh, microbatches=microbatches, return_hidden=return_hidden,
+        )
+
+
+def forward_pp(
+    model: "Llama",
+    params,
+    tokens,
+    *,
+    mesh,
+    microbatches: int,
+    return_hidden: bool = False,
+):
+    """Pipeline-parallel forward: the layer stack runs through
+    ``parallel.pipeline.pipeline_apply`` over the mesh's ``pp`` axis,
+    numerically identical to ``model.apply`` (same params, same order).
+
+    The scan-stacked layer params (leading axis n_layers) regroup into
+    P stages of n_layers/P consecutive layers; embed / final norm / LM
+    head run outside the pipeline under the surrounding pjit (their
+    FLOPs are a sliver of the stack's, and keeping them SPMD avoids
+    first/last-stage special cases). ``cfg.remat`` applies per layer
+    inside each stage. Composes with dp/fsdp on the same mesh —
+    pipeline_apply takes manual control of pp only.
+
+    Constraints: ``cfg.n_layers % pp == 0``; ring attention (sp) cannot
+    nest inside the pp pipeline.
+    """
+    import jax
+
+    from ..parallel.pipeline import pipeline_apply
+
+    cfg = model.cfg
+    n_stages = mesh.shape["pp"]
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={n_stages}"
+        )
+    if cfg.attn_impl == "ring":
+        raise ValueError("attn_impl='ring' cannot run inside the pp pipeline")
+    p = nn.meta.unbox(params)
+
+    # Embedding lookup, matching nn.Embed(dtype=cfg.dtype) semantics
+    # (table cast to the compute dtype, then take).
+    x = p["embed"]["embedding"].astype(cfg.dtype)[tokens]
+
+    layers = p["layers"]
+    stage_params = jax.tree.map(
+        lambda l: l.reshape((n_stages, cfg.n_layers // n_stages) + l.shape[1:]),
+        layers,
+    )
+    # Blocks inside the pipeline get mesh=None: pp is already manual in
+    # pipeline_apply, and the remaining axes (dp/fsdp) are compiler-
+    # propagated — the block needs no mesh consultation (ring is the one
+    # mesh consumer, rejected above; flash runs unwrapped).
+    block = Block(cfg, None)
+
+    def stage(sp, act):
+        pos = jnp.broadcast_to(
+            jnp.arange(act.shape[1], dtype=jnp.int32), act.shape[:2]
+        )
+
+        def layer(carry, lp):
+            # Logical-axis rules off inside the pipeline: pp is manual
+            # here, so flax's constraint/unbox machinery would try to
+            # bind logical names against a Manual-axis mesh and reject;
+            # the remaining axes (dp/fsdp) propagate through shard_map's
+            # auto mode without annotations.
+            with nn.logical_axis_rules(()):
+                out, _ = block.apply({"params": lp}, carry, None)
+            return out, None
+
+        if cfg.remat:
+            layer = jax.checkpoint(layer, prevent_cse=False)
+        (act_out, _pos), _ = jax.lax.scan(layer, (act, pos), sp)
+        return act_out
+
+    x = pipeline_apply(
+        stage, stage_params, x, mesh=mesh, microbatches=microbatches
+    )
+
+    x = RMSNorm(cfg.rms_eps, name="final_norm").apply(
+        {"params": p["final_norm"]}, x
+    )
+    if return_hidden:
+        return x
+    # DenseGeneral(dtype=float32) semantics: promote input and kernel.
+    w = p["lm_head"]["kernel"]
+    return x.astype(jnp.float32) @ w.astype(jnp.float32)

@@ -39,6 +39,7 @@ def synthetic_bigram_batch(batch: int, seq_len: int, vocab: int, step: int):
 
 CONFIGS = {
     "8b": "llama3_8b",
+    "0.3b": "llama_0_3b",
     "tiny": "llama_tiny",
 }
 
@@ -60,6 +61,7 @@ def run(
     xent_impl: str | None = None,
     n_experts: int | None = None,
     moe_top_k: int | None = None,
+    pp_microbatches: int | None = None,
     preempt_at: int | None = None,
     profile_dir: str | None = None,
     log=print,
@@ -122,7 +124,7 @@ def run(
     n_params = sum(p.size for p in jax.tree.leaves(state["params"]))
     log(f"[llama] {n_params/1e6:.1f}M params, sharded init +{time.time()-t_init:.1f}s")
 
-    train_step = make_lm_train_step(model, tx, mesh)
+    train_step = make_lm_train_step(model, tx, mesh, microbatches=pp_microbatches)
     batch_sharding = named_sharding(mesh, "batch", "seq")
 
     # Fault injection (SURVEY.md §5 "fault injection = kill a worker
@@ -252,6 +254,11 @@ def main(argv=None) -> int:
         help="experts routed per token (default 2); must be <= --experts",
     )
     p.add_argument(
+        "--pp-microbatches", type=int, default=None,
+        help="GPipe microbatch count when the mesh has a pp axis "
+        "(default 2 x pp extent; must be a multiple of it)",
+    )
+    p.add_argument(
         "--preempt-at", type=int, default=None,
         help="fault injection: die with a retryable exit code at this step "
         "on the replica's first life (simulated TPU preemption)",
@@ -280,6 +287,7 @@ def main(argv=None) -> int:
         xent_impl=args.xent_impl,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
+        pp_microbatches=args.pp_microbatches,
         preempt_at=args.preempt_at,
         profile_dir=args.profile_dir,
         log=lambda msg: print(

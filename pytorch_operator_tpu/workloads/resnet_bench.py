@@ -326,8 +326,10 @@ def run_benchmark(
             windows = 1
         n_win = max(windows, 1)
         dt = math.inf
-        if not profile_dir:
+        if not profile_dir and n_win > 1:
             # Protocol A: fenced windows, min-time estimator (round 1).
+            # At n_win == 1 the two protocols are the same measurement —
+            # skip A rather than running every step twice.
             for _ in range(n_win):
                 t0 = time.time()
                 for _ in range(steps // chunk):
@@ -353,6 +355,8 @@ def run_benchmark(
         if loader is not None:
             loader.close()
 
+    if not math.isfinite(dt) and not profile_dir:
+        dt = dt_sustained  # n_win == 1: the sustained window IS the window
     min_window_per_chip = (
         batch * steps / dt / n_dev if math.isfinite(dt) else None
     )

@@ -55,7 +55,7 @@ def init_sharded_train_state(model_init: Callable, tx, mesh):
     return init_sharded(init_state, mesh, jax.random.key(int(os.environ.get("TPUJOB_SEED", "0"))))
 
 
-def make_lm_train_step(model, tx, mesh):
+def make_lm_train_step(model, tx, mesh, microbatches=None):
     """Next-token cross-entropy train step, jitted WITHOUT state donation.
 
     Keep it donation-free: async checkpointing (llama_train
@@ -67,6 +67,12 @@ def make_lm_train_step(model, tx, mesh):
     When the model config sets ``xent_impl="chunked"``, the LM head matmul
     is fused into the loss via ops/chunked_xent.py — the model returns
     hidden states and no [B,S,V] logits tensor ever exists.
+
+    When the mesh has a ``pp`` axis of extent > 1, the layer stack runs
+    through the GPipe pipeline (models.llama.forward_pp) with
+    ``microbatches`` microbatches (default 2 x pp extent) — numerically
+    identical to the sequential forward, and composing with dp/fsdp on
+    the same mesh.
     """
     import jax
     import optax
@@ -74,19 +80,37 @@ def make_lm_train_step(model, tx, mesh):
     from ..parallel import activation_rules
 
     chunked = getattr(getattr(model, "cfg", None), "xent_impl", "dense") == "chunked"
+    pp = mesh.shape.get("pp", 1) > 1
+    if pp:
+        if not hasattr(model, "pp_forward"):
+            raise ValueError(
+                f"mesh has a pp axis but {type(model).__name__} defines no "
+                "pp_forward hook (pipeline layering is model-owned)"
+            )
+        mb = microbatches or 2 * mesh.shape["pp"]
+
+    def forward(params, tokens, return_hidden):
+        if pp:
+            return model.pp_forward(
+                params, tokens,
+                mesh=mesh, microbatches=mb, return_hidden=return_hidden,
+            )
+        if return_hidden:
+            return model.apply({"params": params}, tokens, return_hidden=True)
+        return model.apply({"params": params}, tokens)
 
     def loss_fn(params, tokens):
         if chunked:
             from ..ops.chunked_xent import chunked_softmax_xent
 
             with activation_rules(mesh):
-                hidden = model.apply({"params": params}, tokens, return_hidden=True)
+                hidden = forward(params, tokens, True)
             # Head access goes through the model (it owns its param naming).
             w = model.head_kernel(params)
             h = hidden[:, :-1].reshape(-1, hidden.shape[-1])
             return chunked_softmax_xent(h, w, tokens[:, 1:].reshape(-1)).mean()
         with activation_rules(mesh):
-            logits = model.apply({"params": params}, tokens)
+            logits = forward(params, tokens, False)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1], tokens[:, 1:]
         ).mean()
