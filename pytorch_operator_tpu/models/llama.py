@@ -61,6 +61,13 @@ class LlamaConfig:
     # axis (parallel/moe.py); 0 = dense.
     n_experts: int = 0
     moe_top_k: int = 2
+    # Expert dispatch: "dense" (every device runs its local experts over
+    # all tokens — exact, no drops, FLOPs ∝ local experts) or "sparse"
+    # (GShard capacity-factor dispatch — FLOPs ∝ top_k·capacity_factor,
+    # over-capacity tokens dropped; measured 1.2-1.3x ideal vs dense's
+    # 2.1-4.9x at E=8-32, BASELINE.md). Prefer "sparse" from E >= 16.
+    moe_dispatch: str = "dense"
+    moe_capacity_factor: float = 1.25
 
     @property
     def q_per_kv(self) -> int:
@@ -300,7 +307,22 @@ class MoEMLP(nn.Module):
             "w_out": w_out.astype(cfg.dtype),
         }
         x2d = x.reshape(-1, D)
-        if self.mesh is not None and self.mesh.shape.get("ep", 1) > 1:
+        ep_live = self.mesh is not None and self.mesh.shape.get("ep", 1) > 1
+        if cfg.moe_dispatch not in ("dense", "sparse"):
+            raise ValueError(
+                f"moe_dispatch={cfg.moe_dispatch!r} not in ('dense', 'sparse')"
+            )
+        if cfg.moe_dispatch == "sparse":
+            from ..parallel.moe import moe_mlp_sparse
+
+            out = moe_mlp_sparse(
+                params,
+                x2d,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                mesh=self.mesh if ep_live else None,
+            )
+        elif ep_live:
             out = moe_mlp(params, x2d, mesh=self.mesh, top_k=cfg.moe_top_k)
         else:
             out = moe_mlp_reference(params, x2d, top_k=cfg.moe_top_k)

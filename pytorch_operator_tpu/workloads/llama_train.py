@@ -61,6 +61,8 @@ def run(
     xent_impl: str | None = None,
     n_experts: int | None = None,
     moe_top_k: int | None = None,
+    moe_dispatch: str | None = None,
+    moe_capacity_factor: float | None = None,
     pp_microbatches: int | None = None,
     preempt_at: int | None = None,
     profile_dir: str | None = None,
@@ -86,6 +88,14 @@ def run(
         over["n_experts"] = n_experts
     if moe_top_k is not None:
         over["moe_top_k"] = moe_top_k
+    if moe_dispatch is not None:
+        if moe_dispatch not in ("dense", "sparse"):
+            raise ValueError(
+                f"moe_dispatch={moe_dispatch!r} not in ('dense', 'sparse')"
+            )
+        over["moe_dispatch"] = moe_dispatch
+    if moe_capacity_factor is not None:
+        over["moe_capacity_factor"] = moe_capacity_factor
     cfg = getattr(llama_lib, CONFIGS[config])(**over)
     # Validate the routing config up front — otherwise a bad top_k only
     # surfaces as a ValueError deep inside model tracing.
@@ -254,6 +264,19 @@ def main(argv=None) -> int:
         help="experts routed per token (default 2); must be <= --experts",
     )
     p.add_argument(
+        "--moe-dispatch", choices=("dense", "sparse"), default=None,
+        dest="moe_dispatch",
+        help="expert dispatch: dense (exact, FLOPs scale with experts) or "
+        "sparse (capacity-factor GShard dispatch, FLOPs scale with top_k; "
+        "over-capacity tokens dropped — prefer from 16 experts up)",
+    )
+    p.add_argument(
+        "--moe-capacity-factor", type=float, default=None,
+        dest="moe_capacity_factor",
+        help="sparse dispatch per-expert capacity multiplier (default "
+        "1.25); higher drops fewer tokens, costs more FLOPs",
+    )
+    p.add_argument(
         "--pp-microbatches", type=int, default=None,
         help="GPipe microbatch count when the mesh has a pp axis "
         "(default 2 x pp extent; must be a multiple of it)",
@@ -287,6 +310,8 @@ def main(argv=None) -> int:
         xent_impl=args.xent_impl,
         n_experts=args.n_experts,
         moe_top_k=args.moe_top_k,
+        moe_dispatch=args.moe_dispatch,
+        moe_capacity_factor=args.moe_capacity_factor,
         pp_microbatches=args.pp_microbatches,
         preempt_at=args.preempt_at,
         profile_dir=args.profile_dir,
