@@ -96,6 +96,20 @@ class Supervisor:
         """
         set_defaults(job)
         validate(job)
+        key = job_key(job)
+        # A previous incarnation deleted cross-process (`tpujob delete`
+        # with no daemon running) removes the STORE record immediately but
+        # leaves replica records/processes — and the marker — for the
+        # consumer, which may be this very supervisor. Reap stale state
+        # through the canonical teardown before accepting the new
+        # incarnation: adopting a stale finished master's exit record
+        # would complete the new job without ever running it. The marker
+        # clear is unconditional: a surviving marker would make a later
+        # daemon delete the NEW incarnation mid-run.
+        if self.store.get(key) is None:
+            if self.runner.list_for_job(key):
+                self.delete_job(key)
+            self.store.clear_deletion_marker(key)
         key = self.store.add(job)
         self.events.normal(key, "TPUJobSubmitted", f"TPUJob {key} accepted.")
         return key
@@ -116,21 +130,25 @@ class Supervisor:
         # interleaves with a reconcile pass would race replica creation.
         with self.reconciler.key_lock(key):
             job = self.store.get(key)
-            if job is None:
-                return False
+            # Replica processes/records can outlive the store record (a
+            # cross-process `tpujob delete` removes the record up front
+            # and leaves the reaping to the marker consumer) — the full
+            # teardown runs regardless, so the daemon's marker-driven
+            # delete can't leak events/locks/gang state per key.
             self.runner.delete_many(
                 [h.name for h in self.runner.list_for_job(key)]
             )
             self.gang.delete_group(key)
             self.expectations.delete_expectations(key)
-            self.store.delete(key)
+            if job is not None:
+                self.store.delete(key)
             self.events.drop_job(key)
             if purge_artifacts:
                 purge_job_artifacts(self.state_dir, key)
         # Job record gone → retire its reconcile lock (a daemon with high
         # job churn would otherwise leak one Lock per key ever seen).
         self.reconciler.drop_key_lock(key)
-        return True
+        return job is not None
 
     def apply(self, job: TPUJob) -> str:
         """kubectl-apply semantics: create the job if absent, update the

@@ -81,9 +81,20 @@ def main(argv=None) -> int:
         return 1
 
     model = DigitCNN(dtype=jnp.bfloat16)
-    params = model.init(jax.random.key(args.seed), jnp.zeros((1, 8, 8, 1)))
     tx = optax.adam(args.lr)
-    opt_state = tx.init(params)
+
+    # ONE jitted init for params + optimizer state: eager flax init would
+    # dispatch dozens of tiny ops, each a separate compile RPC on remote
+    # PJRT tunnels (measured: the bulk of this example's ~37s cold
+    # schedule-to-first-step, BASELINE.md) — and their cache keys were
+    # unstable run to run, defeating the persistent compile cache. A
+    # single fused init compiles once and caches stably.
+    @jax.jit
+    def make_state(key):
+        params = model.init(key, jnp.zeros((1, 8, 8, 1)))
+        return params, tx.init(params)
+
+    params, opt_state = make_state(jax.random.key(args.seed))
 
     # Replicated params/opt-state, dp-sharded batch: XLA derives the
     # gradient psum from the shardings (DDP-allreduce analog).

@@ -21,6 +21,7 @@ from pytorch_operator_tpu.controller import (
     Supervisor,
     schedule_to_first_step_latency,
 )
+from pytorch_operator_tpu.controller.runner import replica_name
 from tests.testutil import new_job
 
 
@@ -40,6 +41,47 @@ class TestSubprocessE2E:
         lat = schedule_to_first_step_latency(done)
         assert lat is not None and 0 <= lat < 30
         sup.shutdown()
+
+    def test_resubmit_after_cross_process_delete_actually_runs(self, tmp_path):
+        """`tpujob delete` with no daemon running removes the STORE record
+        and leaves replica records for the marker consumer. A fresh
+        supervisor resubmitting the same job must reap those stale
+        records, not adopt the old master's exit file and declare the new
+        job Succeeded without running anything (round-2 regression)."""
+        import time as _time
+
+        sup = make_supervisor(tmp_path)
+        job = new_job(name="re-run", workers=0)
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            command=["sh", "-c", "sleep 0.2; exit 0"]
+        )
+        key = None
+        try:
+            done = sup.run(job, timeout=30)
+            key = "default/re-run"
+            assert done.is_succeeded()
+            # CLI-style cross-process delete: marker + record removal only.
+            sup.store.mark_deletion(key)
+            sup.store.delete(key)
+        finally:
+            sup.shutdown()
+
+        sup2 = make_supervisor(tmp_path)
+        try:
+            t0 = _time.time()
+            job2 = new_job(name="re-run", workers=0)
+            job2.spec.replica_specs[ReplicaType.MASTER].template = (
+                ProcessTemplate(command=["sh", "-c", "sleep 0.2; exit 0"])
+            )
+            done2 = sup2.run(job2, timeout=30)
+            assert done2.is_succeeded()
+            h = sup2.runner.get(replica_name(key, ReplicaType.MASTER, 0))
+            assert h is not None and h.created_at >= t0, (
+                "new incarnation adopted the deleted run's stale record "
+                "instead of actually running"
+            )
+        finally:
+            sup2.shutdown()
 
     def test_failing_job_backoff(self, tmp_path):
         sup = make_supervisor(tmp_path)
