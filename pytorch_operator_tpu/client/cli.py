@@ -483,7 +483,7 @@ def cmd_delete(args) -> int:
     # AFTER killing the replicas (else a live workload's next checkpoint
     # save would re-create the dir behind the purge). The immediate purge
     # below covers the daemon-less case (no replicas running).
-    store.mark_deletion(key, purge=args.purge)
+    store.mark_deletion(key, purge=args.purge, uid=job.metadata.uid or "")
     store.delete(key)
     if args.purge:
         purge_job_artifacts(state, key)

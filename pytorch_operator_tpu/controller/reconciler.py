@@ -361,11 +361,13 @@ class Reconciler:
 
     # ---- the core sync ----
 
-    def key_lock(self, key: str) -> threading.Lock:
+    def key_lock(self, key: str) -> threading.RLock:
         """The per-key mutex; also taken by supervisor delete/scale so a
-        teardown can't interleave with an in-flight sync of the same job."""
+        teardown can't interleave with an in-flight sync of the same job.
+        Reentrant: supervisor flows nest it (apply → submit → stale-reap
+        delete_job all guard the same key)."""
         with self._key_locks_guard:
-            return self._key_locks.setdefault(key, threading.Lock())
+            return self._key_locks.setdefault(key, threading.RLock())
 
     def drop_key_lock(self, key: str) -> None:
         """Retire a deleted job's lock. Benign if the key reappears: the

@@ -160,15 +160,22 @@ class JobStore:
     def _marker_path(self, key: str, kind: str) -> Path:
         return self.persist_dir / (key.replace("/", "_") + "." + kind)
 
-    def mark_deletion(self, key: str, purge: bool = False) -> None:
-        """Leave a cross-process deletion request for the owning supervisor."""
+    def mark_deletion(self, key: str, purge: bool = False, uid: str = "") -> None:
+        """Leave a cross-process deletion request for the owning supervisor.
+
+        ``uid`` pins the request to the job INCARNATION being deleted: a
+        consumer must ignore the marker if the stored job's uid differs
+        (a new incarnation was submitted after the delete — killing it
+        would act on a job the user never asked to remove).
+        """
         if self.persist_dir is None:
             return
-        # Atomic: the daemon checks existence first, then reads the purge
-        # flag — a plain write_text would expose a just-created empty file
-        # (purge silently read as False).
+        # Atomic: the daemon checks existence first, then reads the
+        # content — a plain write_text would expose a just-created empty
+        # file (purge silently read as False).
         self._atomic_write(
-            self._marker_path(key, "delete"), "purge" if purge else ""
+            self._marker_path(key, "delete"),
+            json.dumps({"purge": purge, "uid": uid}),
         )
 
     def deletion_markers(self) -> List[str]:
@@ -180,15 +187,29 @@ class JobStore:
             keys.append(p.stem.replace("_", "/", 1))
         return keys
 
+    def _read_deletion_marker(self, key: str) -> dict:
+        if self.persist_dir is None:
+            return {}
+        p = self._marker_path(key, "delete")
+        try:
+            content = p.read_text()
+        except OSError:
+            return {}
+        try:
+            rec = json.loads(content)
+            return rec if isinstance(rec, dict) else {}
+        except ValueError:
+            # Legacy format: bare "purge"/"" string.
+            return {"purge": "purge" in content, "uid": ""}
+
     def marker_requests_purge(self, key: str) -> bool:
         """Whether the pending deletion marker asks for an artifact purge."""
-        if self.persist_dir is None:
-            return False
-        p = self.persist_dir / (key.replace("/", "_") + ".delete")
-        try:
-            return "purge" in p.read_text()
-        except OSError:
-            return False
+        return bool(self._read_deletion_marker(key).get("purge"))
+
+    def marker_uid(self, key: str) -> str:
+        """The uid of the incarnation the deletion marker targets ('' =
+        unpinned legacy marker)."""
+        return str(self._read_deletion_marker(key).get("uid") or "")
 
     def clear_deletion_marker(self, key: str) -> None:
         if self.persist_dir is None:

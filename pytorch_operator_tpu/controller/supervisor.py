@@ -108,7 +108,12 @@ class Supervisor:
         # daemon delete the NEW incarnation mid-run.
         if self.store.get(key) is None:
             if self.runner.list_for_job(key):
-                self.delete_job(key)
+                # Honor the orphaned marker's purge request (the user's
+                # `delete --purge` must not leave a checkpoint the new
+                # incarnation silently resumes from).
+                self.delete_job(
+                    key, purge_artifacts=self.store.marker_requests_purge(key)
+                )
             self.store.clear_deletion_marker(key)
         key = self.store.add(job)
         self.events.normal(key, "TPUJobSubmitted", f"TPUJob {key} accepted.")
@@ -439,12 +444,20 @@ class Supervisor:
         """Act on cross-process ``tpujob delete`` requests: this process owns
         the replica processes, so it performs the kill + record removal."""
         for key in self.store.deletion_markers():
-            # Read the purge request BEFORE acting; purge happens after the
-            # replicas are dead, so a running workload can't re-create the
-            # checkpoint dir behind the purge.
-            purge = self.store.marker_requests_purge(key)
-            self.delete_job(key, purge_artifacts=purge)
-            self.store.clear_deletion_marker(key)
+            with self.reconciler.key_lock(key):
+                # Read the purge request BEFORE acting; purge happens after
+                # the replicas are dead, so a running workload can't
+                # re-create the checkpoint dir behind the purge.
+                purge = self.store.marker_requests_purge(key)
+                uid = self.store.marker_uid(key)
+                cur = self.store.get(key)
+                if cur is not None and uid and cur.metadata.uid != uid:
+                    # The marker targets a PREVIOUS incarnation that a
+                    # resubmit already reaped — never kill the new job.
+                    self.store.clear_deletion_marker(key)
+                    continue
+                self.delete_job(key, purge_artifacts=purge)
+                self.store.clear_deletion_marker(key)
 
     def process_suspend_markers(self) -> None:
         """Act on cross-process ``tpujob suspend``/``resume`` requests."""

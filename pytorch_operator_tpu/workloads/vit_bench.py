@@ -1,0 +1,228 @@
+"""ViT training throughput benchmark + workload.
+
+Companion to resnet_bench (same measurement protocols: chunked
+single-dispatch steps, fenced-min + sustained windows, device_get
+fence) for the transformer vision family — the architecture that
+actually saturates the MXU (no batch-norm HBM reduce traffic;
+BASELINE.md records the measured MFU gap vs ResNet-50).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+
+from ..runtime import rendezvous
+
+
+def make_train_chunk(model, tx, chunk: int, label_smoothing: float = 0.1):
+    """``chunk`` AdamW train steps fused into ONE dispatch (donated state)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def step(params, opt_state, bx, by):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, bx)
+            labels = optax.smooth_labels(
+                jax.nn.one_hot(by, logits.shape[-1]), label_smoothing
+            )
+            return optax.softmax_cross_entropy(logits, labels).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_chunk(params, opt_state, bx, by):
+        def body(_, s):
+            params, opt_state, _loss = s
+            return step(params, opt_state, bx, by)
+
+        return jax.lax.fori_loop(
+            0, chunk, body, (params, opt_state, jnp.zeros((), jnp.float32))
+        )
+
+    return train_chunk
+
+
+def run_benchmark(
+    *,
+    variant: str = "b16",
+    batch_size: int = 128,
+    image_size: int = 224,
+    classes: int = 1000,
+    steps: int = 30,
+    warmup: int = 5,
+    lr: float = 1e-3,
+    windows: int = 1,
+    attn_impl: str = "dense",
+    profile_dir: str | None = None,
+    log=print,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..models import vit as vit_lib
+    from ..parallel import make_mesh
+    from ..parallel.data import global_batch
+    from .datasets import synthetic_images
+
+    cfg = vit_lib.BY_NAME[variant](
+        image_size=image_size, num_classes=classes, attn_impl=attn_impl
+    )
+    model = vit_lib.ViT(cfg)
+    n_dev = jax.device_count()
+    mesh = make_mesh({"dp": n_dev})
+    batch = max(batch_size // n_dev, 1) * n_dev
+    log(
+        f"[vit] ViT-{variant} d={cfg.d_model} depth={cfg.depth} on {n_dev} "
+        f"device(s) ({jax.devices()[0].platform}), global batch {batch}, "
+        f"{image_size}px, attn={attn_impl} (synthetic)"
+    )
+
+    tx = optax.adamw(lr, weight_decay=0.05)
+
+    # ONE fused init jit (params + opt state): stable cache key, no
+    # per-op tunnel compile RPCs (the mnist cold-start lesson).
+    @jax.jit
+    def make_state(key):
+        params = model.init(key, jnp.zeros((1, image_size, image_size, 3)))[
+            "params"
+        ]
+        return params, tx.init(params)
+
+    params, opt_state = jax.tree.map(
+        lambda l: l.unbox() if hasattr(l, "unbox") else l,
+        make_state(jax.random.key(0)),
+        is_leaf=lambda l: hasattr(l, "unbox"),
+    )
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    log(f"[vit] {n_params / 1e6:.1f}M params")
+
+    chunk = min(30, max(steps, 1))
+    steps = math.ceil(max(steps, 1) / chunk) * chunk
+    warm_chunks = max(1, round(max(warmup, 1) / chunk))
+    train_chunk = make_train_chunk(model, tx, chunk)
+    hx, hy = synthetic_images(batch, image_size, image_size, classes)
+    gx = global_batch(hx.astype(jnp.bfloat16), mesh)
+    gy = global_batch(hy, mesh)
+
+    t_start = time.time()
+    for i in range(warm_chunks):
+        params, opt_state, loss = train_chunk(params, opt_state, gx, gy)
+        if i == 0:
+            float(jax.device_get(loss))
+            rendezvous.report_first_step(0)
+            log(f"[vit] first chunk ({chunk} steps, compile) +{time.time() - t_start:.1f}s")
+    float(jax.device_get(loss))
+
+    from .trainer import maybe_profile
+
+    if profile_dir and windows > 1:
+        log("[vit] --profile-dir set: timing a single window")
+        windows = 1
+    n_win = max(windows, 1)
+    dt = math.inf
+    if not profile_dir and n_win > 1:
+        for _ in range(n_win):
+            t0 = time.time()
+            for _ in range(steps // chunk):
+                params, opt_state, loss = train_chunk(params, opt_state, gx, gy)
+            final_loss = float(jax.device_get(loss))
+            dt = min(dt, time.time() - t0)
+    with maybe_profile(profile_dir, lambda m: log(f"[vit] {m}")):
+        # Sustained: depth-1 lookahead — fence window i-1 after
+        # dispatching window i. The device never idles on the fence, but
+        # the dispatch queue stays 1 deep: with donated train state,
+        # deeper queues hold one un-donatable state copy per in-flight
+        # dispatch and thrash HBM (measured 3x slower at depth 5 on
+        # ViT-B, which fills most of the chip).
+        t0 = time.time()
+        prev = None
+        for _ in range(n_win):
+            for _ in range(steps // chunk):
+                params, opt_state, loss = train_chunk(params, opt_state, gx, gy)
+            if prev is not None:
+                float(jax.device_get(prev))
+            prev = loss
+        final_loss = float(jax.device_get(loss))
+        dt_sustained = time.time() - t0
+    if not math.isfinite(dt):
+        dt = dt_sustained
+
+    sustained_steps = steps * n_win
+    images_per_sec = batch * sustained_steps / dt_sustained
+    per_chip = images_per_sec / n_dev
+    min_window = batch * steps / dt / n_dev
+    rendezvous.report_metrics(
+        sustained_steps,
+        images_per_sec=images_per_sec,
+        images_per_sec_per_chip=per_chip,
+    )
+    log(
+        f"[vit] sustained {sustained_steps} steps in {dt_sustained:.2f}s: "
+        f"{per_chip:.1f} images/sec/chip, "
+        f"{1000 * dt_sustained / sustained_steps:.1f} ms/step, "
+        f"loss={final_loss:.3f} (min fenced window: {min_window:.1f})"
+    )
+    return {
+        "metric": f"vit_{variant}_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "min_window_images_per_sec_per_chip": round(min_window, 2),
+        "params_m": round(n_params / 1e6, 1),
+        "global_batch": batch,
+        "devices": n_dev,
+        "final_loss": round(final_loss, 4),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--variant", choices=sorted("s16 b16 l16".split()), default="b16")
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--windows", type=int, default=1)
+    p.add_argument("--attn-impl", choices=("dense", "flash"), default="dense")
+    p.add_argument("--profile-dir", default=None)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    world = rendezvous.initialize_from_env()
+    result = run_benchmark(
+        variant=args.variant,
+        batch_size=args.batch_size,
+        image_size=args.image_size,
+        classes=args.classes,
+        steps=args.steps,
+        warmup=args.warmup,
+        lr=args.lr,
+        windows=args.windows,
+        attn_impl=args.attn_impl,
+        profile_dir=args.profile_dir,
+        log=lambda msg: print(
+            f"[rank {world.process_id}/{world.num_processes}] {msg}"
+            if world.num_processes > 1
+            else msg,
+            flush=True,
+        ),
+    )
+    if args.json and world.process_id == 0:
+        print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,65 @@ class TestSubprocessE2E:
         finally:
             sup2.shutdown()
 
+    def test_apply_after_cross_process_delete_does_not_deadlock(self, tmp_path):
+        """apply() holds the per-key lock and calls submit(), whose
+        stale-incarnation reap calls delete_job() — which re-acquires the
+        same key lock. With a non-reentrant lock this deadlocked; the
+        RLock must let the nested teardown proceed."""
+        import threading
+
+        sup = make_supervisor(tmp_path)
+        tmpl = ProcessTemplate(command=["sh", "-c", "sleep 0.2; exit 0"])
+        job = new_job(name="ap-re", workers=0)
+        job.spec.replica_specs[ReplicaType.MASTER].template = tmpl
+        try:
+            done = sup.run(job, timeout=30)
+            assert done.is_succeeded()
+            sup.store.mark_deletion("default/ap-re")
+            sup.store.delete("default/ap-re")
+        finally:
+            sup.shutdown()
+
+        sup2 = make_supervisor(tmp_path)
+        try:
+            job2 = new_job(name="ap-re", workers=0)
+            job2.spec.replica_specs[ReplicaType.MASTER].template = tmpl
+            result = {}
+            t = threading.Thread(
+                target=lambda: result.update(key=sup2.apply(job2))
+            )
+            t.start()
+            t.join(timeout=20)
+            assert not t.is_alive(), "apply() deadlocked on the key lock"
+            assert result["key"] == "default/ap-re"
+        finally:
+            sup2.shutdown()
+
+    def test_deletion_marker_for_old_incarnation_spares_new_job(self, tmp_path):
+        """A daemon consuming a uid-pinned deletion marker must not kill a
+        NEWER incarnation of the same job name (the marker's uid differs
+        from the stored job's)."""
+        sup = make_supervisor(tmp_path)
+        try:
+            job = new_job(name="uid-guard", workers=0)
+            job.spec.replica_specs[ReplicaType.MASTER].template = (
+                ProcessTemplate(command=["sleep", "30"])
+            )
+            key = sup.submit(job)
+            old_uid = "previous-incarnation-uid"
+            sup.store.mark_deletion(key, purge=False, uid=old_uid)
+            sup.process_deletion_markers()
+            assert sup.store.get(key) is not None, (
+                "marker for an old incarnation deleted the new job"
+            )
+            assert key not in sup.store.deletion_markers()  # consumed
+            # An unpinned (legacy) or matching-uid marker still deletes.
+            sup.store.mark_deletion(key, uid=sup.store.get(key).metadata.uid)
+            sup.process_deletion_markers()
+            assert sup.store.get(key) is None
+        finally:
+            sup.shutdown()
+
     def test_failing_job_backoff(self, tmp_path):
         sup = make_supervisor(tmp_path)
         job = new_job(

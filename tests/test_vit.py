@@ -1,0 +1,102 @@
+"""ViT model family + benchmark workload."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import tests.jaxenv  # noqa: F401
+from pytorch_operator_tpu.models import vit as vit_lib
+from pytorch_operator_tpu.parallel import make_mesh
+
+
+def tiny_cfg(**over):
+    return vit_lib.ViTConfig(
+        **{
+            "image_size": 16,
+            "patch_size": 4,
+            "num_classes": 10,
+            "d_model": 32,
+            "depth": 2,
+            "n_heads": 2,
+            "d_ff": 64,
+            "dtype": np.float32,
+            **over,
+        }
+    )
+
+
+class TestViTModel:
+    def test_forward_shape_and_finite(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = tiny_cfg()
+        model = vit_lib.ViT(cfg)
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((3, 16, 16, 3)),
+            jnp.float32,
+        )
+        params = model.init(jax.random.key(0), x)["params"]
+        logits = model.apply({"params": params}, x)
+        assert logits.shape == (3, 10)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_flash_attention_matches_dense(self):
+        """attn_impl='flash' (pallas interpret mode on CPU) must agree
+        with the dense path given identical params."""
+        import jax
+        import jax.numpy as jnp
+
+        dense = vit_lib.ViT(tiny_cfg())
+        flash = vit_lib.ViT(tiny_cfg(attn_impl="flash"))
+        x = jnp.asarray(
+            np.random.default_rng(1).standard_normal((2, 16, 16, 3)),
+            jnp.float32,
+        )
+        params = dense.init(jax.random.key(0), x)["params"]
+        yd = dense.apply({"params": params}, x)
+        yf = flash.apply({"params": params}, x)
+        np.testing.assert_allclose(
+            np.asarray(yd), np.asarray(yf), rtol=2e-4, atol=2e-4
+        )
+
+    def test_trains_loss_decreases(self):
+        import jax
+
+        from pytorch_operator_tpu.workloads.vit_bench import run_benchmark
+
+        result = run_benchmark(
+            variant="s16",
+            batch_size=8,
+            image_size=16,
+            classes=10,
+            steps=6,
+            warmup=1,
+            lr=1e-3,
+            log=lambda *_: None,
+        )
+        assert np.isfinite(result["final_loss"])
+        # Label-smoothed chance level for 10 classes is ~2.3; six AdamW
+        # steps on a fixed synthetic batch must beat it.
+        assert result["final_loss"] < 2.3
+
+    def test_shards_on_fsdp_tp_mesh(self):
+        """The LM-stack logical annotations carry over: encoder q_proj
+        kernels land (embed=fsdp, heads=tp)-sharded abstractly."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_operator_tpu.parallel import logical_shardings
+
+        mesh = make_mesh("fsdp=4,tp=2")
+        cfg = tiny_cfg(n_heads=2)
+        model = vit_lib.ViT(cfg)
+
+        abstract = jax.eval_shape(
+            lambda k: model.init(k, jnp.zeros((1, 16, 16, 3))),
+            jax.random.key(0),
+        )
+        sh = logical_shardings(abstract, mesh)
+        q = sh["params"]["layers"]["q_proj"]["kernel"]
+        assert "fsdp" in tuple(q.spec) and "tp" in tuple(q.spec), q
