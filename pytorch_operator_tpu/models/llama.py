@@ -68,6 +68,15 @@ class LlamaConfig:
     # 2.1-4.9x at E=8-32, BASELINE.md). Prefer "sparse" from E >= 16.
     moe_dispatch: str = "dense"
     moe_capacity_factor: float = 1.25
+    # Autoregressive decoding: ``decode=True`` switches attention to a
+    # KV-cache path (flax "cache" collection: cached_key/cached_value of
+    # static length ``max_decode_len``, updated in place each step) —
+    # prefill writes the whole prompt at once, decode steps append one
+    # token. Static shapes throughout: the scores run against the full
+    # cache with a position mask, so the decode step is ONE fixed XLA
+    # program regardless of how much of the cache is filled.
+    decode: bool = False
+    max_decode_len: int = 2048
 
     @property
     def q_per_kv(self) -> int:
@@ -197,6 +206,8 @@ class Attention(nn.Module):
         # GQA: group q heads over their kv head: [B,S,K,G,D] against [B,S,K,D].
         G = cfg.q_per_kv
         q = q.reshape(B, S, K, G, D)
+        if cfg.decode:
+            return self._decode_attend(q, k, v, positions)
         if cfg.attn_impl == "ring":
             if self.mesh is None:
                 raise ValueError(
@@ -224,6 +235,10 @@ class Attention(nn.Module):
         out = out.reshape(B, S, H * D)
         out = nn.with_logical_constraint(out, ("batch", "seq", None))
 
+        return self._o_proj(out)
+
+    def _o_proj(self, out):
+        cfg = self.cfg
         return nn.DenseGeneral(
             cfg.d_model, axis=-1, use_bias=False,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -232,6 +247,57 @@ class Attention(nn.Module):
             ),
             name="o_proj",
         )(out)
+
+    def _decode_attend(self, q, k, v, positions):
+        """KV-cache attention (prefill AND single-token decode steps).
+
+        Cache: ``cached_key``/``cached_value`` [B, max_decode_len, K, D]
+        in the flax "cache" collection, written in place at the current
+        positions; scores run q against the FULL cache with a
+        position-validity mask (col_pos <= row_pos), so the program shape
+        is static no matter how much of the cache is filled.
+
+        CONTRACT: positions must be batch-uniform (every row at the same
+        offsets — the standard unpadded generate loop). The cache write
+        offset and mask read row 0; left-padded/ragged batches would need
+        per-row offsets and are not supported here.
+        """
+        cfg = self.cfg
+        B, S, K, G, D = q.shape
+        L = cfg.max_decode_len
+        ck = self.variable(
+            "cache", "cached_key", jnp.zeros, (B, L, K, D), cfg.dtype
+        )
+        cv = self.variable(
+            "cache", "cached_value", jnp.zeros, (B, L, K, D), cfg.dtype
+        )
+        if not self.is_initializing():
+            # The incoming S tokens sit at contiguous positions starting
+            # at positions[:, 0] (prefill: the whole prompt from 0;
+            # decode: one token at the current index).
+            start = positions[0, 0]
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, start, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, start, 0, 0)
+            )
+        kc, vc = ck.value, cv.value
+        scores = jnp.einsum(
+            "bskgd,btkd->bkgst", q, kc, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(D).astype(jnp.float32)
+        col = jnp.arange(L)[None, :]            # cache position
+        row = positions[0][:, None]             # query position
+        scores = jnp.where(
+            (col <= row)[None, None, None, :, :],
+            scores,
+            jnp.finfo(jnp.float32).min,
+        )
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bkgst,btkd->bskgd", probs, vc)
+        out = out.reshape(B, S, K * G * D)
+        out = nn.with_logical_constraint(out, ("batch", "seq", None))
+        return self._o_proj(out)
 
 
 class MLP(nn.Module):
@@ -396,7 +462,9 @@ class Llama(nn.Module):
             block = nn.remat(Block, prevent_cse=False)
         ScanBlocks = nn.scan(
             block,
-            variable_axes={"params": 0},
+            # Per-layer stacking for params AND the decode KV cache
+            # (cached_key/value gain a leading layer axis).
+            variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True},
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
