@@ -68,6 +68,13 @@ class LlamaConfig:
     # 2.1-4.9x at E=8-32, BASELINE.md). Prefer "sparse" from E >= 16.
     moe_dispatch: str = "dense"
     moe_capacity_factor: float = 1.25
+    # Switch-style load-balancing auxiliary loss weight (0 = off). With
+    # top-k routing — and capacity-factor sparse dispatch especially,
+    # which DROPS over-capacity tokens — an unregularized router
+    # collapses onto a few experts; the standard weight is ~1e-2. Sown
+    # into the "losses" collection per layer; make_lm_train_step adds
+    # weight * mean(per-layer aux) to the objective.
+    moe_aux_weight: float = 0.0
     # Autoregressive decoding: ``decode=True`` switches attention to a
     # KV-cache path (flax "cache" collection: cached_key/cached_value of
     # static length ``max_decode_len``, updated in place each step) —
@@ -373,6 +380,14 @@ class MoEMLP(nn.Module):
             "w_out": w_out.astype(cfg.dtype),
         }
         x2d = x.reshape(-1, D)
+        if cfg.moe_aux_weight > 0:
+            from ..parallel.moe import load_balance_loss
+
+            self.sow(
+                "losses",
+                "moe_aux",
+                load_balance_loss(params, x2d, cfg.moe_top_k),
+            )
         ep_live = self.mesh is not None and self.mesh.shape.get("ep", 1) > 1
         if cfg.moe_dispatch not in ("dense", "sparse"):
             raise ValueError(
@@ -462,9 +477,9 @@ class Llama(nn.Module):
             block = nn.remat(Block, prevent_cse=False)
         ScanBlocks = nn.scan(
             block,
-            # Per-layer stacking for params AND the decode KV cache
-            # (cached_key/value gain a leading layer axis).
-            variable_axes={"params": 0, "cache": 0},
+            # Per-layer stacking for params, the decode KV cache, and
+            # sown aux losses (each gains a leading layer axis).
+            variable_axes={"params": 0, "cache": 0, "losses": 0},
             split_rngs={"params": True},
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},

@@ -43,6 +43,30 @@ def _gates(params, x, top_k: int):
     return jnp.put_along_axis(gates, top_idx, probs, axis=-1, inplace=False)
 
 
+def load_balance_loss(params, x, top_k: int):
+    """Switch-Transformer load-balancing auxiliary loss.
+
+    ``E * Σ_e f_e · P_e`` where ``f_e`` is the fraction of (token,
+    choice) routings landing on expert e and ``P_e`` the mean FULL-softmax
+    router probability for e. Perfectly balanced routing scores 1.0; a
+    router collapsed onto one expert scores ~E. Differentiable through
+    ``P_e`` (the f_e term is a straight-through count), which is exactly
+    the gradient that spreads the router out — without it, top-k training
+    (especially capacity-factor sparse dispatch, which DROPS over-capacity
+    tokens) collapses onto a few experts.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    logits, top_idx, _ = _router_topk(params, x, top_k)
+    E = logits.shape[-1]
+    full_probs = jax.nn.softmax(logits, axis=-1)          # [N, E]
+    counts = jax.nn.one_hot(top_idx, E, dtype=jnp.float32).sum(axis=(0, 1))
+    f = counts / counts.sum()                             # routing fractions
+    p = full_probs.mean(axis=0)                           # mean router prob
+    return E * jnp.sum(jax.lax.stop_gradient(f) * p)
+
+
 def _expert_ffn(w_in, w_out, gates, x):
     """Gated gelu FFN over an expert block: [E?, D, F] weights, [N, E?]
     gates → [N, D]. The shared compute of the sharded and dense paths."""

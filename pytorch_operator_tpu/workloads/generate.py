@@ -42,6 +42,13 @@ def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
             jnp.int32
         )
 
+    def last_logits(params, hidden):
+        # Head matmul on the LAST position only: prefill would otherwise
+        # materialize [B, prompt_len, vocab] f32 logits (~2 GB at the
+        # 0.3b bench config) just to sample one token.
+        w = model.head_kernel(params)
+        return hidden[:, -1].astype(jnp.float32) @ w.astype(jnp.float32)
+
     @functools.partial(jax.jit, donate_argnums=(1,))
     def generate(params, cache, prompt, rng):
         B, Sp = prompt.shape
@@ -54,24 +61,28 @@ def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
                 f"prompt_len {Sp} + max_new_tokens {max_new_tokens} "
                 f"exceeds cfg.max_decode_len {L}"
             )
-        logits, upd = model.apply(
-            {"params": params, "cache": cache}, prompt, mutable=["cache"]
+        hidden, upd = model.apply(
+            {"params": params, "cache": cache},
+            prompt,
+            return_hidden=True,
+            mutable=["cache"],
         )
         cache = upd["cache"]
         rng, k = jax.random.split(rng)
-        tok = sample(logits[:, -1], k)
+        tok = sample(last_logits(params, hidden), k)
 
         def step(carry, _):
             cache, tok, pos, rng = carry
             positions = jnp.broadcast_to(pos, (B, 1))
-            lg, upd = model.apply(
+            h, upd = model.apply(
                 {"params": params, "cache": cache},
                 tok[:, None],
                 positions,
+                return_hidden=True,
                 mutable=["cache"],
             )
             rng, k = jax.random.split(rng)
-            nxt = sample(lg[:, -1], k)
+            nxt = sample(last_logits(params, h), k)
             return (upd["cache"], nxt, pos + 1, rng), tok
 
         (cache, last, _, _), toks = jax.lax.scan(

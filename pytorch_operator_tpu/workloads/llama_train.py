@@ -63,6 +63,7 @@ def run(
     moe_top_k: int | None = None,
     moe_dispatch: str | None = None,
     moe_capacity_factor: float | None = None,
+    moe_aux_weight: float | None = None,
     pp_microbatches: int | None = None,
     preempt_at: int | None = None,
     profile_dir: str | None = None,
@@ -96,6 +97,8 @@ def run(
         over["moe_dispatch"] = moe_dispatch
     if moe_capacity_factor is not None:
         over["moe_capacity_factor"] = moe_capacity_factor
+    if moe_aux_weight is not None:
+        over["moe_aux_weight"] = moe_aux_weight
     cfg = getattr(llama_lib, CONFIGS[config])(**over)
     # Validate the routing config up front — otherwise a bad top_k only
     # surfaces as a ValueError deep inside model tracing.
@@ -103,6 +106,12 @@ def run(
         raise ValueError(
             f"moe_top_k={cfg.moe_top_k} must lie in [1, n_experts="
             f"{cfg.n_experts}] — pass --moe-top-k to adjust the routing"
+        )
+    if cfg.moe_aux_weight > 0 and cfg.n_experts == 0:
+        raise ValueError(
+            "--moe-aux-weight needs a MoE model (pass --experts N); "
+            "without experts no router exists, so the aux loss would be "
+            "silently inert"
         )
 
     n_dev = jax.device_count()
@@ -277,6 +286,11 @@ def main(argv=None) -> int:
         "1.25); higher drops fewer tokens, costs more FLOPs",
     )
     p.add_argument(
+        "--moe-aux-weight", type=float, default=None, dest="moe_aux_weight",
+        help="Switch-style load-balancing aux loss weight (typical 0.01; "
+        "default 0 = off); spreads the router across experts",
+    )
+    p.add_argument(
         "--pp-microbatches", type=int, default=None,
         help="GPipe microbatch count when the mesh has a pp axis "
         "(default 2 x pp extent; must be a multiple of it)",
@@ -312,6 +326,7 @@ def main(argv=None) -> int:
         moe_top_k=args.moe_top_k,
         moe_dispatch=args.moe_dispatch,
         moe_capacity_factor=args.moe_capacity_factor,
+        moe_aux_weight=args.moe_aux_weight,
         pp_microbatches=args.pp_microbatches,
         preempt_at=args.preempt_at,
         profile_dir=args.profile_dir,

@@ -79,7 +79,9 @@ def make_lm_train_step(model, tx, mesh, microbatches=None):
 
     from ..parallel import activation_rules
 
-    chunked = getattr(getattr(model, "cfg", None), "xent_impl", "dense") == "chunked"
+    cfg = getattr(model, "cfg", None)
+    chunked = getattr(cfg, "xent_impl", "dense") == "chunked"
+    aux_w = float(getattr(cfg, "moe_aux_weight", 0.0) or 0.0)
     pp = mesh.shape.get("pp", 1) > 1
     if pp:
         if not hasattr(model, "pp_forward"):
@@ -87,33 +89,55 @@ def make_lm_train_step(model, tx, mesh, microbatches=None):
                 f"mesh has a pp axis but {type(model).__name__} defines no "
                 "pp_forward hook (pipeline layering is model-owned)"
             )
+        if aux_w > 0:
+            raise ValueError(
+                "moe_aux_weight is not supported on a pp mesh (the "
+                "pipeline path bypasses flax sow collections)"
+            )
         mb = microbatches or 2 * mesh.shape["pp"]
 
     def forward(params, tokens, return_hidden):
+        """Returns (output, aux_loss) — aux is 0 unless the model sows
+        MoE load-balance losses and moe_aux_weight > 0."""
         if pp:
-            return model.pp_forward(
+            out = model.pp_forward(
                 params, tokens,
                 mesh=mesh, microbatches=mb, return_hidden=return_hidden,
             )
-        if return_hidden:
-            return model.apply({"params": params}, tokens, return_hidden=True)
-        return model.apply({"params": params}, tokens)
+            return out, 0.0
+        kwargs = {"return_hidden": True} if return_hidden else {}
+        if aux_w > 0:
+            out, mods = model.apply(
+                {"params": params}, tokens, mutable=["losses"], **kwargs
+            )
+            import jax.numpy as jnp
+
+            aux_leaves = jax.tree.leaves(mods.get("losses", {}))
+            aux = (
+                jnp.mean(jnp.stack([a.mean() for a in aux_leaves]))
+                if aux_leaves
+                else 0.0
+            )
+            return out, aux
+        return model.apply({"params": params}, tokens, **kwargs), 0.0
 
     def loss_fn(params, tokens):
         if chunked:
             from ..ops.chunked_xent import chunked_softmax_xent
 
             with activation_rules(mesh):
-                hidden = forward(params, tokens, True)
+                hidden, aux = forward(params, tokens, True)
             # Head access goes through the model (it owns its param naming).
             w = model.head_kernel(params)
             h = hidden[:, :-1].reshape(-1, hidden.shape[-1])
-            return chunked_softmax_xent(h, w, tokens[:, 1:].reshape(-1)).mean()
+            xent = chunked_softmax_xent(h, w, tokens[:, 1:].reshape(-1)).mean()
+            return xent + aux_w * aux
         with activation_rules(mesh):
-            logits = forward(params, tokens, False)
-        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, aux = forward(params, tokens, False)
+        xent = optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1], tokens[:, 1:]
         ).mean()
+        return xent + aux_w * aux
 
     @jax.jit
     def train_step(state, tokens):

@@ -93,24 +93,19 @@ class TestGenerate:
         with pytest.raises(ValueError, match="max_decode_len"):
             gen(params, cache, prompt, jax.random.key(0))
 
-    def test_cache_reuse_after_donation_is_fresh(self):
-        """Two generations from fresh caches agree (the donated cache
-        from run 1 is never silently reused)."""
+    def test_garbage_cache_contents_cannot_leak(self):
+        """Every cache slot the mask allows reading is written by the
+        current run first — a cache pre-filled with garbage must produce
+        the same rollout as a zero cache (and the donated buffer from a
+        previous run therefore can't leak either)."""
         import jax
+        import jax.numpy as jnp
 
         new = 6
         cfg, train_model, decode_model, params, prompt = _setup(new=new)
         gen = make_generate(decode_model, max_new_tokens=new)
-        t1, _ = gen(
-            params,
-            init_cache(decode_model, prompt.shape[0], prompt.shape[1]),
-            prompt,
-            jax.random.key(0),
-        )
-        t2, _ = gen(
-            params,
-            init_cache(decode_model, prompt.shape[0], prompt.shape[1]),
-            prompt,
-            jax.random.key(0),
-        )
+        clean = init_cache(decode_model, prompt.shape[0], prompt.shape[1])
+        garbage = jax.tree.map(lambda z: jnp.full_like(z, 7.0), clean)
+        t1, _ = gen(params, clean, prompt, jax.random.key(0))
+        t2, _ = gen(params, garbage, prompt, jax.random.key(0))
         np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
