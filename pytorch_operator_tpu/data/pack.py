@@ -21,26 +21,57 @@ from .array_file import pack_arrays
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--out", required=True)
-    p.add_argument("--dataset", choices=("digits", "synthetic"), default="digits")
+    p.add_argument(
+        "--dataset", choices=("digits", "synthetic", "text"), default="digits"
+    )
     p.add_argument("--split", default="train", choices=("train", "test"))
     p.add_argument("--n", type=int, default=4096, help="synthetic: record count")
     p.add_argument("--height", type=int, default=32)
     p.add_argument("--width", type=int, default=32)
     p.add_argument("--classes", type=int, default=10)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--input", default=None,
+        help="text: path to a UTF-8/byte file to pack as LM training data",
+    )
+    p.add_argument(
+        "--seq-len", type=int, default=512,
+        help="text: tokens per record (byte-level, vocab 256)",
+    )
     args = p.parse_args(argv)
 
     if args.dataset == "digits":
         from ..workloads.datasets import digits
 
         x, y = digits(args.split)
+        meta = pack_arrays(args.out, {"x": x, "y": y})
+    elif args.dataset == "text":
+        # Byte-level LM corpus: any file becomes int32 token records of
+        # --seq-len bytes (vocab 256) — the real-data path for
+        # llama_train --data-file with no external tokenizer.
+        import numpy as np
+        from pathlib import Path
+
+        if not args.input:
+            raise SystemExit("--dataset text needs --input FILE")
+        data = Path(args.input).read_bytes()
+        S = args.seq_len
+        n = len(data) // S
+        if n == 0:
+            raise SystemExit(
+                f"{args.input}: {len(data)} bytes < one record of {S}"
+            )
+        tokens = (
+            np.frombuffer(data[: n * S], np.uint8).astype(np.int32).reshape(n, S)
+        )
+        meta = pack_arrays(args.out, {"tokens": tokens})
     else:
         from ..workloads.datasets import synthetic_images
 
         x, y = synthetic_images(
             args.n, args.height, args.width, args.classes, seed=args.seed
         )
-    meta = pack_arrays(args.out, {"x": x, "y": y})
+        meta = pack_arrays(args.out, {"x": x, "y": y})
     print(
         f"packed {meta.n_records} records "
         f"({meta.record_bytes} B each) -> {args.out}"

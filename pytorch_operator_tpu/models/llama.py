@@ -383,6 +383,11 @@ class MoEMLP(nn.Module):
         if cfg.moe_aux_weight > 0:
             from ..parallel.moe import load_balance_loss
 
+            # The router matmul recurs inside the dispatch below; both
+            # run outside any shard_map (dispatch tensors are computed
+            # replicated), the op is <1% of the expert FFN FLOPs, and
+            # XLA CSEs identical-trace repeats — not worth threading
+            # precomputed logits through both call paths.
             self.sow(
                 "losses",
                 "moe_aux",

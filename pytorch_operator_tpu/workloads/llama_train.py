@@ -53,6 +53,11 @@ def run(
     steps: int = 20,
     warmup: int = 2,
     lr: float = 3e-4,
+    lr_schedule: str = "constant",
+    lr_warmup_steps: int = 0,
+    lr_decay_steps: int | None = None,
+    grad_clip: float | None = None,
+    data_file: str | None = None,
     checkpoint_every: int = 0,
     async_checkpoint: bool = False,
     max_steps: int | None = None,
@@ -135,7 +140,25 @@ def run(
         f"({jax.devices()[0].platform})"
     )
 
-    tx = optax.adamw(lr, weight_decay=0.1)
+    # Optimizer: AdamW with an optional schedule (linear warmup + cosine
+    # decay — the standard LM recipe) and optional global-norm clipping.
+    if lr_schedule == "cosine":
+        total = lr_decay_steps or (steps + max(warmup, 1))
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=max(lr_warmup_steps, 1),
+            decay_steps=max(total, lr_warmup_steps + 1),
+        )
+    elif lr_schedule == "constant":
+        sched = lr
+    else:
+        raise ValueError(f"lr_schedule={lr_schedule!r} not in ('constant', 'cosine')")
+    tx = optax.adamw(sched, weight_decay=0.1)
+    if grad_clip is not None:
+        if grad_clip <= 0:
+            raise ValueError(f"grad_clip must be positive, got {grad_clip}")
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
     t_init = time.time()
     state, _ = init_sharded_train_state(
         lambda k: model.init(k, np.zeros((1, seq_len), np.int32)), tx, mesh
@@ -153,58 +176,133 @@ def run(
     # restarted life resumes from checkpoint.
     restart_count = int(os.environ.get("TPUJOB_RESTART_COUNT", "0"))
 
-    def batches(step: int):
+    def maybe_preempt(step: int):
         if preempt_at is not None and restart_count == 0 and step >= preempt_at:
             log(f"[llama] injected preemption at step {step} (exit 138)")
             sys.stdout.flush()
             sys.stderr.flush()
             os._exit(138)
-        return put_global(
-            synthetic_bigram_batch(batch, seq_len, cfg.vocab_size, step),
-            batch_sharding,
+
+    loader = None
+    if data_file:
+        from ..data import open_loader, read_meta
+
+        meta = read_meta(data_file)
+        names = [f.name for f in meta.fields]
+        if "tokens" not in names:
+            raise ValueError(
+                f"--data-file needs a 'tokens' field; {data_file} has "
+                f"{names} (pack with pytorch_operator_tpu.data.pack "
+                f"--dataset text)"
+            )
+        f_tok = next(f for f in meta.fields if f.name == "tokens")
+        if f_tok.shape[0] < seq_len:
+            raise ValueError(
+                f"--data-file records hold {f_tok.shape[0]} tokens < "
+                f"--seq-len {seq_len}"
+            )
+        if meta.n_records < batch:
+            raise ValueError(
+                f"--data-file holds {meta.n_records} records < global "
+                f"batch {batch}"
+            )
+        # Multi-process gangs pin the native loader (same guard as
+        # mnist/resnet: divergent per-rank shuffles would corrupt
+        # assembled global batches).
+        loader = open_loader(
+            data_file, batch, seed=0,
+            native=True if jax.process_count() > 1 else None,
         )
 
-    # ---- resume (preemption recovery, BASELINE.json:11) ----
-    start_step = 0
-    mgr = None
-    ckpt_dir = job_checkpoint_dir()
-    if checkpoint_every and ckpt_dir is not None:
-        mgr = CheckpointManager(ckpt_dir)
-        resumed = mgr.restore_or_none(state)
-        if resumed is not None:
-            start_step, state = resumed
-            log(f"[llama] resumed from checkpoint at step {start_step}")
+        validated = False
 
-    if max_steps is not None:
-        steps = max(min(steps, max_steps - start_step - max(warmup, 1)), 0)
+        def batches(step: int):
+            nonlocal validated
+            maybe_preempt(step)
+            _, _, fields = loader.next_batch()
+            toks = np.ascontiguousarray(
+                fields["tokens"][:, :seq_len], dtype=np.int32
+            )
+            if not validated:
+                # First batch only: a per-step host-side max() scan would
+                # sit inside the timed throughput window.
+                top = int(toks.max())
+                if top >= cfg.vocab_size:
+                    raise ValueError(
+                        f"--data-file token id {top} >= model vocab "
+                        f"{cfg.vocab_size}"
+                    )
+                validated = True
+            return put_global(toks, batch_sharding)
 
-    def on_first():
-        rendezvous.report_first_step(start_step)
+    else:
 
-    with mesh:
-        state, final_loss, steps_per_sec, end_step = throughput_loop(
-            train_step,
-            state,
-            batches,
-            steps=steps,
-            warmup=warmup,
-            device_get=lambda x: jax.device_get(x),
-            on_first_step=on_first,
-            checkpoint_every=checkpoint_every,
-            # Async saves overlap the orbax write with the next training
-            # steps (the step fn does not donate state, so the buffers stay
-            # valid); mgr.close()/the final save below still commit
-            # everything before exit. Blocking is the default — preemption
-            # tests need the just-saved step to be durable.
-            save=(
-                (lambda s, st: mgr.save(s, st, block=not async_checkpoint))
-                if mgr is not None
-                else None
-            ),
-            start_step=start_step,
-            log=lambda m: log(f"[llama] {m}"),
-            profile_dir=profile_dir,
-        )
+        def batches(step: int):
+            maybe_preempt(step)
+            return put_global(
+                synthetic_bigram_batch(batch, seq_len, cfg.vocab_size, step),
+                batch_sharding,
+            )
+
+    # The try spans everything from here: a failure anywhere before or
+    # during the loop (corrupt checkpoint, trainer validation) must not
+    # leak the native loader's prefetch thread/mmap.
+    try:
+        # ---- resume (preemption recovery, BASELINE.json:11) ----
+        start_step = 0
+        mgr = None
+        ckpt_dir = job_checkpoint_dir()
+        if checkpoint_every and ckpt_dir is not None:
+            mgr = CheckpointManager(ckpt_dir)
+            resumed = mgr.restore_or_none(state)
+            if resumed is not None:
+                start_step, state = resumed
+                log(f"[llama] resumed from checkpoint at step {start_step}")
+                if loader is not None and start_step > 0:
+                    # Fast-forward the data stream to where the previous
+                    # life stopped (fixed seed ⇒ deterministic order):
+                    # without this a resumed run would replay batches
+                    # 0..start_step and diverge from an uninterrupted run.
+                    for _ in range(start_step):
+                        loader.next_batch()
+                    log(
+                        f"[llama] data stream fast-forwarded "
+                        f"{start_step} batches"
+                    )
+
+        if max_steps is not None:
+            steps = max(min(steps, max_steps - start_step - max(warmup, 1)), 0)
+
+        def on_first():
+            rendezvous.report_first_step(start_step)
+
+        with mesh:
+            state, final_loss, steps_per_sec, end_step = throughput_loop(
+                train_step,
+                state,
+                batches,
+                steps=steps,
+                warmup=warmup,
+                device_get=lambda x: jax.device_get(x),
+                on_first_step=on_first,
+                checkpoint_every=checkpoint_every,
+                # Async saves overlap the orbax write with the next training
+                # steps (the step fn does not donate state, so the buffers stay
+                # valid); mgr.close()/the final save below still commit
+                # everything before exit. Blocking is the default — preemption
+                # tests need the just-saved step to be durable.
+                save=(
+                    (lambda s, st: mgr.save(s, st, block=not async_checkpoint))
+                    if mgr is not None
+                    else None
+                ),
+                start_step=start_step,
+                log=lambda m: log(f"[llama] {m}"),
+                profile_dir=profile_dir,
+            )
+    finally:
+        if loader is not None:
+            loader.close()
     if mgr is not None:
         if mgr.latest_step() != end_step:
             mgr.save(end_step, state)
@@ -243,6 +341,23 @@ def main(argv=None) -> int:
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument(
+        "--lr-schedule", choices=("constant", "cosine"), default="constant",
+        help="cosine = linear warmup to --lr then cosine decay over "
+        "--lr-decay-steps (default: the run length)",
+    )
+    p.add_argument("--lr-warmup-steps", type=int, default=0)
+    p.add_argument("--lr-decay-steps", type=int, default=None)
+    p.add_argument(
+        "--grad-clip", type=float, default=None,
+        help="clip gradients to this global norm (standard LM recipe: 1.0)",
+    )
+    p.add_argument(
+        "--data-file", default=None,
+        help="train from packed token records via the prefetch loader "
+        "(pack any text file byte-level with pytorch_operator_tpu.data."
+        "pack --dataset text); default: synthetic bigram stream",
+    )
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument(
         "--async-checkpoint", action="store_true",
@@ -316,6 +431,11 @@ def main(argv=None) -> int:
         steps=args.steps,
         warmup=args.warmup,
         lr=args.lr,
+        lr_schedule=args.lr_schedule,
+        lr_warmup_steps=args.lr_warmup_steps,
+        lr_decay_steps=args.lr_decay_steps,
+        grad_clip=args.grad_clip,
+        data_file=args.data_file,
         checkpoint_every=args.checkpoint_every,
         async_checkpoint=args.async_checkpoint,
         max_steps=args.max_steps,
