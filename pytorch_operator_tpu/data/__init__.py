@@ -9,15 +9,27 @@ This package is the TPU-native equivalent: a packed record file format
 the training loop's critical path.
 """
 
-from .array_file import ArrayFileMeta, pack_arrays, read_meta
+from .array_file import ArrayFileMeta, field_max, pack_arrays, read_meta
 from .native_loader import LoaderUnavailable, NativeLoader, PyLoader, open_loader
+
+
+def open_training_loader(path, batch: int, *, seed: int = 0, processes: int = 1):
+    """``open_loader`` with the gang-determinism guard every training
+    workload needs: multi-process worlds PIN the native loader, because
+    the pure-python fallback shuffles with a different RNG and divergent
+    per-rank permutations would silently corrupt assembled global
+    batches. (One shared helper so the rule can't drift per workload.)"""
+    return open_loader(path, batch, seed=seed, native=True if processes > 1 else None)
+
 
 __all__ = [
     "ArrayFileMeta",
+    "field_max",
     "pack_arrays",
     "read_meta",
     "LoaderUnavailable",
     "NativeLoader",
     "PyLoader",
     "open_loader",
+    "open_training_loader",
 ]

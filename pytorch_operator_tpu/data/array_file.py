@@ -64,6 +64,34 @@ def meta_path(path) -> Path:
     return Path(str(path) + ".meta.json")
 
 
+def field_max(path, meta: ArrayFileMeta, name: str, chunk_records: int = 8192):
+    """Max value of a field across ALL records — one streaming memmap
+    pass at file-read speed. Used to validate token ids up front: a
+    per-batch check misses records outside the scanned batches, and
+    out-of-range embedding lookups clamp silently in XLA.
+    """
+    off = 0
+    fm = None
+    for f in meta.fields:
+        if f.name == name:
+            fm = f
+            break
+        off += f.nbytes
+    if fm is None:
+        raise KeyError(f"field {name!r} not in {[f.name for f in meta.fields]}")
+    R = meta.record_bytes
+    data = np.memmap(path, np.uint8, mode="r")
+    best = None
+    for i in range(0, meta.n_records, chunk_records):
+        j = min(i + chunk_records, meta.n_records)
+        block = np.ascontiguousarray(
+            data[i * R : j * R].reshape(j - i, R)[:, off : off + fm.nbytes]
+        )
+        m = block.reshape(-1).view(fm.dtype).max()
+        best = m if best is None else max(best, m)
+    return best
+
+
 def pack_arrays(path, arrays: Dict[str, np.ndarray]) -> ArrayFileMeta:
     """Write per-example arrays (each shaped ``(N, ...)``) as one record file.
 

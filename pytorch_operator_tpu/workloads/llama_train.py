@@ -143,7 +143,12 @@ def run(
     # Optimizer: AdamW with an optional schedule (linear warmup + cosine
     # decay — the standard LM recipe) and optional global-norm clipping.
     if lr_schedule == "cosine":
-        total = lr_decay_steps or (steps + max(warmup, 1))
+        # Default horizon: --max-steps when set (the GLOBAL step budget,
+        # correct across checkpoint resumes — the restored optimizer
+        # count is global), else this life's steps+warmup. A resumed run
+        # without --max-steps or --lr-decay-steps would otherwise train
+        # its tail at LR ~0.
+        total = lr_decay_steps or max_steps or (steps + max(warmup, 1))
         sched = optax.warmup_cosine_decay_schedule(
             init_value=0.0,
             peak_value=lr,
@@ -185,7 +190,7 @@ def run(
 
     loader = None
     if data_file:
-        from ..data import open_loader, read_meta
+        from ..data import field_max, open_training_loader, read_meta
 
         meta = read_meta(data_file)
         names = [f.name for f in meta.fields]
@@ -201,38 +206,37 @@ def run(
                 f"--data-file records hold {f_tok.shape[0]} tokens < "
                 f"--seq-len {seq_len}"
             )
+        if f_tok.shape[0] > seq_len:
+            log(
+                f"[llama] WARNING: records hold {f_tok.shape[0]} tokens; "
+                f"training uses only the first {seq_len} of each "
+                f"(--seq-len) — repack with --seq-len {seq_len} to use "
+                f"the whole corpus"
+            )
         if meta.n_records < batch:
             raise ValueError(
                 f"--data-file holds {meta.n_records} records < global "
                 f"batch {batch}"
             )
-        # Multi-process gangs pin the native loader (same guard as
-        # mnist/resnet: divergent per-rank shuffles would corrupt
-        # assembled global batches).
-        loader = open_loader(
-            data_file, batch, seed=0,
-            native=True if jax.process_count() > 1 else None,
+        # Whole-file scan UP FRONT (memmap streaming pass): a per-batch
+        # check would miss records outside the scanned batches, and XLA
+        # clamps out-of-range embedding lookups silently.
+        top = int(field_max(data_file, meta, "tokens"))
+        if top >= cfg.vocab_size:
+            raise ValueError(
+                f"--data-file token id {top} >= model vocab "
+                f"{cfg.vocab_size}"
+            )
+        loader = open_training_loader(
+            data_file, batch, seed=0, processes=jax.process_count()
         )
 
-        validated = False
-
         def batches(step: int):
-            nonlocal validated
             maybe_preempt(step)
             _, _, fields = loader.next_batch()
             toks = np.ascontiguousarray(
                 fields["tokens"][:, :seq_len], dtype=np.int32
             )
-            if not validated:
-                # First batch only: a per-step host-side max() scan would
-                # sit inside the timed throughput window.
-                top = int(toks.max())
-                if top >= cfg.vocab_size:
-                    raise ValueError(
-                        f"--data-file token id {top} >= model vocab "
-                        f"{cfg.vocab_size}"
-                    )
-                validated = True
             return put_global(toks, batch_sharding)
 
     else:

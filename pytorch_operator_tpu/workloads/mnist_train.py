@@ -124,16 +124,11 @@ def main(argv=None) -> int:
     # compute on a background C++ thread).
     loader = None
     if args.data_file:
-        from ..data import open_loader
+        from ..data import open_training_loader
 
-        # Multi-process gangs pin the native loader: the pure-python
-        # fallback shuffles with a different RNG, and divergent per-rank
-        # permutations would silently corrupt assembled global batches.
-        loader = open_loader(
-            args.data_file,
-            batch,
-            seed=args.seed,
-            native=True if world.num_processes > 1 else None,
+        loader = open_training_loader(
+            args.data_file, batch, seed=args.seed,
+            processes=world.num_processes,
         )
         if loader.batches_per_epoch == 0:
             print(

@@ -256,16 +256,11 @@ def run_benchmark(
     if data_file:
         from jax.sharding import NamedSharding, PartitionSpec
 
-        from ..data import open_loader
+        from ..data import open_training_loader
         from ..parallel.data import put_global
 
-        # Multi-process gangs pin the native loader: the python fallback
-        # shuffles with a different RNG, and divergent per-rank orders
-        # would silently corrupt assembled global batches (same guard as
-        # mnist_train).
-        loader = open_loader(
-            data_file, batch, seed=0,
-            native=True if jax.process_count() > 1 else None,
+        loader = open_training_loader(
+            data_file, batch, seed=0, processes=jax.process_count()
         )
         x_sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
         _, _, first = loader.next_batch()
