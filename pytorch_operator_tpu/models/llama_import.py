@@ -1,0 +1,125 @@
+"""Import PyTorch (HuggingFace-layout) Llama weights into the flax model.
+
+The migration story's missing half: `api/convert.py` carries a user's
+PyTorchJob MANIFESTS over; this carries their trained WEIGHTS. A
+state_dict using the HF ``LlamaForCausalLM`` naming scheme
+(``model.layers.N.self_attn.q_proj.weight`` …) maps 1:1 onto this
+package's flax tree — torch ``Linear`` stores ``[out, in]`` so kernels
+transpose, attention projections reshape into the (heads, head_dim)
+DenseGeneral layout, and per-layer tensors stack into the
+``nn.scan``-stacked ``[n_layers, ...]`` arrays.
+
+RoPE convention note: this package's ``apply_rope`` uses the rotate-half
+convention — the same one HF's modeling_llama applies — so projections
+import WITHOUT the permutation needed when converting from Meta's
+original interleaved checkpoints. The equivalence test
+(tests/test_llama_import.py) runs a real torch reference forward and
+asserts logits match.
+
+Accepts either live ``torch.Tensor`` values or numpy arrays, so packed
+state_dicts can be imported without torch installed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch.Tensor
+        # Real HF checkpoints ship bf16, which numpy can't represent —
+        # widen on the torch side first.
+        t = t.detach().float().cpu().numpy()
+    return np.asarray(t)
+
+
+def import_hf_llama_state_dict(sd: Dict[str, Any], cfg) -> dict:
+    """HF-layout state_dict → this package's flax ``params`` tree
+    (unboxed numpy arrays, ready for ``jax.device_put`` /
+    ``model.apply({"params": ...})``)."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "HF import for MoE configs is not implemented (dense Llama only)"
+        )
+    L = cfg.n_layers
+    H, K, D, hd = cfg.n_heads, cfg.n_kv_heads, cfg.d_model, cfg.head_dim
+
+    def take(name, shape):
+        if name not in sd:
+            raise KeyError(f"state_dict missing {name!r}")
+        a = _np(sd[name]).astype(np.float32)
+        if tuple(a.shape) != tuple(shape):
+            raise ValueError(
+                f"{name}: expected shape {tuple(shape)}, got {tuple(a.shape)}"
+            )
+        return a
+
+    def stack(fmt, shape):
+        return np.stack([take(fmt.format(i), shape) for i in range(L)])
+
+    # torch Linear [out, in] → flax kernel [in, out].
+    def lin(fmt, out_dim, in_dim):
+        return stack(fmt, (out_dim, in_dim)).transpose(0, 2, 1)
+
+    params = {
+        "embed": {
+            "embedding": take("model.embed_tokens.weight", (cfg.vocab_size, D))
+        },
+        "layers": {
+            "attn_norm": {
+                "scale": stack("model.layers.{}.input_layernorm.weight", (D,))
+            },
+            "attn": {
+                "q_proj": {
+                    "kernel": lin(
+                        "model.layers.{}.self_attn.q_proj.weight", H * hd, D
+                    ).reshape(L, D, H, hd)
+                },
+                "k_proj": {
+                    "kernel": lin(
+                        "model.layers.{}.self_attn.k_proj.weight", K * hd, D
+                    ).reshape(L, D, K, hd)
+                },
+                "v_proj": {
+                    "kernel": lin(
+                        "model.layers.{}.self_attn.v_proj.weight", K * hd, D
+                    ).reshape(L, D, K, hd)
+                },
+                "o_proj": {
+                    "kernel": lin(
+                        "model.layers.{}.self_attn.o_proj.weight", D, H * hd
+                    )
+                },
+            },
+            "mlp_norm": {
+                "scale": stack(
+                    "model.layers.{}.post_attention_layernorm.weight", (D,)
+                )
+            },
+            "mlp": {
+                "gate_proj": {
+                    "kernel": lin("model.layers.{}.mlp.gate_proj.weight", cfg.d_ff, D)
+                },
+                "up_proj": {
+                    "kernel": lin("model.layers.{}.mlp.up_proj.weight", cfg.d_ff, D)
+                },
+                "down_proj": {
+                    "kernel": lin("model.layers.{}.mlp.down_proj.weight", D, cfg.d_ff)
+                },
+            },
+        },
+        "final_norm": {"scale": take("model.norm.weight", (D,))},
+        "lm_head": {
+            # tie_word_embeddings checkpoints (e.g. Llama-3.2-1B/3B)
+            # omit lm_head.weight — the head is the embedding table.
+            "kernel": take(
+                "lm_head.weight"
+                if "lm_head.weight" in sd
+                else "model.embed_tokens.weight",
+                (cfg.vocab_size, D),
+            ).T.copy()
+        },
+    }
+    return params
