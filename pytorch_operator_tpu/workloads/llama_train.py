@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import time
 
@@ -58,6 +59,8 @@ def run(
     lr_decay_steps: int | None = None,
     grad_clip: float | None = None,
     data_file: str | None = None,
+    eval_file: str | None = None,
+    eval_batches: int = 8,
     checkpoint_every: int = 0,
     async_checkpoint: bool = False,
     max_steps: int | None = None,
@@ -188,56 +191,69 @@ def run(
             sys.stderr.flush()
             os._exit(138)
 
-    loader = None
-    if data_file:
+    def open_token_file(path: str, flag: str, seed: int, open: bool = True):
+        """Validate (and optionally open) a packed token file."""
         from ..data import field_max, open_training_loader, read_meta
 
-        meta = read_meta(data_file)
+        meta = read_meta(path)
         names = [f.name for f in meta.fields]
         if "tokens" not in names:
             raise ValueError(
-                f"--data-file needs a 'tokens' field; {data_file} has "
-                f"{names} (pack with pytorch_operator_tpu.data.pack "
-                f"--dataset text)"
+                f"{flag} needs a 'tokens' field; {path} has {names} "
+                f"(pack with pytorch_operator_tpu.data.pack --dataset text)"
             )
         f_tok = next(f for f in meta.fields if f.name == "tokens")
         if f_tok.shape[0] < seq_len:
             raise ValueError(
-                f"--data-file records hold {f_tok.shape[0]} tokens < "
+                f"{flag} records hold {f_tok.shape[0]} tokens < "
                 f"--seq-len {seq_len}"
             )
         if f_tok.shape[0] > seq_len:
             log(
-                f"[llama] WARNING: records hold {f_tok.shape[0]} tokens; "
-                f"training uses only the first {seq_len} of each "
+                f"[llama] WARNING: {flag} records hold {f_tok.shape[0]} "
+                f"tokens; only the first {seq_len} of each are used "
                 f"(--seq-len) — repack with --seq-len {seq_len} to use "
                 f"the whole corpus"
             )
         if meta.n_records < batch:
             raise ValueError(
-                f"--data-file holds {meta.n_records} records < global "
-                f"batch {batch}"
+                f"{flag} holds {meta.n_records} records < global batch {batch}"
             )
         # Whole-file scan UP FRONT (memmap streaming pass): a per-batch
         # check would miss records outside the scanned batches, and XLA
         # clamps out-of-range embedding lookups silently.
-        top = int(field_max(data_file, meta, "tokens"))
+        top = int(field_max(path, meta, "tokens"))
         if top >= cfg.vocab_size:
             raise ValueError(
-                f"--data-file token id {top} >= model vocab "
-                f"{cfg.vocab_size}"
+                f"{flag} token id {top} >= model vocab {cfg.vocab_size}"
             )
-        loader = open_training_loader(
-            data_file, batch, seed=0, processes=jax.process_count()
+        if not open:
+            return None, meta
+        return (
+            open_training_loader(
+                path, batch, seed=seed, processes=jax.process_count()
+            ),
+            meta,
         )
+
+    def next_tokens(ldr):
+        _, _, fields = ldr.next_batch()
+        return np.ascontiguousarray(fields["tokens"][:, :seq_len], np.int32)
+
+    if eval_file:
+        # Validate the eval file BEFORE spending any training compute —
+        # a bad eval file must not destroy a finished run's output.
+        if eval_batches < 1:
+            raise ValueError(f"eval_batches must be >= 1, got {eval_batches}")
+        open_token_file(eval_file, "--eval-file", seed=1, open=False)
+
+    loader = None
+    if data_file:
+        loader, _ = open_token_file(data_file, "--data-file", seed=0)
 
         def batches(step: int):
             maybe_preempt(step)
-            _, _, fields = loader.next_batch()
-            toks = np.ascontiguousarray(
-                fields["tokens"][:, :seq_len], dtype=np.int32
-            )
-            return put_global(toks, batch_sharding)
+            return put_global(next_tokens(loader), batch_sharding)
 
     else:
 
@@ -324,7 +340,7 @@ def run(
         f"[llama] {steps} steps: {tokens_per_sec:,.0f} tokens/sec "
         f"({per_chip:,.0f}/chip), final loss {final_loss:.3f}"
     )
-    return {
+    result = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
@@ -334,6 +350,47 @@ def run(
         "end_step": end_step,
         "devices": n_dev,
     }
+
+    if eval_file:
+        # Held-out evaluation: same objective as training (shared
+        # make_lm_loss_fn), fixed deterministic batch order, no updates.
+        from .trainer import make_lm_eval_step
+
+        eval_loader, eval_meta = open_token_file(eval_file, "--eval-file", seed=1)
+        try:
+            eval_step = make_lm_eval_step(model, mesh, microbatches=pp_microbatches)
+            n_eval = max(
+                1, min(eval_batches, eval_meta.n_records // batch)
+            )
+            losses = []
+            with mesh:
+                for _ in range(n_eval):
+                    losses.append(
+                        float(
+                            jax.device_get(
+                                eval_step(
+                                    state["params"],
+                                    put_global(
+                                        next_tokens(eval_loader), batch_sharding
+                                    ),
+                                )
+                            )
+                        )
+                    )
+        finally:
+            eval_loader.close()
+        eval_loss = sum(losses) / len(losses)
+        ppl = math.exp(min(eval_loss, 30.0))
+        rendezvous.report_metrics(
+            end_step, eval_loss=eval_loss, eval_perplexity=ppl
+        )
+        log(
+            f"[llama] eval: loss {eval_loss:.4f} (ppl {ppl:.1f}) over "
+            f"{n_eval} held-out batch(es)"
+        )
+        result["eval_loss"] = round(eval_loss, 4)
+        result["eval_perplexity"] = round(ppl, 2)
+    return result
 
 
 def main(argv=None) -> int:
@@ -361,6 +418,15 @@ def main(argv=None) -> int:
         help="train from packed token records via the prefetch loader "
         "(pack any text file byte-level with pytorch_operator_tpu.data."
         "pack --dataset text); default: synthetic bigram stream",
+    )
+    p.add_argument(
+        "--eval-file", default=None,
+        help="held-out packed token file: report eval loss + perplexity "
+        "after training (same objective, no updates)",
+    )
+    p.add_argument(
+        "--eval-batches", type=int, default=8,
+        help="max held-out batches to average over",
     )
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument(
@@ -440,6 +506,8 @@ def main(argv=None) -> int:
         lr_decay_steps=args.lr_decay_steps,
         grad_clip=args.grad_clip,
         data_file=args.data_file,
+        eval_file=args.eval_file,
+        eval_batches=args.eval_batches,
         checkpoint_every=args.checkpoint_every,
         async_checkpoint=args.async_checkpoint,
         max_steps=args.max_steps,

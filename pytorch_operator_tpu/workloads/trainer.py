@@ -55,14 +55,11 @@ def init_sharded_train_state(model_init: Callable, tx, mesh):
     return init_sharded(init_state, mesh, jax.random.key(int(os.environ.get("TPUJOB_SEED", "0"))))
 
 
-def make_lm_train_step(model, tx, mesh, microbatches=None):
-    """Next-token cross-entropy train step, jitted WITHOUT state donation.
-
-    Keep it donation-free: async checkpointing (llama_train
-    --async-checkpoint) hands the returned state to an in-flight orbax
-    save while the next step runs — donated buffers would be invalidated
-    under the save. (XLA still updates params efficiently; donation here
-    buys little for the LM workloads.)
+def make_lm_loss_fn(model, mesh, microbatches=None, include_aux=True):
+    """Next-token cross-entropy ``loss_fn(params, tokens)`` — the shared
+    objective behind the train step and held-out evaluation.
+    ``include_aux=False`` drops the MoE load-balance term (evaluation:
+    perplexity must be exp of the cross-entropy alone).
 
     When the model config sets ``xent_impl="chunked"``, the LM head matmul
     is fused into the loss via ops/chunked_xent.py — the model returns
@@ -81,7 +78,9 @@ def make_lm_train_step(model, tx, mesh, microbatches=None):
 
     cfg = getattr(model, "cfg", None)
     chunked = getattr(cfg, "xent_impl", "dense") == "chunked"
-    aux_w = float(getattr(cfg, "moe_aux_weight", 0.0) or 0.0)
+    aux_w = (
+        float(getattr(cfg, "moe_aux_weight", 0.0) or 0.0) if include_aux else 0.0
+    )
     pp = mesh.shape.get("pp", 1) > 1
     if pp:
         if not hasattr(model, "pp_forward"):
@@ -139,6 +138,24 @@ def make_lm_train_step(model, tx, mesh, microbatches=None):
         ).mean()
         return xent + aux_w * aux
 
+    return loss_fn
+
+
+def make_lm_train_step(model, tx, mesh, microbatches=None):
+    """Jitted LM train step, WITHOUT state donation.
+
+    Keep it donation-free: async checkpointing (llama_train
+    --async-checkpoint) hands the returned state to an in-flight orbax
+    save while the next step runs — donated buffers would be invalidated
+    under the save. (XLA still updates params efficiently; donation here
+    buys little for the LM workloads.) Objective semantics are
+    :func:`make_lm_loss_fn`'s.
+    """
+    import jax
+    import optax
+
+    loss_fn = make_lm_loss_fn(model, mesh, microbatches)
+
     @jax.jit
     def train_step(state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
@@ -147,6 +164,16 @@ def make_lm_train_step(model, tx, mesh, microbatches=None):
         return {"params": params, "opt_state": opt_state}, loss
 
     return train_step
+
+
+def make_lm_eval_step(model, mesh, microbatches=None):
+    """Jitted held-out loss: ``eval_step(params, tokens) -> loss`` — the
+    training cross-entropy WITHOUT the MoE aux term (no gradients flow,
+    so load balancing is moot, and exp(eval loss) must be a true
+    perplexity), no optimizer update."""
+    import jax
+
+    return jax.jit(make_lm_loss_fn(model, mesh, microbatches, include_aux=False))
 
 
 def throughput_loop(

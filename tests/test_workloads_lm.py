@@ -87,6 +87,35 @@ def test_llama_trains_from_packed_text_file(tmp_path):
     assert result["final_loss"] < 5.0  # well below chance (ln 256 ≈ 5.55)
 
 
+def test_llama_eval_file_reports_heldout_loss(tmp_path):
+    """--eval-file computes held-out loss + perplexity with the training
+    objective, no updates; on a learnable corpus the trained model's eval
+    loss lands below chance."""
+    import numpy as np
+
+    from pytorch_operator_tpu.data import pack_arrays
+
+    tokens = (
+        (np.arange(48)[None, :] + np.arange(64)[:, None]) % 256
+    ).astype(np.int32)
+    train_f, eval_f = tmp_path / "train.bin", tmp_path / "eval.bin"
+    pack_arrays(train_f, {"tokens": tokens})
+    pack_arrays(eval_f, {"tokens": (tokens + 1) % 256})
+
+    result = llama_train.run(
+        config="tiny", mesh_spec="dp=8", batch_size=8, seq_len=48,
+        steps=20, warmup=1, lr=3e-3, data_file=str(train_f),
+        eval_file=str(eval_f), eval_batches=2, log=lambda *_: None,
+    )
+    assert np.isfinite(result["eval_loss"])
+    assert result["eval_loss"] < 5.55  # below ln(256) chance
+    # Both fields are rounded for the JSON line — relative tolerance
+    # covers the rounding at any loss magnitude.
+    assert result["eval_perplexity"] == pytest.approx(
+        np.exp(result["eval_loss"]), rel=2e-2
+    )
+
+
 def test_llama_data_file_validation(tmp_path):
     import numpy as np
     import pytest
