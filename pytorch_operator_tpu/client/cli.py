@@ -251,6 +251,11 @@ def cmd_supervisor(args) -> int:
             sup.process_suspend_markers()
             sup.process_apply_markers()
             sup.sync_once()
+            # Retire reconcile locks of deleted jobs (delete_job can't:
+            # it may run nested under a held lock).
+            sup.reconciler.gc_key_locks(
+                {job_key(j) for j in sup.store.list()}
+            )
             sup.write_metrics_file()
             time.sleep(args.interval)
     except KeyboardInterrupt:

@@ -371,9 +371,27 @@ class Reconciler:
 
     def drop_key_lock(self, key: str) -> None:
         """Retire a deleted job's lock. Benign if the key reappears: the
-        next key_lock() simply mints a fresh Lock."""
+        next key_lock() simply mints a fresh Lock. Callers must NOT hold
+        the lock (popping a held lock lets a concurrent key_lock() mint
+        a second one and race the holder) — long-running daemons use
+        :meth:`gc_key_locks` instead."""
         with self._key_locks_guard:
             self._key_locks.pop(key, None)
+
+    def gc_key_locks(self, live_keys) -> None:
+        """Retire locks of keys no longer in the store (a daemon with
+        high job churn would otherwise leak one lock per key ever seen).
+        Only uncontended locks are dropped: ``acquire(blocking=False)``
+        proves no other thread holds it at pop time. Call from a thread
+        that holds none of them (the daemon loop)."""
+        with self._key_locks_guard:
+            for key in [k for k in self._key_locks if k not in live_keys]:
+                lock = self._key_locks[key]
+                if lock.acquire(blocking=False):
+                    try:
+                        self._key_locks.pop(key, None)
+                    finally:
+                        lock.release()
 
     def sync(self, key: str, now: Optional[float] = None) -> bool:
         """One reconcile pass. Returns True if the job still needs syncing."""

@@ -172,10 +172,13 @@ class JobStore:
             return
         # Atomic: the daemon checks existence first, then reads the
         # content — a plain write_text would expose a just-created empty
-        # file (purge silently read as False).
+        # file (purge silently read as False). The payload spells the
+        # purge request as mode="purge"/"keep" so the literal substring
+        # "purge" appears ONLY when purging — a daemon still running the
+        # legacy substring check must not purge on every delete.
         self._atomic_write(
             self._marker_path(key, "delete"),
-            json.dumps({"purge": purge, "uid": uid}),
+            json.dumps({"mode": "purge" if purge else "keep", "uid": uid}),
         )
 
     def deletion_markers(self) -> List[str]:
@@ -197,7 +200,10 @@ class JobStore:
             return {}
         try:
             rec = json.loads(content)
-            return rec if isinstance(rec, dict) else {}
+            if isinstance(rec, dict):
+                rec["purge"] = rec.get("mode") == "purge"
+                return rec
+            return {}
         except ValueError:
             # Legacy format: bare "purge"/"" string.
             return {"purge": "purge" in content, "uid": ""}

@@ -150,9 +150,12 @@ class Supervisor:
             self.events.drop_job(key)
             if purge_artifacts:
                 purge_job_artifacts(self.state_dir, key)
-        # Job record gone → retire its reconcile lock (a daemon with high
-        # job churn would otherwise leak one Lock per key ever seen).
-        self.reconciler.drop_key_lock(key)
+        # NOTE: the key's reconcile lock is NOT dropped here — delete_job
+        # now runs nested under callers that hold it (apply→submit's
+        # stale reap, the daemon's marker loop), and popping a held RLock
+        # would let a concurrent sync mint a fresh one and race the
+        # holder. Long-running daemons GC retired locks instead
+        # (Reconciler.gc_key_locks, called from the daemon loop).
         return job is not None
 
     def apply(self, job: TPUJob) -> str:
@@ -452,8 +455,23 @@ class Supervisor:
                 uid = self.store.marker_uid(key)
                 cur = self.store.get(key)
                 if cur is not None and uid and cur.metadata.uid != uid:
-                    # The marker targets a PREVIOUS incarnation that a
-                    # resubmit already reaped — never kill the new job.
+                    # The marker targets a PREVIOUS incarnation. Never
+                    # kill the new job — but the old incarnation's
+                    # replica records may still exist (`tpujob submit`
+                    # writes the store record directly, with no runner to
+                    # reap through): leaving them would let the
+                    # reconciler adopt a stale SUCCEEDED exit record and
+                    # complete the new job without running it. Replicas
+                    # created before the new incarnation was accepted are
+                    # provably the old job's.
+                    born = cur.metadata.creation_timestamp or 0.0
+                    stale = [
+                        h.name
+                        for h in self.runner.list_for_job(key)
+                        if h.created_at < born
+                    ]
+                    if stale:
+                        self.runner.delete_many(stale)
                     self.store.clear_deletion_marker(key)
                     continue
                 self.delete_job(key, purge_artifacts=purge)

@@ -41,7 +41,6 @@ class ViTConfig:
     depth: int = 12
     n_heads: int = 12
     d_ff: int = 3072
-    dropout: float = 0.0  # benchmark configs run dropout-free
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     attn_impl: str = "dense"  # "dense" | "flash"
@@ -137,12 +136,17 @@ class EncoderBlock(nn.Module):
 
 
 class ViT(nn.Module):
-    """images [B, H, W, 3] → logits [B, num_classes]."""
+    """images [B, H, W, 3] → logits [B, num_classes].
+
+    Deliberately regularizer-free (no dropout knob): the benchmark/test
+    configs never use one, and a config field no code reads would be a
+    silent no-op trap.
+    """
 
     cfg: ViTConfig
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x):
         cfg = self.cfg
         B = x.shape[0]
         x = x.astype(cfg.dtype)

@@ -191,10 +191,23 @@ def run(
             sys.stderr.flush()
             os._exit(138)
 
-    def open_token_file(path: str, flag: str, seed: int, open: bool = True):
-        """Validate (and optionally open) a packed token file."""
+    validated_files: dict = {}
+
+    def open_token_file(path: str, flag: str, seed: int, do_open: bool = True):
+        """Validate (once per path — the whole-file vocab scan is a full
+        read) and optionally open a packed token file."""
         from ..data import field_max, open_training_loader, read_meta
 
+        if path in validated_files:
+            meta = validated_files[path]
+            if not do_open:
+                return None, meta
+            return (
+                open_training_loader(
+                    path, batch, seed=seed, processes=jax.process_count()
+                ),
+                meta,
+            )
         meta = read_meta(path)
         names = [f.name for f in meta.fields]
         if "tokens" not in names:
@@ -227,7 +240,8 @@ def run(
             raise ValueError(
                 f"{flag} token id {top} >= model vocab {cfg.vocab_size}"
             )
-        if not open:
+        validated_files[path] = meta
+        if not do_open:
             return None, meta
         return (
             open_training_loader(
@@ -245,7 +259,7 @@ def run(
         # a bad eval file must not destroy a finished run's output.
         if eval_batches < 1:
             raise ValueError(f"eval_batches must be >= 1, got {eval_batches}")
-        open_token_file(eval_file, "--eval-file", seed=1, open=False)
+        open_token_file(eval_file, "--eval-file", seed=1, do_open=False)
 
     loader = None
     if data_file:

@@ -312,57 +312,37 @@ def run_benchmark(
                 )
         float(jax.device_get(loss))
 
-        from .trainer import maybe_profile
+        from .trainer import timed_windows
 
         if profile_dir and windows > 1:
             # The trace must show exactly the run the reported number
             # comes from — one sustained window, nothing else.
             log("[resnet] --profile-dir set: timing a single window")
             windows = 1
-        n_win = max(windows, 1)
-        dt = math.inf
-        if not profile_dir and n_win > 1:
-            # Protocol A: fenced windows, min-time estimator (round 1).
-            # At n_win == 1 the two protocols are the same measurement —
-            # skip A rather than running every step twice.
-            for _ in range(n_win):
-                t0 = time.time()
-                for _ in range(steps // chunk):
-                    bx, by = next_batches()
-                    params, batch_stats, opt_state, loss = train_chunk(
-                        params, batch_stats, opt_state, bx, by
-                    )
-                final_loss = float(jax.device_get(loss))
-                dt = min(dt, time.time() - t0)
-        with maybe_profile(profile_dir, lambda m: log(f"[resnet] {m}")):
-            # Protocol B (headline): windows pipelined with depth-1
-            # lookahead — window i-1's loss is fenced after dispatching
-            # window i, so the device never idles on a fence but the
-            # queue stays 1 deep (deeper queues hold one un-donatable
-            # train-state copy per in-flight dispatch; measured 3x
-            # slower on HBM-filling models — vit_bench).
-            t0 = time.time()
-            prev = None
-            for _ in range(n_win):
-                for _ in range(steps // chunk):
-                    bx, by = next_batches()
-                    params, batch_stats, opt_state, loss = train_chunk(
-                        params, batch_stats, opt_state, bx, by
-                    )
-                if prev is not None:
-                    float(jax.device_get(prev))
-                prev = loss
-            final_loss = float(jax.device_get(loss))
-            # dt is taken here, before stop_trace() flushes the trace.
-            dt_sustained = time.time() - t0
+
+        def run_window():
+            nonlocal params, batch_stats, opt_state, loss
+            for _ in range(steps // chunk):
+                bx, by = next_batches()
+                params, batch_stats, opt_state, loss = train_chunk(
+                    params, batch_stats, opt_state, bx, by
+                )
+            return loss
+
+        dt, dt_sustained, n_win = timed_windows(
+            run_window,
+            lambda tok: float(jax.device_get(tok)),
+            windows=windows,
+            profile_dir=profile_dir,
+            log=lambda m: log(f"[resnet] {m}"),
+        )
+        final_loss = float(jax.device_get(loss))
     finally:
         if loader is not None:
             loader.close()
 
-    if not math.isfinite(dt) and not profile_dir:
-        dt = dt_sustained  # n_win == 1: the sustained window IS the window
     min_window_per_chip = (
-        batch * steps / dt / n_dev if math.isfinite(dt) else None
+        batch * steps / dt / n_dev if dt is not None else None
     )
     sustained_steps = steps * n_win
     images_per_sec = batch * sustained_steps / dt_sustained

@@ -176,6 +176,50 @@ def make_lm_eval_step(model, mesh, microbatches=None):
     return jax.jit(make_lm_loss_fn(model, mesh, microbatches, include_aux=False))
 
 
+def timed_windows(run_window, fence, *, windows, profile_dir=None, log=print):
+    """The dual benchmark protocol shared by the image benches
+    (resnet_bench / vit_bench — one definition so protocol fixes cannot
+    skew one benchmark relative to the other):
+
+    - Protocol A: fenced windows, min-time estimator (round-1 protocol;
+      skipped when ``windows == 1`` — identical to B then — or when
+      profiling, so the trace shows exactly the headline run).
+    - Protocol B (headline): the same windows pipelined with depth-1
+      lookahead — window i-1's token is fenced after dispatching window
+      i, so the device never idles on a fence but the dispatch queue
+      stays 1 deep (deeper queues hold one un-donatable train-state copy
+      per in-flight dispatch; measured 3x slower on HBM-filling models).
+
+    ``run_window()`` dispatches one window and returns a fence token;
+    ``fence(token)`` performs a REAL host transfer on it. Returns
+    ``(dt_min_window | None, dt_sustained_total, n_win)``.
+    """
+    import math as _math
+    import time as _time
+
+    n_win = max(windows, 1)
+    dt = _math.inf
+    if not profile_dir and n_win > 1:
+        for _ in range(n_win):
+            t0 = _time.time()
+            fence(run_window())
+            dt = min(dt, _time.time() - t0)
+    with maybe_profile(profile_dir, log):
+        t0 = _time.time()
+        prev = None
+        for _ in range(n_win):
+            tok = run_window()
+            if prev is not None:
+                fence(prev)
+            prev = tok
+        fence(prev)
+        # dt_sustained is taken here, before stop_trace() flushes.
+        dt_sustained = _time.time() - t0
+    if not _math.isfinite(dt):
+        dt = None if profile_dir else dt_sustained / n_win
+    return dt, dt_sustained, n_win
+
+
 def throughput_loop(
     train_step,
     state,

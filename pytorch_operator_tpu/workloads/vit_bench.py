@@ -124,44 +124,31 @@ def run_benchmark(
             log(f"[vit] first chunk ({chunk} steps, compile) +{time.time() - t_start:.1f}s")
     float(jax.device_get(loss))
 
-    from .trainer import maybe_profile
+    from .trainer import timed_windows
 
     if profile_dir and windows > 1:
         log("[vit] --profile-dir set: timing a single window")
         windows = 1
-    n_win = max(windows, 1)
-    dt = math.inf
-    if not profile_dir and n_win > 1:
-        for _ in range(n_win):
-            t0 = time.time()
-            for _ in range(steps // chunk):
-                params, opt_state, loss = train_chunk(params, opt_state, gx, gy)
-            final_loss = float(jax.device_get(loss))
-            dt = min(dt, time.time() - t0)
-    with maybe_profile(profile_dir, lambda m: log(f"[vit] {m}")):
-        # Sustained: depth-1 lookahead — fence window i-1 after
-        # dispatching window i. The device never idles on the fence, but
-        # the dispatch queue stays 1 deep: with donated train state,
-        # deeper queues hold one un-donatable state copy per in-flight
-        # dispatch and thrash HBM (measured 3x slower at depth 5 on
-        # ViT-B, which fills most of the chip).
-        t0 = time.time()
-        prev = None
-        for _ in range(n_win):
-            for _ in range(steps // chunk):
-                params, opt_state, loss = train_chunk(params, opt_state, gx, gy)
-            if prev is not None:
-                float(jax.device_get(prev))
-            prev = loss
-        final_loss = float(jax.device_get(loss))
-        dt_sustained = time.time() - t0
-    if not math.isfinite(dt):
-        dt = dt_sustained
+
+    def run_window():
+        nonlocal params, opt_state, loss
+        for _ in range(steps // chunk):
+            params, opt_state, loss = train_chunk(params, opt_state, gx, gy)
+        return loss
+
+    dt, dt_sustained, n_win = timed_windows(
+        run_window,
+        lambda tok: float(jax.device_get(tok)),
+        windows=windows,
+        profile_dir=profile_dir,
+        log=lambda m: log(f"[vit] {m}"),
+    )
+    final_loss = float(jax.device_get(loss))
 
     sustained_steps = steps * n_win
     images_per_sec = batch * sustained_steps / dt_sustained
     per_chip = images_per_sec / n_dev
-    min_window = batch * steps / dt / n_dev
+    min_window = batch * steps / dt / n_dev if dt is not None else None
     rendezvous.report_metrics(
         sustained_steps,
         images_per_sec=images_per_sec,
@@ -171,13 +158,20 @@ def run_benchmark(
         f"[vit] sustained {sustained_steps} steps in {dt_sustained:.2f}s: "
         f"{per_chip:.1f} images/sec/chip, "
         f"{1000 * dt_sustained / sustained_steps:.1f} ms/step, "
-        f"loss={final_loss:.3f} (min fenced window: {min_window:.1f})"
+        f"loss={final_loss:.3f} "
+        + (
+            f"(min fenced window: {min_window:.1f})"
+            if min_window is not None
+            else "(fenced windows skipped: profiling)"
+        )
     )
     return {
         "metric": f"vit_{variant}_train_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
-        "min_window_images_per_sec_per_chip": round(min_window, 2),
+        "min_window_images_per_sec_per_chip": (
+            round(min_window, 2) if min_window is not None else None
+        ),
         "params_m": round(n_params / 1e6, 1),
         "global_batch": batch,
         "devices": n_dev,
