@@ -276,6 +276,13 @@ def cmd_get(args) -> int:
         if not jobs:
             print(f"error: tpujob {_resolve_key(args)} not found", file=sys.stderr)
             return 1
+    if getattr(args, "json", False):
+        # kubectl -o json analog: the full stored objects, parseable.
+        out = [j.to_dict() for j in sorted(
+            jobs, key=lambda j: j.metadata.creation_timestamp or 0
+        )]
+        print(json.dumps(out[0] if args.name and len(out) == 1 else out, indent=2))
+        return 0
     # QUEUE/PRIORITY columns appear only when some job sets them — the
     # default listing stays as terse as kubectl's.
     show_sched = any(
@@ -354,6 +361,9 @@ def cmd_describe(args) -> int:
     if job is None:
         print(f"error: tpujob {key} not found", file=sys.stderr)
         return 1
+    if getattr(args, "json", False):
+        print(json.dumps(job.to_dict(), indent=2))
+        return 0
     print(f"Name:       {job.metadata.name}")
     print(f"Namespace:  {job.metadata.namespace}")
     print(f"UID:        {job.metadata.uid}")
@@ -649,11 +659,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("get", help="list jobs")
     sp.add_argument("name", nargs="?")
+    sp.add_argument(
+        "--json", action="store_true",
+        help="full job objects as JSON (kubectl -o json analog)",
+    )
     add_ns(sp)
     sp.set_defaults(func=cmd_get)
 
     sp = sub.add_parser("describe", help="show job details and events")
     sp.add_argument("name")
+    sp.add_argument(
+        "--json", action="store_true",
+        help="the full job object as JSON (kubectl -o json analog)",
+    )
     add_ns(sp)
     sp.set_defaults(func=cmd_describe)
 

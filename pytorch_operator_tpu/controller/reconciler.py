@@ -369,21 +369,14 @@ class Reconciler:
         with self._key_locks_guard:
             return self._key_locks.setdefault(key, threading.RLock())
 
-    def drop_key_lock(self, key: str) -> None:
-        """Retire a deleted job's lock. Benign if the key reappears: the
-        next key_lock() simply mints a fresh Lock. Callers must NOT hold
-        the lock (popping a held lock lets a concurrent key_lock() mint
-        a second one and race the holder) — long-running daemons use
-        :meth:`gc_key_locks` instead."""
-        with self._key_locks_guard:
-            self._key_locks.pop(key, None)
-
     def gc_key_locks(self, live_keys) -> None:
         """Retire locks of keys no longer in the store (a daemon with
         high job churn would otherwise leak one lock per key ever seen).
-        Only uncontended locks are dropped: ``acquire(blocking=False)``
-        proves no other thread holds it at pop time. Call from a thread
-        that holds none of them (the daemon loop)."""
+        Only uncontended locks are dropped — ``acquire(blocking=False)``
+        proves no other thread holds it at pop time; popping a HELD lock
+        would let a concurrent key_lock() mint a second one and race the
+        holder (the reason the old per-delete drop_key_lock is gone).
+        Call from a thread that holds none of them (the daemon loop)."""
         with self._key_locks_guard:
             for key in [k for k in self._key_locks if k not in live_keys]:
                 lock = self._key_locks[key]

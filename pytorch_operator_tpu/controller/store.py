@@ -201,7 +201,11 @@ class JobStore:
         try:
             rec = json.loads(content)
             if isinstance(rec, dict):
-                rec["purge"] = rec.get("mode") == "purge"
+                if "mode" in rec:
+                    rec["purge"] = rec["mode"] == "purge"
+                else:
+                    # Transitional JSON format carried a bare bool.
+                    rec["purge"] = bool(rec.get("purge"))
                 return rec
             return {}
         except ValueError:

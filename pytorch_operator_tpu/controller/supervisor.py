@@ -468,7 +468,11 @@ class Supervisor:
                     stale = [
                         h.name
                         for h in self.runner.list_for_job(key)
-                        if h.created_at < born
+                        # created_at == 0.0 means the record predates the
+                        # field (unknown age) — never treat unknown as
+                        # provably-old; this branch must not be able to
+                        # kill the new incarnation.
+                        if h.created_at and h.created_at < born
                     ]
                     if stale:
                         self.runner.delete_many(stale)

@@ -43,6 +43,8 @@ def run(
     steps: int = 30,
     warmup: int = 2,
     lr: float = 1e-4,
+    lr_warmup_steps: int = 0,
+    grad_clip: float | None = None,
     num_classes: int = 2,
     profile_dir: str | None = None,
     log=print,
@@ -69,7 +71,21 @@ def run(
         f"batch={batch} seq={seq_len} ({jax.devices()[0].platform})"
     )
 
-    tx = optax.adamw(lr, weight_decay=0.01)
+    # Standard fine-tune recipe knobs (mirroring llama_train): linear
+    # warmup when requested, optional global-norm clipping.
+    sched = (
+        optax.warmup_cosine_decay_schedule(
+            0.0, lr, max(lr_warmup_steps, 1),
+            max(steps + max(warmup, 1), lr_warmup_steps + 1),
+        )
+        if lr_warmup_steps > 0
+        else lr
+    )
+    tx = optax.adamw(sched, weight_decay=0.01)
+    if grad_clip is not None:
+        if grad_clip <= 0:
+            raise ValueError(f"grad_clip must be positive, got {grad_clip}")
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
     t_init = time.time()
     state, _ = init_sharded_train_state(
         lambda k: model.init(k, np.zeros((1, seq_len), np.int32)), tx, mesh
@@ -172,6 +188,14 @@ def main(argv=None) -> int:
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument(
+        "--lr-warmup-steps", type=int, default=0,
+        help="linear warmup to --lr then cosine decay (0 = constant lr)",
+    )
+    p.add_argument(
+        "--grad-clip", type=float, default=None,
+        help="clip gradients to this global norm",
+    )
+    p.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace of the timed window here",
     )
@@ -187,6 +211,8 @@ def main(argv=None) -> int:
         steps=args.steps,
         warmup=args.warmup,
         lr=args.lr,
+        lr_warmup_steps=args.lr_warmup_steps,
+        grad_clip=args.grad_clip,
         profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"

@@ -28,6 +28,26 @@ def run_cli(*argv) -> int:
 
 
 class TestCLI:
+    def test_get_describe_json_output(self, tmp_path, job_yaml, capsys):
+        """kubectl -o json analog: parseable full objects round-trip."""
+        import json as _json
+
+        from pytorch_operator_tpu.api.types import TPUJob
+
+        state = tmp_path / "state"
+        assert run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30") == 0
+        capsys.readouterr()
+
+        assert run_cli("--state-dir", state, "get", "--json") == 0
+        listed = _json.loads(capsys.readouterr().out)
+        assert isinstance(listed, list) and len(listed) == 1
+
+        assert run_cli("--state-dir", state, "describe", "cli-job", "--json") == 0
+        obj = _json.loads(capsys.readouterr().out)
+        job = TPUJob.from_dict(obj)  # parseable AND loadable
+        assert job.metadata.name == "cli-job"
+        assert job.is_succeeded()
+
     def test_run_get_describe_logs(self, tmp_path, job_yaml, capsys):
         state = tmp_path / "state"
         rc = run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30")
