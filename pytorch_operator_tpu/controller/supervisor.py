@@ -465,14 +465,21 @@ class Supervisor:
                     # created before the new incarnation was accepted are
                     # provably the old job's.
                     born = cur.metadata.creation_timestamp or 0.0
+                    # created_at == 0.0 means the record predates the
+                    # field (unknown age). Unknown-age ACTIVE replicas
+                    # are spared — this branch must never be able to
+                    # kill the new incarnation's running world — but
+                    # unknown-age FINISHED records are reaped: leaving a
+                    # stale SUCCEEDED exit record would let the
+                    # reconciler adopt it and complete the new job
+                    # without running it, and reaping a finished record
+                    # can at worst trigger a re-create, never kill live
+                    # work.
                     stale = [
                         h.name
                         for h in self.runner.list_for_job(key)
-                        # created_at == 0.0 means the record predates the
-                        # field (unknown age) — never treat unknown as
-                        # provably-old; this branch must not be able to
-                        # kill the new incarnation.
-                        if h.created_at and h.created_at < born
+                        if (h.created_at and h.created_at < born)
+                        or (not h.created_at and h.is_finished())
                     ]
                     if stale:
                         self.runner.delete_many(stale)

@@ -123,3 +123,58 @@ def import_hf_llama_state_dict(sd: Dict[str, Any], cfg) -> dict:
         },
     }
     return params
+
+
+def export_hf_llama_state_dict(params, cfg) -> Dict[str, np.ndarray]:
+    """The inverse of :func:`import_hf_llama_state_dict`: this package's
+    flax ``params`` tree (boxed or not) → an HF-layout state_dict of
+    numpy f32 arrays, so a model trained here can be handed back to a
+    PyTorch/HF stack. Round-trip is exact (tests/test_llama_import.py).
+    """
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "HF export for MoE configs is not implemented (dense Llama only)"
+        )
+
+    def unbox(tree):
+        leaves = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                leaves[k] = unbox(v)
+            else:
+                leaves[k] = _np(v.unbox() if hasattr(v, "unbox") else v)
+        return leaves
+
+    p = unbox(params)
+    L = cfg.n_layers
+    H, K, D, hd = cfg.n_heads, cfg.n_kv_heads, cfg.d_model, cfg.head_dim
+
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": p["embed"]["embedding"],
+        "model.norm.weight": p["final_norm"]["scale"],
+        "lm_head.weight": p["lm_head"]["kernel"].T,
+    }
+    lay = p["layers"]
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        sd[pre + "input_layernorm.weight"] = lay["attn_norm"]["scale"][i]
+        sd[pre + "post_attention_layernorm.weight"] = lay["mlp_norm"]["scale"][i]
+        # flax kernel [D, h, hd] → torch Linear [h*hd, D].
+        sd[pre + "self_attn.q_proj.weight"] = (
+            lay["attn"]["q_proj"]["kernel"][i].reshape(D, H * hd).T
+        )
+        sd[pre + "self_attn.k_proj.weight"] = (
+            lay["attn"]["k_proj"]["kernel"][i].reshape(D, K * hd).T
+        )
+        sd[pre + "self_attn.v_proj.weight"] = (
+            lay["attn"]["v_proj"]["kernel"][i].reshape(D, K * hd).T
+        )
+        sd[pre + "self_attn.o_proj.weight"] = lay["attn"]["o_proj"]["kernel"][i].T
+        sd[pre + "mlp.gate_proj.weight"] = lay["mlp"]["gate_proj"]["kernel"][i].T
+        sd[pre + "mlp.up_proj.weight"] = lay["mlp"]["up_proj"]["kernel"][i].T
+        sd[pre + "mlp.down_proj.weight"] = lay["mlp"]["down_proj"]["kernel"][i].T
+    # np.array (not asarray): exactly ONE cast+copy per tensor, producing
+    # WRITABLE contiguous buffers — views over JAX-backed arrays are
+    # read-only (torch.from_numpy warns, in-place fine-tune writes would
+    # be UB) and would alias the source flax tree.
+    return {k: np.array(v, np.float32) for k, v in sd.items()}

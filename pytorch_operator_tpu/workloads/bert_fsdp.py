@@ -71,21 +71,17 @@ def run(
         f"batch={batch} seq={seq_len} ({jax.devices()[0].platform})"
     )
 
-    # Standard fine-tune recipe knobs (mirroring llama_train): linear
-    # warmup when requested, optional global-norm clipping.
-    sched = (
-        optax.warmup_cosine_decay_schedule(
-            0.0, lr, max(lr_warmup_steps, 1),
-            max(steps + max(warmup, 1), lr_warmup_steps + 1),
-        )
-        if lr_warmup_steps > 0
-        else lr
+    # Shared recipe helper (one definition with llama_train).
+    from .trainer import make_optimizer
+
+    tx = make_optimizer(
+        lr,
+        schedule="cosine" if lr_warmup_steps > 0 else "constant",
+        warmup_steps=lr_warmup_steps,
+        decay_steps=steps + max(warmup, 1),
+        grad_clip=grad_clip,
+        weight_decay=0.01,
     )
-    tx = optax.adamw(sched, weight_decay=0.01)
-    if grad_clip is not None:
-        if grad_clip <= 0:
-            raise ValueError(f"grad_clip must be positive, got {grad_clip}")
-        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
     t_init = time.time()
     state, _ = init_sharded_train_state(
         lambda k: model.init(k, np.zeros((1, seq_len), np.int32)), tx, mesh

@@ -143,30 +143,21 @@ def run(
         f"({jax.devices()[0].platform})"
     )
 
-    # Optimizer: AdamW with an optional schedule (linear warmup + cosine
-    # decay — the standard LM recipe) and optional global-norm clipping.
-    if lr_schedule == "cosine":
-        # Default horizon: --max-steps when set (the GLOBAL step budget,
-        # correct across checkpoint resumes — the restored optimizer
-        # count is global), else this life's steps+warmup. A resumed run
-        # without --max-steps or --lr-decay-steps would otherwise train
-        # its tail at LR ~0.
-        total = lr_decay_steps or max_steps or (steps + max(warmup, 1))
-        sched = optax.warmup_cosine_decay_schedule(
-            init_value=0.0,
-            peak_value=lr,
-            warmup_steps=max(lr_warmup_steps, 1),
-            decay_steps=max(total, lr_warmup_steps + 1),
-        )
-    elif lr_schedule == "constant":
-        sched = lr
-    else:
-        raise ValueError(f"lr_schedule={lr_schedule!r} not in ('constant', 'cosine')")
-    tx = optax.adamw(sched, weight_decay=0.1)
-    if grad_clip is not None:
-        if grad_clip <= 0:
-            raise ValueError(f"grad_clip must be positive, got {grad_clip}")
-        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    # Optimizer via the shared recipe helper. Cosine horizon default:
+    # --max-steps when set (the GLOBAL step budget, correct across
+    # checkpoint resumes — the restored optimizer count is global), else
+    # this life's steps+warmup; a resumed run without --max-steps or
+    # --lr-decay-steps would otherwise train its tail at LR ~0.
+    from .trainer import make_optimizer
+
+    tx = make_optimizer(
+        lr,
+        schedule=lr_schedule,
+        warmup_steps=lr_warmup_steps,
+        decay_steps=lr_decay_steps or max_steps or (steps + max(warmup, 1)),
+        grad_clip=grad_clip,
+        weight_decay=0.1,
+    )
     t_init = time.time()
     state, _ = init_sharded_train_state(
         lambda k: model.init(k, np.zeros((1, seq_len), np.int32)), tx, mesh

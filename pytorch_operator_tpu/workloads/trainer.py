@@ -55,6 +55,40 @@ def init_sharded_train_state(model_init: Callable, tx, mesh):
     return init_sharded(init_state, mesh, jax.random.key(int(os.environ.get("TPUJOB_SEED", "0"))))
 
 
+def make_optimizer(
+    lr,
+    *,
+    schedule: str = "constant",
+    warmup_steps: int = 0,
+    decay_steps=None,
+    grad_clip=None,
+    weight_decay: float = 0.1,
+):
+    """The shared AdamW recipe (llama_train and bert_fsdp both use it —
+    one definition so schedule/clipping fixes cannot drift per workload):
+    optional linear-warmup + cosine decay, optional global-norm clipping.
+    """
+    import optax
+
+    if schedule == "cosine":
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=max(decay_steps or warmup_steps + 1, warmup_steps + 1),
+        )
+    elif schedule == "constant":
+        sched = lr
+    else:
+        raise ValueError(f"schedule={schedule!r} not in ('constant', 'cosine')")
+    tx = optax.adamw(sched, weight_decay=weight_decay)
+    if grad_clip is not None:
+        if grad_clip <= 0:
+            raise ValueError(f"grad_clip must be positive, got {grad_clip}")
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    return tx
+
+
 def make_lm_loss_fn(model, mesh, microbatches=None, include_aux=True):
     """Next-token cross-entropy ``loss_fn(params, tokens)`` — the shared
     objective behind the train step and held-out evaluation.

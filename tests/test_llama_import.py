@@ -15,7 +15,10 @@ import pytest
 
 import tests.jaxenv  # noqa: F401
 from pytorch_operator_tpu.models import llama as llama_lib
-from pytorch_operator_tpu.models.llama_import import import_hf_llama_state_dict
+from pytorch_operator_tpu.models.llama_import import (
+    export_hf_llama_state_dict,
+    import_hf_llama_state_dict,
+)
 
 torch = pytest.importorskip("torch")
 
@@ -154,6 +157,43 @@ class TestLlamaImport:
             params["lm_head"]["kernel"],
             params["embed"]["embedding"].T,
         )
+
+    def test_export_round_trips_exactly(self):
+        """import(export(params)) == params, and export reproduces the
+        original state_dict tensors — both directions are lossless."""
+        import jax
+
+        cfg = _cfg()
+        sd = _random_state_dict(cfg)
+        params = import_hf_llama_state_dict(sd, cfg)
+        sd2 = export_hf_llama_state_dict(params, cfg)
+        assert set(sd2) == set(sd)
+        for k in sd:
+            np.testing.assert_allclose(
+                sd2[k], sd[k].numpy(), rtol=0, atol=0, err_msg=k
+            )
+        params2 = import_hf_llama_state_dict(sd2, cfg)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_export_trained_flax_params(self):
+        """Params born in THIS framework (flax init, boxed metadata)
+        export to a state_dict the torch reference can run."""
+        import flax.linen as nn
+        import jax
+
+        cfg = _cfg()
+        model = llama_lib.Llama(cfg)
+        variables = model.init(jax.random.key(5), np.zeros((1, 8), np.int32))
+        sd = export_hf_llama_state_dict(variables["params"], cfg)  # boxed ok
+        tokens = np.random.default_rng(6).integers(0, 64, (2, 8)).astype(np.int32)
+        ref = _torch_reference_forward(
+            {k: torch.from_numpy(v) for k, v in sd.items()}, cfg, tokens
+        )
+        ours = np.asarray(
+            model.apply({"params": nn.meta.unbox(variables["params"])}, tokens)
+        )
+        np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
 
     def test_moe_config_rejected_up_front(self):
         cfg = llama_lib.llama_tiny(n_experts=4)
