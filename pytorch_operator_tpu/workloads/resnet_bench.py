@@ -197,22 +197,15 @@ def run_benchmark(
     from .datasets import synthetic_images
 
     warmup = max(warmup, 1)  # the first (compile) step can never be timed
-    meta = None
     if data_file:
         from ..data import read_meta
 
-        meta = read_meta(data_file)
-        names = [f.name for f in meta.fields]
-        if "x" not in names or "y" not in names:
-            raise ValueError(
-                f"--data-file needs fields named 'x' (images) and 'y' "
-                f"(labels); {data_file} has {names} "
-                f"(pack with pytorch_operator_tpu.data.pack)"
-            )
-        field_x = next(f for f in meta.fields if f.name == "x")
         # ResNet params are spatial-size-independent (convs + global pool),
         # so the file's H suffices for init; batches carry the real (H, W).
-        image_size = field_x.shape[0]
+        # Full validation + loader open happens in open_image_feed below.
+        fields = {f.name: f for f in read_meta(data_file).fields}
+        if "x" in fields:
+            image_size = fields["x"].shape[0]
     model = resnet_lib.BY_DEPTH[depth](
         num_classes=classes, bn_f32_stats=bn_f32_stats, s2d_stem=s2d_stem
     )
@@ -220,13 +213,9 @@ def run_benchmark(
     n_dev = jax.device_count()
     mesh = make_mesh({"dp": n_dev})
     batch = max(batch_size // n_dev, 1) * n_dev
-    if meta is not None and batch > meta.n_records:
-        raise ValueError(
-            f"--data-file holds {meta.n_records} records < global batch {batch}"
-        )
     geometry = (
-        "x".join(str(s) for s in field_x.shape[:2]) + "px"
-        if meta is not None
+        "x".join(str(s) for s in fields["x"].shape[:2]) + "px"
+        if data_file and "x" in fields
         else f"{image_size}px"
     )
     log(
@@ -254,39 +243,11 @@ def run_benchmark(
 
     loader = None
     if data_file:
-        from jax.sharding import NamedSharding, PartitionSpec
+        from .trainer import open_image_feed
 
-        from ..data import open_training_loader
-        from ..parallel.data import put_global
-
-        loader = open_training_loader(
-            data_file, batch, seed=0, processes=jax.process_count()
+        next_batches, loader, _ = open_image_feed(
+            data_file, batch=batch, chunk=chunk, classes=classes, mesh=mesh
         )
-        x_sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
-        _, _, first = loader.next_batch()
-        if int(first["y"].max()) >= classes:
-            loader.close()
-            raise ValueError(
-                f"--data-file labels reach {int(first['y'].max())} but the "
-                f"model head has {classes} classes (pass --classes)"
-            )
-
-        def next_batches():
-            """chunk loader batches stacked [chunk, B, ...], one transfer.
-
-            The loader hands out zero-copy views into a slot it reuses on
-            the next call, so stashed data MUST be copied out — done here
-            by assigning into preallocated stacks (one cast/copy pass, no
-            second np.stack copy; this path is already input-bound).
-            """
-            sx = np.empty((chunk, batch) + field_x.shape, jnp.bfloat16)
-            sy = np.empty((chunk, batch), np.int32)
-            for i in range(chunk):
-                _, _, fields = loader.next_batch()
-                sx[i] = fields["x"]  # casts f32 → bf16 in place
-                sy[i] = fields["y"]
-            return put_global(sx, x_sh), put_global(sy, x_sh)
-
         train_chunk = make_train_chunk_fed(model, tx)
     else:
         train_chunk = make_train_chunk(model, tx, chunk)

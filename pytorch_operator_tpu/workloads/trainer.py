@@ -55,6 +55,87 @@ def init_sharded_train_state(model_init: Callable, tx, mesh):
     return init_sharded(init_state, mesh, jax.random.key(int(os.environ.get("TPUJOB_SEED", "0"))))
 
 
+def open_image_feed(
+    data_file: str,
+    *,
+    batch: int,
+    chunk: int,
+    classes: int,
+    mesh,
+    square: bool = False,
+    seed: int = 0,
+):
+    """Validate + open a packed image file and return ``(next_batches,
+    loader, field_x)`` — the real-data feed both image benches share
+    (one definition so validation/feed fixes cannot drift per bench).
+
+    ``next_batches()`` returns ``chunk`` loader batches stacked
+    ``[chunk, B, ...]`` as device arrays (bf16 images, i32 labels, one
+    host transfer each). The loader hands out zero-copy views into a
+    reused slot, so the copy into the stacked buffers is mandatory.
+    Labels are range-checked against ``classes`` on the first call
+    (out-of-range labels one_hot to all-zero rows and silently deflate
+    the loss). ``square=True`` additionally requires H == W (ViT's
+    position embeddings; ResNet is spatial-size-independent).
+    Caller owns ``loader.close()``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..data import open_training_loader, read_meta
+    from ..parallel.data import put_global
+
+    meta = read_meta(data_file)
+    names = [f.name for f in meta.fields]
+    if "x" not in names or "y" not in names:
+        raise ValueError(
+            f"--data-file needs fields named 'x' (images) and 'y' (labels); "
+            f"{data_file} has {names} (pack with pytorch_operator_tpu.data.pack)"
+        )
+    field_x = next(f for f in meta.fields if f.name == "x")
+    if len(field_x.shape) != 3:
+        raise ValueError(
+            f"--data-file 'x' records must be HxWxC images; got shape "
+            f"{field_x.shape}"
+        )
+    if square and field_x.shape[0] != field_x.shape[1]:
+        raise ValueError(
+            f"--data-file images must be square (H == W) for this model; "
+            f"got {field_x.shape[0]}x{field_x.shape[1]}"
+        )
+    if meta.n_records < batch:
+        raise ValueError(
+            f"--data-file holds {meta.n_records} records < global batch {batch}"
+        )
+    loader = open_training_loader(
+        data_file, batch, seed=seed, processes=jax.process_count()
+    )
+    x_sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
+    checked = False
+
+    def next_batches():
+        nonlocal checked
+        sx = np.empty((chunk, batch) + field_x.shape, jnp.bfloat16)
+        sy = np.empty((chunk, batch), np.int32)
+        for i in range(chunk):
+            _, _, fields = loader.next_batch()
+            sx[i] = fields["x"]  # casts f32 → bf16 in place
+            sy[i] = fields["y"]
+        if not checked:
+            top = int(sy.max())
+            if top >= classes:
+                raise ValueError(
+                    f"--data-file labels reach {top} but the model head has "
+                    f"{classes} classes (pass --classes)"
+                )
+            checked = True
+        return put_global(sx, x_sh), put_global(sy, x_sh)
+
+    return next_batches, loader, field_x
+
+
 def make_optimizer(
     lr,
     *,

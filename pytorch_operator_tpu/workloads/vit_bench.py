@@ -18,12 +18,8 @@ import time
 from ..runtime import rendezvous
 
 
-def make_train_chunk(model, tx, chunk: int, label_smoothing: float = 0.1):
-    """``chunk`` AdamW train steps fused into ONE dispatch (donated state)."""
-    import functools
-
+def _step_fn(model, tx, label_smoothing: float = 0.1):
     import jax
-    import jax.numpy as jnp
     import optax
 
     def step(params, opt_state, bx, by):
@@ -39,6 +35,18 @@ def make_train_chunk(model, tx, chunk: int, label_smoothing: float = 0.1):
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
+    return step
+
+
+def make_train_chunk(model, tx, chunk: int, label_smoothing: float = 0.1):
+    """``chunk`` AdamW train steps fused into ONE dispatch (donated state)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    step = _step_fn(model, tx, label_smoothing)
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_chunk(params, opt_state, bx, by):
         def body(_, s):
@@ -48,6 +56,32 @@ def make_train_chunk(model, tx, chunk: int, label_smoothing: float = 0.1):
         return jax.lax.fori_loop(
             0, chunk, body, (params, opt_state, jnp.zeros((), jnp.float32))
         )
+
+    return train_chunk
+
+
+def make_train_chunk_fed(model, tx, label_smoothing: float = 0.1):
+    """Like :func:`make_train_chunk`, but each fused step consumes its
+    OWN batch (stacked ``[chunk, B, ...]``, one host transfer per chunk)
+    — the real-data path, mirroring resnet_bench's."""
+    import functools
+
+    import jax
+
+    step = _step_fn(model, tx, label_smoothing)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_chunk(params, opt_state, bxs, bys):
+        def body(s, batch):
+            params, opt_state = s
+            bx, by = batch
+            params, opt_state, loss = step(params, opt_state, bx, by)
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), (bxs, bys)
+        )
+        return params, opt_state, losses[-1]
 
     return train_chunk
 
@@ -63,6 +97,7 @@ def run_benchmark(
     lr: float = 1e-3,
     windows: int = 1,
     attn_impl: str = "dense",
+    data_file: str | None = None,
     profile_dir: str | None = None,
     log=print,
 ) -> dict:
@@ -75,6 +110,15 @@ def run_benchmark(
     from ..parallel.data import global_batch
     from .datasets import synthetic_images
 
+    if data_file:
+        from ..data import read_meta
+
+        # Geometry from the file; full validation (incl. the H == W
+        # requirement ViT's position embeddings impose) + loader open
+        # happens in open_image_feed below.
+        fields = {f.name: f for f in read_meta(data_file).fields}
+        if "x" in fields:
+            image_size = fields["x"].shape[0]
     cfg = vit_lib.BY_NAME[variant](
         image_size=image_size, num_classes=classes, attn_impl=attn_impl
     )
@@ -85,7 +129,8 @@ def run_benchmark(
     log(
         f"[vit] ViT-{variant} d={cfg.d_model} depth={cfg.depth} on {n_dev} "
         f"device(s) ({jax.devices()[0].platform}), global batch {batch}, "
-        f"{image_size}px, attn={attn_impl} (synthetic)"
+        f"{image_size}px, attn={attn_impl}"
+        + (f", data file {data_file}" if data_file else " (synthetic)")
     )
 
     tx = optax.adamw(lr, weight_decay=0.05)
@@ -110,40 +155,59 @@ def run_benchmark(
     chunk = min(30, max(steps, 1))
     steps = math.ceil(max(steps, 1) / chunk) * chunk
     warm_chunks = max(1, round(max(warmup, 1) / chunk))
-    train_chunk = make_train_chunk(model, tx, chunk)
-    hx, hy = synthetic_images(batch, image_size, image_size, classes)
-    gx = global_batch(hx.astype(jnp.bfloat16), mesh)
-    gy = global_batch(hy, mesh)
+    loader = None
+    if data_file:
+        from .trainer import open_image_feed
+
+        next_batches, loader, _ = open_image_feed(
+            data_file, batch=batch, chunk=chunk, classes=classes, mesh=mesh,
+            square=True,
+        )
+        train_chunk = make_train_chunk_fed(model, tx)
+    else:
+        train_chunk = make_train_chunk(model, tx, chunk)
+        hx, hy = synthetic_images(batch, image_size, image_size, classes)
+        gx = global_batch(hx.astype(jnp.bfloat16), mesh)
+        gy = global_batch(hy, mesh)
+
+        def next_batches():
+            return gx, gy
 
     t_start = time.time()
-    for i in range(warm_chunks):
-        params, opt_state, loss = train_chunk(params, opt_state, gx, gy)
-        if i == 0:
-            float(jax.device_get(loss))
-            rendezvous.report_first_step(0)
-            log(f"[vit] first chunk ({chunk} steps, compile) +{time.time() - t_start:.1f}s")
-    float(jax.device_get(loss))
+    try:
+        for i in range(warm_chunks):
+            bx, by = next_batches()
+            params, opt_state, loss = train_chunk(params, opt_state, bx, by)
+            if i == 0:
+                float(jax.device_get(loss))
+                rendezvous.report_first_step(0)
+                log(f"[vit] first chunk ({chunk} steps, compile) +{time.time() - t_start:.1f}s")
+        float(jax.device_get(loss))
 
-    from .trainer import timed_windows
+        from .trainer import timed_windows
 
-    if profile_dir and windows > 1:
-        log("[vit] --profile-dir set: timing a single window")
-        windows = 1
+        if profile_dir and windows > 1:
+            log("[vit] --profile-dir set: timing a single window")
+            windows = 1
 
-    def run_window():
-        nonlocal params, opt_state, loss
-        for _ in range(steps // chunk):
-            params, opt_state, loss = train_chunk(params, opt_state, gx, gy)
-        return loss
+        def run_window():
+            nonlocal params, opt_state, loss
+            for _ in range(steps // chunk):
+                bx, by = next_batches()
+                params, opt_state, loss = train_chunk(params, opt_state, bx, by)
+            return loss
 
-    dt, dt_sustained, n_win = timed_windows(
-        run_window,
-        lambda tok: float(jax.device_get(tok)),
-        windows=windows,
-        profile_dir=profile_dir,
-        log=lambda m: log(f"[vit] {m}"),
-    )
-    final_loss = float(jax.device_get(loss))
+        dt, dt_sustained, n_win = timed_windows(
+            run_window,
+            lambda tok: float(jax.device_get(tok)),
+            windows=windows,
+            profile_dir=profile_dir,
+            log=lambda m: log(f"[vit] {m}"),
+        )
+        final_loss = float(jax.device_get(loss))
+    finally:
+        if loader is not None:
+            loader.close()
 
     sustained_steps = steps * n_win
     images_per_sec = batch * sustained_steps / dt_sustained
@@ -176,6 +240,7 @@ def run_benchmark(
         "global_batch": batch,
         "devices": n_dev,
         "final_loss": round(final_loss, 4),
+        "input": "file" if data_file else "synthetic",
     }
 
 
@@ -190,6 +255,12 @@ def main(argv=None) -> int:
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--windows", type=int, default=1)
     p.add_argument("--attn-impl", choices=("dense", "flash"), default="dense")
+    p.add_argument(
+        "--data-file", default=None,
+        help="train from a packed image file via the prefetch loader "
+        "(pack with pytorch_operator_tpu.data.pack); image geometry "
+        "comes from the file, throughput includes the input pipeline",
+    )
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
@@ -205,6 +276,7 @@ def main(argv=None) -> int:
         lr=args.lr,
         windows=args.windows,
         attn_impl=args.attn_impl,
+        data_file=args.data_file,
         profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"

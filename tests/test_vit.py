@@ -81,6 +81,32 @@ class TestViTModel:
         # steps on a fixed synthetic batch must beat it.
         assert result["final_loss"] < 2.3
 
+    def test_trains_from_packed_image_file(self, tmp_path):
+        """Real-data path: packed images stream through the prefetch
+        loader; image geometry comes from the file."""
+        import numpy as np_
+
+        from pytorch_operator_tpu.data import pack_arrays
+        from pytorch_operator_tpu.workloads.vit_bench import run_benchmark
+
+        rng = np_.random.default_rng(0)
+        x = rng.standard_normal((32, 16, 16, 3), dtype=np_.float32)
+        y = rng.integers(0, 10, size=(32,), dtype=np_.int32)
+        f = tmp_path / "imgs.bin"
+        pack_arrays(f, {"x": x, "y": y})
+
+        result = run_benchmark(
+            variant="s16",
+            batch_size=8,
+            classes=10,
+            steps=4,
+            warmup=1,
+            data_file=str(f),
+            log=lambda *_: None,
+        )
+        assert result["input"] == "file"
+        assert np.isfinite(result["final_loss"])
+
     def test_shards_on_fsdp_tp_mesh(self):
         """The LM-stack logical annotations carry over: encoder q_proj
         kernels land (embed=fsdp, heads=tp)-sharded abstractly."""
