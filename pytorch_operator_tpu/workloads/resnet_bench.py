@@ -197,15 +197,16 @@ def run_benchmark(
     from .datasets import synthetic_images
 
     warmup = max(warmup, 1)  # the first (compile) step can never be timed
+    file_meta = field_x = None
     if data_file:
-        from ..data import read_meta
+        from .trainer import probe_image_file
 
         # ResNet params are spatial-size-independent (convs + global pool),
         # so the file's H suffices for init; batches carry the real (H, W).
         # Full validation + loader open happens in open_image_feed below.
-        fields = {f.name: f for f in read_meta(data_file).fields}
-        if "x" in fields:
-            image_size = fields["x"].shape[0]
+        file_meta, field_x = probe_image_file(data_file)
+        if field_x is not None:
+            image_size = field_x.shape[0]
     model = resnet_lib.BY_DEPTH[depth](
         num_classes=classes, bn_f32_stats=bn_f32_stats, s2d_stem=s2d_stem
     )
@@ -214,8 +215,8 @@ def run_benchmark(
     mesh = make_mesh({"dp": n_dev})
     batch = max(batch_size // n_dev, 1) * n_dev
     geometry = (
-        "x".join(str(s) for s in fields["x"].shape[:2]) + "px"
-        if data_file and "x" in fields
+        "x".join(str(s) for s in field_x.shape[:2]) + "px"
+        if field_x is not None
         else f"{image_size}px"
     )
     log(
@@ -245,8 +246,9 @@ def run_benchmark(
     if data_file:
         from .trainer import open_image_feed
 
-        next_batches, loader, _ = open_image_feed(
-            data_file, batch=batch, chunk=chunk, classes=classes, mesh=mesh
+        next_batches, loader = open_image_feed(
+            data_file, batch=batch, chunk=chunk, classes=classes, mesh=mesh,
+            meta=file_meta,
         )
         train_chunk = make_train_chunk_fed(model, tx)
     else:

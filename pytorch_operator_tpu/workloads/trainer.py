@@ -55,6 +55,17 @@ def init_sharded_train_state(model_init: Callable, tx, mesh):
     return init_sharded(init_state, mesh, jax.random.key(int(os.environ.get("TPUJOB_SEED", "0"))))
 
 
+def probe_image_file(data_file: str):
+    """Pre-model geometry probe: ``(meta, x_field_or_None)`` — the one
+    place both benches read image shape from a packed file (full
+    validation happens in :func:`open_image_feed`, which accepts the
+    probed meta to avoid re-reading)."""
+    from ..data import read_meta
+
+    meta = read_meta(data_file)
+    return meta, next((f for f in meta.fields if f.name == "x"), None)
+
+
 def open_image_feed(
     data_file: str,
     *,
@@ -64,10 +75,11 @@ def open_image_feed(
     mesh,
     square: bool = False,
     seed: int = 0,
+    meta=None,
 ):
     """Validate + open a packed image file and return ``(next_batches,
-    loader, field_x)`` — the real-data feed both image benches share
-    (one definition so validation/feed fixes cannot drift per bench).
+    loader)`` — the real-data feed both image benches share (one
+    definition so validation/feed fixes cannot drift per bench).
 
     ``next_batches()`` returns ``chunk`` loader batches stacked
     ``[chunk, B, ...]`` as device arrays (bf16 images, i32 labels, one
@@ -87,7 +99,8 @@ def open_image_feed(
     from ..data import open_training_loader, read_meta
     from ..parallel.data import put_global
 
-    meta = read_meta(data_file)
+    if meta is None:
+        meta = read_meta(data_file)
     names = [f.name for f in meta.fields]
     if "x" not in names or "y" not in names:
         raise ValueError(
@@ -133,7 +146,7 @@ def open_image_feed(
             checked = True
         return put_global(sx, x_sh), put_global(sy, x_sh)
 
-    return next_batches, loader, field_x
+    return next_batches, loader
 
 
 def make_optimizer(

@@ -110,15 +110,16 @@ def run_benchmark(
     from ..parallel.data import global_batch
     from .datasets import synthetic_images
 
+    file_meta = None
     if data_file:
-        from ..data import read_meta
+        from .trainer import probe_image_file
 
         # Geometry from the file; full validation (incl. the H == W
         # requirement ViT's position embeddings impose) + loader open
         # happens in open_image_feed below.
-        fields = {f.name: f for f in read_meta(data_file).fields}
-        if "x" in fields:
-            image_size = fields["x"].shape[0]
+        file_meta, field_x = probe_image_file(data_file)
+        if field_x is not None:
+            image_size = field_x.shape[0]
     cfg = vit_lib.BY_NAME[variant](
         image_size=image_size, num_classes=classes, attn_impl=attn_impl
     )
@@ -159,9 +160,9 @@ def run_benchmark(
     if data_file:
         from .trainer import open_image_feed
 
-        next_batches, loader, _ = open_image_feed(
+        next_batches, loader = open_image_feed(
             data_file, batch=batch, chunk=chunk, classes=classes, mesh=mesh,
-            square=True,
+            square=True, meta=file_meta,
         )
         train_chunk = make_train_chunk_fed(model, tx)
     else:

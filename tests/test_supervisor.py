@@ -11,6 +11,7 @@ from pytorch_operator_tpu.api import (
     ConditionType,
     ElasticPolicy,
     ProcessTemplate,
+    ReplicaPhase,
     ReplicaSpec,
     ReplicaType,
     RestartPolicy,
@@ -116,6 +117,105 @@ class TestSubprocessE2E:
             assert result["key"] == "default/ap-re"
         finally:
             sup2.shutdown()
+
+    def test_deletion_marker_legacy_formats_keep_purge_request(self, tmp_path):
+        """Markers written by older code (bare 'purge' string; transitional
+        JSON with a bare purge bool) must still purge — and the current
+        mode-based payload only contains the literal 'purge' when purging
+        (legacy substring readers must not purge plain deletes)."""
+        import json as _json
+
+        sup = make_supervisor(tmp_path)
+        try:
+            store = sup.store
+            key = "default/legacy"
+            # Current format: plain delete carries no 'purge' substring.
+            store.mark_deletion(key, purge=False, uid="u")
+            marker = store._marker_path(key, "delete")
+            assert "purge" not in marker.read_text()
+            assert store.marker_requests_purge(key) is False
+            store.mark_deletion(key, purge=True, uid="u")
+            assert store.marker_requests_purge(key) is True
+            # Transitional JSON format (bare bool).
+            marker.write_text(_json.dumps({"purge": True, "uid": "u"}))
+            assert store.marker_requests_purge(key) is True
+            # Legacy string format.
+            marker.write_text("purge")
+            assert store.marker_requests_purge(key) is True
+            marker.write_text("")
+            assert store.marker_requests_purge(key) is False
+            marker.unlink()
+        finally:
+            sup.shutdown()
+
+    def test_unknown_age_finished_records_reaped_active_spared(self, tmp_path):
+        """uid-mismatch marker processing with legacy records that lack
+        created_at (0.0): FINISHED stale records are reaped (they would
+        be adopted as phantom success), ACTIVE unknown-age replicas are
+        spared (never kill what might be the new job's world)."""
+        sup = make_supervisor(tmp_path)
+        try:
+            job = new_job(name="age", workers=1)
+            tmpl = ProcessTemplate(command=["sleep", "30"])
+            for rs in job.spec.replica_specs.values():
+                rs.template = tmpl
+            key = sup.submit(job)
+            sup.sync_once()
+            handles = sup.runner.list_for_job(key)
+            assert len(handles) == 2
+            # Simulate legacy records: ages unknown; master finished.
+            master = sup.runner.get(replica_name(key, ReplicaType.MASTER, 0))
+            worker = sup.runner.get(replica_name(key, ReplicaType.WORKER, 0))
+            master.created_at = 0.0
+            worker.created_at = 0.0
+            master.phase = ReplicaPhase.SUCCEEDED
+            master.exit_code = 0
+            # Marker pinned to a DIFFERENT (older) incarnation uid.
+            sup.store.mark_deletion(key, uid="older-uid")
+            sup.process_deletion_markers()
+            assert sup.store.get(key) is not None  # new job survives
+            assert (
+                sup.runner.get(replica_name(key, ReplicaType.MASTER, 0)) is None
+            ), "unknown-age FINISHED record must be reaped"
+            assert (
+                sup.runner.get(replica_name(key, ReplicaType.WORKER, 0))
+                is not None
+            ), "unknown-age ACTIVE replica must be spared"
+        finally:
+            sup.shutdown()
+
+    def test_gc_key_locks_retires_only_uncontended_dead_keys(self, tmp_path):
+        """Locks held by ANOTHER thread survive GC (popping a held lock
+        would let a concurrent key_lock mint a second one); dead
+        uncontended locks are retired; live keys untouched."""
+        import threading
+
+        sup = make_supervisor(tmp_path)
+        try:
+            rec = sup.reconciler
+            lock = rec.key_lock("default/held")
+            rec.key_lock("default/dead")
+            rec.key_lock("default/live")
+            acquired, release = threading.Event(), threading.Event()
+
+            def holder():
+                with lock:
+                    acquired.set()
+                    release.wait(10)
+
+            t = threading.Thread(target=holder)
+            t.start()
+            assert acquired.wait(5)
+            try:
+                rec.gc_key_locks(live_keys={"default/live"})
+                assert "default/dead" not in rec._key_locks
+                assert "default/held" in rec._key_locks  # held elsewhere
+                assert "default/live" in rec._key_locks
+            finally:
+                release.set()
+                t.join(timeout=10)
+        finally:
+            sup.shutdown()
 
     def test_deletion_marker_for_old_incarnation_spares_new_job(self, tmp_path):
         """A daemon consuming a uid-pinned deletion marker must not kill a
