@@ -37,13 +37,20 @@ def chunked_softmax_xent(hidden, w, labels, *, chunk: int = 8192):
     labels ``[N]`` int. Returns float32 ``[N]``. Gradients flow to
     ``hidden`` and ``w``; logits math is float32 regardless of input dtype
     (matching the dense path, whose head computes in f32).
+
+    Out-of-range labels clamp to [0, V) — a DEFINED behavior where the
+    dense path (optax integer-label xent) yields NaN and the previous
+    chunked behavior silently returned plain lse. Padding/ignore tokens
+    should be masked out of the mean, not encoded as sentinel label ids;
+    the clamp just guarantees a stray id can't poison the loss.
     """
     N, D = hidden.shape
     D2, V = w.shape
     assert D == D2, f"hidden D={D} vs w D={D2}"
     c = min(chunk, V)
     n_chunks = -(-V // c)  # ceil — tail chunk is a clamped, masked slice
-    return _xent(hidden, w, labels.astype(jnp.int32), n_chunks, c)
+    labels = jnp.clip(labels.astype(jnp.int32), 0, V - 1)
+    return _xent(hidden, w, labels, n_chunks, c)
 
 
 def _chunk_slice(w, c_idx, chunk):

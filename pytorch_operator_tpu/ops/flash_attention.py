@@ -322,11 +322,18 @@ def _flash_bwd_call(q, k, v, o, lse, do, cfg: _FlashCfg):
 
 def _flash_fwd(q, k, v, cfg: _FlashCfg):
     o, lse = _flash_fwd_call(q, k, v, cfg)
-    return o, (q, k, v, o, lse)
+    # The kernel emits lse as [BH, S, 128] (value broadcast over the lane
+    # dim — TPU tiling); storing that as the fwd→bwd residual would cost
+    # 128x the bytes of the [BH, S] values it holds (134 MB/layer at 8B
+    # shapes). Save the slim column and re-broadcast in backward.
+    return o, (q, k, v, o, lse[:, :, 0])
 
 
 def _flash_bwd(cfg: _FlashCfg, res, do):
-    q, k, v, o, lse = res
+    import jax.numpy as jnp
+
+    q, k, v, o, lse_slim = res
+    lse = jnp.broadcast_to(lse_slim[..., None], lse_slim.shape + (128,))
     return _flash_bwd_call(q, k, v, o, lse, do, cfg)
 
 
@@ -397,6 +404,18 @@ def flash_attention(
         # else Mosaic rejects the tile (e.g. S=100 → block_q=100).
         or (not interpret and (D % 128 or block_q % 8 or block_k % 128))
     ):
+        # Loud fallback: the dense path materializes [B,KH,G,S,S] f32
+        # scores — at long S that is an OOM/perf cliff a user who asked
+        # for flash should hear about, not discover in a memory dump.
+        import warnings
+
+        warnings.warn(
+            f"flash_attention falling back to the DENSE O(S^2) path: "
+            f"shape (S={S}, D={D}) does not fit the kernel tiling "
+            f"(need S divisible by block sizes; on TPU also D%128==0). "
+            f"Expect O(S^2) HBM for the score tensor.",
+            stacklevel=2,
+        )
         return _dense_reference(q, k, v, causal=causal)
     cfg = _FlashCfg(causal, block_q, block_k, H // KH, interpret)
 

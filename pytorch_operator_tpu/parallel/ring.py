@@ -81,6 +81,13 @@ def ring_attention_shard(
         )
         return m_new, l, o
 
+    # Remat the block: without it, grad through the ring loop saves every
+    # step's [B,K,G,Sq,Skv] softmax intermediates as scan residuals —
+    # O(Sq_local * S_total) per chip, the exact quadratic blowup this
+    # module exists to avoid. Recomputing p in backward keeps residuals
+    # at the carry + the rotated K/V blocks (linear in S).
+    block = jax.checkpoint(block)
+
     # Accumulators start as (replicated) constants but become device-varying
     # after the first block; mark them varying over the ring axis up front so
     # the fori_loop carry type is stable (shard_map VMA typing).
@@ -135,9 +142,15 @@ def ring_self_attention(
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    if axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
-        # Degenerate ring: run the same math without shard_map so callers
-        # can use one code path for every mesh.
+    if (
+        axis_name not in mesh.axis_names
+        or mesh.shape[axis_name] == 1
+        # A sequence that doesn't divide the ring cannot be sharded —
+        # fall back to the single-shard path instead of a trace-time
+        # shard_map error (same one-code-path promise as the degenerate
+        # mesh case).
+        or q.shape[1] % mesh.shape[axis_name]
+    ):
         return _single_shard(q, k, v, positions, causal=causal)
 
     body = functools.partial(
@@ -172,17 +185,11 @@ def _single_shard(q, k, v, positions, *, causal: bool):
     if causal:
         ok = positions[:, None, None, None, :] <= positions[:, None, None, :, None]
         s = jnp.where(ok, s, jnp.finfo(jnp.float32).min)
-    p = _softmax(s)
+    import jax
+
+    p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
         "bkgst,btkd->bskgd", p, v.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
     return out.astype(q.dtype)
-
-
-def _softmax(s):
-    import jax.numpy as jnp
-
-    m = jnp.max(s, axis=-1, keepdims=True)
-    e = jnp.exp(s - m)
-    return e / jnp.sum(e, axis=-1, keepdims=True)

@@ -50,6 +50,29 @@ class TestForward:
         ref = _dense_ref(jnp.asarray(hidden), jnp.asarray(w), jnp.asarray(labels))
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
+    def test_out_of_range_labels_clamp_deterministically(self):
+        """Labels outside [0, V) clamp to the range edges — a defined,
+        finite behavior (optax's dense path yields NaN there; the old
+        chunked behavior silently returned plain lse)."""
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_operator_tpu.ops.chunked_xent import chunked_softmax_xent
+
+        hidden, w, _ = _rand(16, 8, 50)
+        labels = np.array([-1, -100, 0, 49, 50, 99, 7, 3] * 2, np.int32)
+        got = chunked_softmax_xent(
+            jnp.asarray(hidden), jnp.asarray(w), jnp.asarray(labels), chunk=16
+        )
+        assert np.isfinite(np.asarray(got)).all()
+        logits = jnp.asarray(hidden) @ jnp.asarray(w)
+        ref = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.clip(jnp.asarray(labels), 0, 49)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
     def test_bf16_hidden(self):
         import jax.numpy as jnp
 
