@@ -157,8 +157,11 @@ Loader* tpujob_loader_open(const char* path, uint64_t record_bytes,
   int fd = ::open(path, O_RDONLY);
   if (fd < 0) return nullptr;
   struct stat st;
-  if (fstat(fd, &st) != 0 ||
-      static_cast<uint64_t>(st.st_size) < record_bytes * n_records) {
+  // Division form: a corrupt/hostile sidecar claiming huge counts must
+  // not wrap record_bytes * n_records into a small value that passes the
+  // size check and drives out-of-bounds reads off the mapping.
+  if (fstat(fd, &st) != 0 || record_bytes == 0 ||
+      n_records > static_cast<uint64_t>(st.st_size) / record_bytes) {
     ::close(fd);
     return nullptr;
   }
@@ -224,7 +227,13 @@ uint64_t tpujob_loader_batches_per_epoch(Loader* l) {
 
 void tpujob_loader_close(Loader* l) {
   if (!l) return;
-  l->stop.store(true);
+  {
+    // stop must flip UNDER the mutex: setting it between a waiter's
+    // predicate check and its block would lose the notify (classic
+    // missed wakeup) and hang producer.join() forever.
+    std::unique_lock<std::mutex> lk(l->mu);
+    l->stop.store(true);
+  }
   l->can_fill.notify_all();
   l->can_take.notify_all();
   if (l->producer.joinable()) l->producer.join();

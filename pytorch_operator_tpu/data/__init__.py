@@ -9,8 +9,14 @@ This package is the TPU-native equivalent: a packed record file format
 the training loop's critical path.
 """
 
-from .array_file import ArrayFileMeta, field_max, pack_arrays, read_meta
-from .native_loader import LoaderUnavailable, NativeLoader, PyLoader, open_loader
+from .array_file import ArrayFileMeta, field_max, field_range, pack_arrays, read_meta
+from .native_loader import (
+    LoaderDataError,
+    LoaderUnavailable,
+    NativeLoader,
+    PyLoader,
+    open_loader,
+)
 
 
 def open_training_loader(path, batch: int, *, seed: int = 0, processes: int = 1):
@@ -25,8 +31,10 @@ def open_training_loader(path, batch: int, *, seed: int = 0, processes: int = 1)
 __all__ = [
     "ArrayFileMeta",
     "field_max",
+    "field_range",
     "pack_arrays",
     "read_meta",
+    "LoaderDataError",
     "LoaderUnavailable",
     "NativeLoader",
     "PyLoader",

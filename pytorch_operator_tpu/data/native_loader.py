@@ -38,7 +38,15 @@ _LIB_PATH = _NATIVE_DIR / "libtpujob_loader.so"
 
 
 class LoaderUnavailable(RuntimeError):
-    pass
+    """The NATIVE loader cannot run here (toolchain/library problem).
+    open_loader treats this as 'fall back to PyLoader'."""
+
+
+class LoaderDataError(ValueError):
+    """The data file/parameters are invalid (short file, bad metadata,
+    batch > records). NOT caught by open_loader's fallback: handing the
+    same bad input to PyLoader would just crash later and more
+    confusingly — both implementations raise this up front."""
 
 
 _lib = None
@@ -54,13 +62,26 @@ def _load_lib() -> ctypes.CDLL:
     if not src.exists():
         raise LoaderUnavailable(f"native source missing: {src}")
     if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < src.stat().st_mtime:
+        # Serialize concurrent first-use builds (multi-process gangs all
+        # hit this at once): without the lock, one rank can CDLL a
+        # half-written .so while another's make is mid-link.
+        import fcntl
+
+        lock_path = _NATIVE_DIR / ".build.lock"
         try:
-            subprocess.run(
-                ["make", "-C", str(_NATIVE_DIR)],
-                check=True,
-                capture_output=True,
-                text=True,
-            )
+            with open(lock_path, "w") as lock_f:
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+                # Re-check under the lock: a peer may have built it.
+                if (
+                    not _LIB_PATH.exists()
+                    or _LIB_PATH.stat().st_mtime < src.stat().st_mtime
+                ):
+                    subprocess.run(
+                        ["make", "-C", str(_NATIVE_DIR)],
+                        check=True,
+                        capture_output=True,
+                        text=True,
+                    )
         except (OSError, subprocess.CalledProcessError) as e:
             detail = getattr(e, "stderr", "") or str(e)
             raise LoaderUnavailable(f"cannot build native loader: {detail}") from e
@@ -124,7 +145,9 @@ class NativeLoader:
             len(self.meta.fields),
         )
         if not self._handle:
-            raise LoaderUnavailable(
+            # Data/parameter problem, not a toolchain one — must NOT be
+            # swallowed by open_loader's PyLoader fallback.
+            raise LoaderDataError(
                 f"tpujob_loader_open failed for {path} "
                 f"(record_bytes={self.meta.record_bytes}, "
                 f"n_records={self.meta.n_records}, batch={batch} — is the file "
@@ -193,9 +216,18 @@ class PyLoader:
         self.shuffle = shuffle
         self.seed = seed
         rb = self.meta.record_bytes
-        self._records = np.memmap(path, dtype=np.uint8, mode="r").reshape(-1, rb)[
-            : self.meta.n_records
-        ]
+        raw = np.memmap(path, dtype=np.uint8, mode="r")
+        need = rb * self.meta.n_records
+        if raw.size < need:
+            # Same up-front contract as the native loader (which checks
+            # file size against the metadata and refuses to open).
+            raise LoaderDataError(
+                f"{path}: {raw.size} bytes < record_bytes*n_records "
+                f"({rb}*{self.meta.n_records}={need})"
+            )
+        # Slice BEFORE reshape: trailing bytes (file longer than the
+        # metadata claims) are tolerated exactly like the native path.
+        self._records = raw[:need].reshape(-1, rb)
         self._epoch = 0
         self._index = 0
         self._perm = self._make_perm()
@@ -203,7 +235,10 @@ class PyLoader:
     def _make_perm(self) -> np.ndarray:
         if not self.shuffle:
             return np.arange(self.meta.n_records)
-        return np.random.default_rng(self.seed + self._epoch).permutation(
+        # SeedSequence-mixed (seed, epoch): additive seed+epoch made
+        # adjacent seeds produce identical permutation streams shifted by
+        # one epoch, undermining seed-based run independence.
+        return np.random.default_rng((self.seed, self._epoch)).permutation(
             self.meta.n_records
         )
 

@@ -187,7 +187,7 @@ def run(
     def open_token_file(path: str, flag: str, seed: int, do_open: bool = True):
         """Validate (once per path — the whole-file vocab scan is a full
         read) and optionally open a packed token file."""
-        from ..data import field_max, open_training_loader, read_meta
+        from ..data import field_range, open_training_loader, read_meta
 
         if path in validated_files:
             meta = validated_files[path]
@@ -225,11 +225,13 @@ def run(
             )
         # Whole-file scan UP FRONT (memmap streaming pass): a per-batch
         # check would miss records outside the scanned batches, and XLA
-        # clamps out-of-range embedding lookups silently.
-        top = int(field_max(path, meta, "tokens"))
-        if top >= cfg.vocab_size:
+        # clamps out-of-range embedding lookups (in BOTH directions)
+        # silently.
+        lo, hi = field_range(path, meta, "tokens")
+        if int(lo) < 0 or int(hi) >= cfg.vocab_size:
             raise ValueError(
-                f"{flag} token id {top} >= model vocab {cfg.vocab_size}"
+                f"{flag} token ids span [{int(lo)}, {int(hi)}] — outside "
+                f"the model vocab [0, {cfg.vocab_size})"
             )
         validated_files[path] = meta
         if not do_open:

@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 
 from pytorch_operator_tpu.data import (
+    LoaderDataError,
     LoaderUnavailable,
     open_loader,
     pack_arrays,
@@ -119,7 +120,7 @@ class TestNativeSpecifics:
         try:
             from pytorch_operator_tpu.data.native_loader import NativeLoader
 
-            with pytest.raises(LoaderUnavailable, match="open failed"):
+            with pytest.raises(LoaderDataError, match="open failed"):
                 NativeLoader(short, batch=16, meta=meta)
         except LoaderUnavailable as e:
             pytest.skip(f"native loader unavailable: {e}")
@@ -132,7 +133,7 @@ class TestNativeSpecifics:
             _load_lib()
         except LoaderUnavailable as e:
             pytest.skip(f"native loader unavailable: {e}")
-        with pytest.raises(LoaderUnavailable, match="open failed"):
+        with pytest.raises(LoaderDataError, match="open failed"):
             NativeLoader(path, batch=128)
 
     def test_stashed_batches_keep_image_label_pairing(self, packed):

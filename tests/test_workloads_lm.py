@@ -141,6 +141,16 @@ def test_llama_data_file_validation(tmp_path):
             config="tiny", mesh_spec="dp=8", batch_size=8, seq_len=16,
             steps=1, warmup=1, data_file=str(f2), log=lambda *_: None,
         )
+    # Negative ids clamp as silently as too-large ones — also rejected.
+    f3 = tmp_path / "neg.bin"
+    toks = np.zeros((8, 16), np.int32)
+    toks[3, 7] = -5
+    pack_arrays(f3, {"tokens": toks})
+    with pytest.raises(ValueError, match="vocab"):
+        llama_train.run(
+            config="tiny", mesh_spec="dp=8", batch_size=8, seq_len=16,
+            steps=1, warmup=1, data_file=str(f3), log=lambda *_: None,
+        )
 
 
 def test_llama_data_file_resume_fast_forwards(tmp_path, monkeypatch):
