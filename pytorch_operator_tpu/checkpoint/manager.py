@@ -46,12 +46,14 @@ class CheckpointManager:
 
         self._ocp = ocp
         self.directory = Path(directory).absolute()
+        # One creation mechanism only: parents=True is load-bearing (the
+        # supervisor nests checkpoint dirs several levels under the state
+        # dir), which orbax's CheckpointManagerOptions(create=True) does
+        # not guarantee — so the explicit mkdir owns creation.
         self.directory.mkdir(parents=True, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
-            ),
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
 
     def latest_step(self) -> Optional[int]:

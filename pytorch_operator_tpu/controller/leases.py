@@ -24,6 +24,16 @@ from pathlib import Path
 from typing import Optional
 
 
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+
+
 class LeaderLease:
     """An exclusive, crash-released lease on a state directory."""
 
@@ -39,7 +49,9 @@ class LeaderLease:
             return True
         self.path.parent.mkdir(parents=True, exist_ok=True)
         fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
-        deadline = None if timeout is None else time.time() + timeout
+        # Monotonic deadline: a wall-clock step (NTP) must not stretch or
+        # collapse the timeout.
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             try:
                 flags = fcntl.LOCK_EX
@@ -52,25 +64,45 @@ class LeaderLease:
                     # Not contention — e.g. flock unsupported on this fs.
                     os.close(fd)
                     raise
-                if not blocking or (deadline is not None and time.time() >= deadline):
+                if not blocking or (
+                    deadline is not None and time.monotonic() >= deadline
+                ):
                     os.close(fd)
                     return False
                 time.sleep(0.05)
         # Record the holder for observability (healthz, error messages).
-        os.ftruncate(fd, 0)
-        os.pwrite(
-            fd,
-            json.dumps(
-                {"holder": self.identity, "pid": os.getpid(), "acquired": time.time()}
-            ).encode(),
-            0,
-        )
+        # Any failure here must release + close the locked fd: leaking it
+        # with self._fd unset would self-deadlock every retry in this
+        # process (same-process fds conflict under flock) and block every
+        # standby forever.
+        try:
+            os.ftruncate(fd, 0)
+            os.pwrite(
+                fd,
+                json.dumps(
+                    {"holder": self.identity, "pid": os.getpid(), "acquired": time.time()}
+                ).encode(),
+                0,
+            )
+        except OSError:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            finally:
+                os.close(fd)
+            raise
         self._fd = fd
         return True
 
     def release(self) -> None:
         if self._fd is None:
             return
+        # Clear the holder record BEFORE unlocking so observers never read
+        # our identity as the leader after we stepped down. (Crash release
+        # skips this — holder() handles that via the pid liveness check.)
+        try:
+            os.ftruncate(self._fd, 0)
+        except OSError:
+            pass
         fcntl.flock(self._fd, fcntl.LOCK_UN)
         os.close(self._fd)
         self._fd = None
@@ -79,24 +111,31 @@ class LeaderLease:
         return self._fd is not None
 
     def holder(self) -> Optional[str]:
-        """Best-effort identity of the current holder (None if unheld)."""
+        """Best-effort identity of the current holder (None if unheld).
+
+        Deliberately LOCK-FREE: a flock probe (shared or exclusive) would
+        momentarily contend with a real ``acquire`` attempt, making a
+        concurrent standby's election spuriously fail just because
+        someone asked who the leader is. Instead read the holder record
+        and judge liveness by pid: the OS releases a dead holder's lock,
+        and a dead pid means the record is stale.
+        """
         if self._fd is not None:
             return self.identity
-        if not self.path.exists():
-            return None
-        probe = os.open(self.path, os.O_RDWR)
         try:
-            fcntl.flock(probe, fcntl.LOCK_EX | fcntl.LOCK_NB)
-            # We got the lock, so nobody holds the lease.
-            fcntl.flock(probe, fcntl.LOCK_UN)
-            return None
+            content = self.path.read_text()
         except OSError:
-            try:
-                return json.loads(self.path.read_text() or "{}").get("holder")
-            except ValueError:
-                return "<unknown>"
-        finally:
-            os.close(probe)
+            return None
+        if not content.strip():
+            return None
+        try:
+            rec = json.loads(content)
+        except ValueError:
+            return "<unknown>"
+        pid = rec.get("pid")
+        if isinstance(pid, int) and not _pid_alive(pid):
+            return None  # crash-released: lock gone, record stale
+        return rec.get("holder")
 
     def __enter__(self) -> "LeaderLease":
         self.acquire()

@@ -63,14 +63,21 @@ class MonitoringServer:
 
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
-                if path == "/metrics":
-                    body = outer._render_metrics().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif path == "/healthz":
-                    body = json.dumps(outer._health()).encode()
-                    ctype = "application/json"
-                else:
-                    self.send_error(404)
+                try:
+                    if path == "/metrics":
+                        body = outer._render_metrics().encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif path == "/healthz":
+                        body = json.dumps(outer._health()).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:  # noqa: BLE001
+                    # A transient callback error must produce an HTTP 500,
+                    # not a dropped connection: liveness probes treat an
+                    # empty reply as dead and would kill the hot spare.
+                    self.send_error(500, explain=f"{type(e).__name__}: {e}")
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -100,9 +107,16 @@ def supervisor_health(supervisor) -> Dict:
     """The /healthz document: live job phase counts + identity."""
     phases: Dict[str, int] = {}
     for job in supervisor.list_jobs():
-        phase = "Succeeded" if job.is_succeeded() else (
-            "Failed" if job.is_failed() else "Active"
-        )
+        if job.is_succeeded():
+            phase = "Succeeded"
+        elif job.is_failed():
+            phase = "Failed"
+        elif job.spec.run_policy.suspend:
+            # Deliberately parked, not running — folding these into
+            # Active would misreport cluster state.
+            phase = "Suspended"
+        else:
+            phase = "Active"
         phases[phase] = phases.get(phase, 0) + 1
     doc = {"status": "ok", "jobs": phases}
     lease = getattr(supervisor, "lease", None)

@@ -152,6 +152,24 @@ class TestLeaderLease:
         assert time.time() - t0 >= 0.3
         a.release()
 
+    def test_holder_is_lock_free_and_detects_stale_records(self, tmp_path):
+        """holder() must not touch the flock (a probe would contend with
+        a real election) — it reads the record and judges liveness by
+        pid, so a crashed leader's stale record reads as None."""
+        import json as _json
+
+        lease = LeaderLease(tmp_path, identity="obs")
+        (tmp_path / "leader.lock").write_text(
+            _json.dumps({"holder": "ghost", "pid": 99_999_999})
+        )
+        assert lease.holder() is None  # dead pid ⇒ crash-released
+        (tmp_path / "leader.lock").write_text(
+            _json.dumps({"holder": "me", "pid": __import__("os").getpid()})
+        )
+        assert lease.holder() == "me"  # live pid ⇒ trusted record
+        (tmp_path / "leader.lock").write_text("not json")
+        assert lease.holder() == "<unknown>"
+
     def test_crash_releases_lease(self, tmp_path):
         """OS-level release on holder death — the fail-over property."""
         repo_root = str(Path(__file__).resolve().parents[1])
