@@ -33,7 +33,13 @@ from ..api import (
     set_defaults,
     validate,
 )
-from ..controller.store import JobStore, job_key, purge_job_artifacts
+from ..controller.store import (
+    JobStore,
+    fs_to_key,
+    job_key,
+    key_to_fs,
+    purge_job_artifacts,
+)
 from ..controller.supervisor import (
     Supervisor,
     default_state_dir,
@@ -245,18 +251,28 @@ def cmd_supervisor(args) -> int:
         print(f"tpujob supervisor: state dir {sup.state_dir}, "
               f"gang={'on' if not args.no_gang else 'off'}")
         while True:
-            sup.store.rescan()
-            sup.process_deletion_markers()
-            sup.process_scale_markers()
-            sup.process_suspend_markers()
-            sup.process_apply_markers()
-            sup.sync_once()
-            # Retire reconcile locks of deleted jobs (delete_job can't:
-            # it may run nested under a held lock).
-            sup.reconciler.gc_key_locks(
-                {job_key(j) for j in sup.store.list()}
-            )
-            sup.write_metrics_file()
+            try:
+                sup.store.rescan()
+                sup.process_deletion_markers()
+                sup.process_scale_markers()
+                sup.process_suspend_markers()
+                sup.process_apply_markers()
+                sup.sync_once()
+                # Retire reconcile locks of deleted jobs (delete_job
+                # can't: it may run nested under a held lock).
+                sup.reconciler.gc_key_locks(
+                    {job_key(j) for j in sup.store.list()}
+                )
+                sup.write_metrics_file()
+            except Exception:
+                # Controller semantics (the reference's workqueue requeues
+                # on sync error): a transient failure in one pass — disk
+                # hiccup, one bad job record — must not crash the daemon,
+                # whose shutdown would tear down every live training
+                # world it spawned. Log and keep reconciling.
+                import traceback
+
+                traceback.print_exc()
             time.sleep(args.interval)
     except KeyboardInterrupt:
         print("supervisor: shutting down")
@@ -319,7 +335,7 @@ def cmd_events(args) -> int:
     records = []
     if ev_dir.is_dir():
         for p in sorted(ev_dir.glob("*.events.jsonl")):
-            obj = p.name[: -len(".events.jsonl")].replace("_", "/", 1)
+            obj = fs_to_key(p.name[: -len(".events.jsonl")])
             for line in p.read_text().splitlines():
                 if not line.strip():
                     continue
@@ -411,7 +427,7 @@ def cmd_describe(args) -> int:
         print(
             f"  {c.type.value:<12} {str(c.status):<6} {c.reason:<24} {c.message}"
         )
-    ev_path = state / "events" / (key.replace("/", "_") + ".events.jsonl")
+    ev_path = state / "events" / (key_to_fs(key) + ".events.jsonl")
     print("Events:")
     if ev_path.exists():
         for line in ev_path.read_text().splitlines():
@@ -428,7 +444,7 @@ def cmd_describe(args) -> int:
 def cmd_logs(args) -> int:
     state = _state_dir(args)
     key = _resolve_key(args)
-    prefix = key.replace("/", "_")
+    prefix = key_to_fs(key)
     log_dir = state / "logs"
     if args.replica:
         paths = [log_dir / f"{prefix}-{args.replica}.log"]

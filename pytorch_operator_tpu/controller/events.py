@@ -2,8 +2,13 @@
 
 Reference: Kubernetes Events emitted on the PyTorchJob object — the
 user-facing observability surface (SURVEY.md §5 "Metrics / logging /
-observability"). Locally: an append-only per-job event list, queryable via
+observability"). Locally: a per-job event list, queryable via
 ``tpujob describe``, optionally mirrored to a JSONL file.
+
+k8s-style aggregation: a repeat of the previous event (same type,
+reason, message) bumps its ``count``/timestamp instead of appending, so
+a crash-looping job cannot grow the log without bound; the in-memory
+list is additionally capped at the newest MAX_EVENTS_PER_JOB entries.
 """
 
 from __future__ import annotations
@@ -18,6 +23,10 @@ from typing import Dict, List, Optional
 EVENT_NORMAL = "Normal"
 EVENT_WARNING = "Warning"
 
+# In-memory cap per job (the JSONL sink keeps first occurrences only, and
+# is reset with the job — see drop_job).
+MAX_EVENTS_PER_JOB = 1000
+
 
 @dataclass
 class Event:
@@ -25,6 +34,7 @@ class Event:
     type: str  # Normal | Warning
     reason: str
     message: str
+    count: int = 1  # k8s Event.count: consecutive-duplicate aggregation
 
     def to_dict(self) -> dict:
         return {
@@ -32,6 +42,7 @@ class Event:
             "type": self.type,
             "reason": self.reason,
             "message": self.message,
+            "count": self.count,
         }
 
 
@@ -42,6 +53,11 @@ class EventRecorder:
     sink_dir: Optional[Path] = None
     _events: Dict[str, List[Event]] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def _sink_path(self, job_key: str) -> Path:
+        from .store import key_to_fs
+
+        return Path(self.sink_dir) / (key_to_fs(job_key) + ".events.jsonl")
 
     def event(
         self,
@@ -58,12 +74,33 @@ class EventRecorder:
             message=message,
         )
         with self._lock:
-            self._events.setdefault(job_key, []).append(ev)
-        if self.sink_dir is not None:
-            path = Path(self.sink_dir) / (job_key.replace("/", "_") + ".events.jsonl")
-            path.parent.mkdir(parents=True, exist_ok=True)
-            with path.open("a") as f:
-                f.write(json.dumps(ev.to_dict()) + "\n")
+            log = self._events.setdefault(job_key, [])
+            if (
+                log
+                and log[-1].type == etype
+                and log[-1].reason == reason
+                and log[-1].message == message
+            ):
+                # Consecutive duplicate: aggregate instead of appending
+                # (a fast restart loop must not grow memory/disk forever).
+                log[-1].count += 1
+                log[-1].timestamp = ev.timestamp
+                return
+            log.append(ev)
+            if len(log) > MAX_EVENTS_PER_JOB:
+                del log[: len(log) - MAX_EVENTS_PER_JOB]
+            if self.sink_dir is not None:
+                # Best-effort observability mirror: a full disk or a
+                # permissions hiccup must never crash the reconcile path
+                # (the daemon's crash handler would tear down live
+                # training worlds over a log line).
+                try:
+                    path = self._sink_path(job_key)
+                    path.parent.mkdir(parents=True, exist_ok=True)
+                    with path.open("a") as f:
+                        f.write(json.dumps(ev.to_dict()) + "\n")
+                except OSError:
+                    pass
 
     def normal(self, job_key: str, reason: str, message: str) -> None:
         self.event(job_key, EVENT_NORMAL, reason, message)
@@ -76,5 +113,14 @@ class EventRecorder:
             return list(self._events.get(job_key, []))
 
     def drop_job(self, job_key: str) -> None:
+        """Forget a deleted job's events — including the sink file, so a
+        resubmitted incarnation's describe/events never opens with the
+        previous incarnation's history (and churn can't grow the events
+        dir one file per key forever)."""
         with self._lock:
             self._events.pop(job_key, None)
+            if self.sink_dir is not None:
+                try:
+                    self._sink_path(job_key).unlink(missing_ok=True)
+                except OSError:
+                    pass

@@ -6,6 +6,10 @@ prevents duplicate pod creation in the window between issuing a create and the
 informer observing it. The local runner is nearly synchronous, but the same
 guard protects against double-creation when a sync races a slow process
 launch or when the supervisor threads syncs.
+
+Creations only: replica DELETION here is synchronous (delete_many blocks
+until the process group is dead), so the reference's deletion half of the
+cache would be dead weight suggesting a protection that isn't needed.
 """
 
 from __future__ import annotations
@@ -22,7 +26,6 @@ EXPECTATION_TIMEOUT_S = 300.0
 @dataclass
 class _Expectation:
     creations: int
-    deletions: int
     timestamp: float
 
 
@@ -32,36 +35,18 @@ class ControllerExpectations:
         self._lock = threading.Lock()
 
     def expect_creations(self, key: str, n: int, now: float = None) -> None:
+        """SET the expectation (the reference's SetExpectations REPLACES —
+        adding to a stale leftover from a failed create pass would freeze
+        the job for the full timeout on every retry)."""
         now = time.time() if now is None else now
         with self._lock:
-            exp = self._by_key.get(key)
-            if exp is None:
-                self._by_key[key] = _Expectation(n, 0, now)
-            else:
-                exp.creations += n
-                exp.timestamp = now
-
-    def expect_deletions(self, key: str, n: int, now: float = None) -> None:
-        now = time.time() if now is None else now
-        with self._lock:
-            exp = self._by_key.get(key)
-            if exp is None:
-                self._by_key[key] = _Expectation(0, n, now)
-            else:
-                exp.deletions += n
-                exp.timestamp = now
+            self._by_key[key] = _Expectation(n, now)
 
     def creation_observed(self, key: str) -> None:
         with self._lock:
             exp = self._by_key.get(key)
             if exp is not None and exp.creations > 0:
                 exp.creations -= 1
-
-    def deletion_observed(self, key: str) -> None:
-        with self._lock:
-            exp = self._by_key.get(key)
-            if exp is not None and exp.deletions > 0:
-                exp.deletions -= 1
 
     def satisfied(self, key: str, now: float = None) -> bool:
         """True when it is safe to compute a fresh diff for this job."""
@@ -70,7 +55,7 @@ class ControllerExpectations:
             exp = self._by_key.get(key)
             if exp is None:
                 return True
-            if exp.creations <= 0 and exp.deletions <= 0:
+            if exp.creations <= 0:
                 return True
             # Expired expectations are treated as satisfied (reference
             # behavior: controller must not deadlock on a lost event).

@@ -38,6 +38,7 @@ from .expectations import ControllerExpectations
 from .gang import GangScheduler
 from .metrics import MetricsRegistry
 from .runner import ProcessRunner, ReplicaHandle, replica_name, replica_slots
+from .store import key_to_fs
 from .status import (
     ACTION_FAIL_JOB,
     ACTION_NONE,
@@ -106,7 +107,7 @@ class Reconciler:
         so the ``/``→``_`` flattening cannot collide."""
         if root is None:
             return None
-        d = root / key.replace("/", "_")
+        d = root / key_to_fs(key)
         d.mkdir(parents=True, exist_ok=True)
         return str(d)
 
@@ -303,7 +304,7 @@ class Reconciler:
         dir — their reports are still this job's."""
         if self.status_root is None:
             return
-        d = self.status_root / key.replace("/", "_")
+        d = self.status_root / key_to_fs(key)
         if d.is_dir():
             import shutil
 
@@ -318,7 +319,7 @@ class Reconciler:
         the schedule-to-first-step latency probe (BASELINE.json:2)."""
         if job.status.first_step_time is not None or self.status_root is None:
             return
-        d = self.status_root / key.replace("/", "_")
+        d = self.status_root / key_to_fs(key)
         if not d.is_dir():
             return
         earliest = None
@@ -658,24 +659,36 @@ class Reconciler:
                 self._desired_replicas(job, rt) for rt in job.spec.replica_specs
             )
             self.expectations.expect_creations(key, len(missing), now=now)
-            for rtype, index in missing:
-                env = build_cluster_env(
-                    job, rtype, index,
-                    num_processes=num_processes,
-                    coordinator_host=self.coordinator_host,
-                    status_dir=status_dir,
-                    checkpoint_dir=checkpoint_dir,
-                    compile_cache_dir=cache_dir,
+            try:
+                for rtype, index in missing:
+                    env = build_cluster_env(
+                        job, rtype, index,
+                        num_processes=num_processes,
+                        coordinator_host=self.coordinator_host,
+                        status_dir=status_dir,
+                        checkpoint_dir=checkpoint_dir,
+                        compile_cache_dir=cache_dir,
+                    )
+                    self.runner.create(
+                        key, rtype, index, job.spec.replica_specs[rtype].template, env
+                    )
+                    self.expectations.creation_observed(key)
+                    self.metrics.replicas_created.inc()
+                    self.events.normal(
+                        key, "SuccessfulCreateReplica",
+                        f"Created replica {replica_name(key, rtype, index)}.",
+                    )
+            except Exception as e:
+                # The reference calls CreationObserved on create error:
+                # un-launched expectations must not gate this job's syncs
+                # for the full expectation timeout once the caller
+                # recovers. Surface the failure as an event, then
+                # propagate (the job retries on the next pass).
+                self.expectations.delete_expectations(key)
+                self.events.warning(
+                    key, "FailedCreateReplica", f"replica create failed: {e}"
                 )
-                self.runner.create(
-                    key, rtype, index, job.spec.replica_specs[rtype].template, env
-                )
-                self.expectations.creation_observed(key)
-                self.metrics.replicas_created.inc()
-                self.events.normal(
-                    key, "SuccessfulCreateReplica",
-                    f"Created replica {replica_name(key, rtype, index)}.",
-                )
+                raise
             handles = self.runner.list_for_job(key)
 
         # ---- elastic grow-back toward the submitted target ----

@@ -25,6 +25,7 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from ..api.types import ProcessTemplate, ReplicaPhase, ReplicaType
+from .store import key_to_fs
 
 
 def replica_name(job_key: str, rtype: ReplicaType, index: int) -> str:
@@ -361,10 +362,10 @@ class SubprocessRunner(ProcessRunner):
     # ---- persistence + adoption ----
 
     def _record_path(self, name: str) -> Path:
-        return self.replica_dir / (name.replace("/", "_") + ".json")
+        return self.replica_dir / (key_to_fs(name) + ".json")
 
     def _exit_path(self, name: str) -> Path:
-        return self.replica_dir / (name.replace("/", "_") + ".exit")
+        return self.replica_dir / (key_to_fs(name) + ".exit")
 
     def _save(self, h: ReplicaHandle, only_if_tracked: bool = False) -> None:
         """``only_if_tracked``: phase-update saves must not resurrect a
@@ -487,7 +488,7 @@ class SubprocessRunner(ProcessRunner):
         with self._lock:
             if name in self.handles and self.handles[name].is_active():
                 raise RuntimeError(f"duplicate create for live replica {name}")
-            log_path = self.log_dir / (name.replace("/", "_") + ".log")
+            log_path = self.log_dir / (key_to_fs(name) + ".log")
             full_env = dict(os.environ)
             full_env.update(template.env)
             full_env.update(env)

@@ -22,6 +22,19 @@ def job_key(job: TPUJob) -> str:
     return f"{job.metadata.namespace}/{job.metadata.name}"
 
 
+def key_to_fs(key: str) -> str:
+    """``ns/name`` → filesystem-safe ``ns_name`` — the ONE definition of
+    the flattening every persistence surface (jobs, events, logs, status,
+    markers) uses. Safe because DNS-1123 validation bans underscores in
+    names; change it here, not at call sites."""
+    return key.replace("/", "_")
+
+
+def fs_to_key(name: str) -> str:
+    """Inverse of :func:`key_to_fs` (first underscore splits ns/name)."""
+    return name.replace("_", "/", 1)
+
+
 class JobStore:
     def __init__(self, persist_dir: Optional[Path] = None):
         self._jobs: Dict[str, TPUJob] = {}
@@ -48,7 +61,7 @@ class JobStore:
                 continue
 
     def _path_for(self, key: str) -> Path:
-        return self.persist_dir / (key.replace("/", "_") + ".json")
+        return self.persist_dir / (key_to_fs(key) + ".json")
 
     def _load_all(self) -> None:
         for p in sorted(self.persist_dir.glob("*.json")):
@@ -145,7 +158,7 @@ class JobStore:
         """
         if self.persist_dir is None:
             return self.get(key)
-        p = self.persist_dir / (key.replace("/", "_") + ".json")
+        p = self.persist_dir / (key_to_fs(key) + ".json")
         with self._lock:
             try:
                 job = TPUJob.from_dict(json.loads(p.read_text()))
@@ -158,7 +171,7 @@ class JobStore:
             return job
 
     def _marker_path(self, key: str, kind: str) -> Path:
-        return self.persist_dir / (key.replace("/", "_") + "." + kind)
+        return self.persist_dir / (key_to_fs(key) + "." + kind)
 
     def mark_deletion(self, key: str, purge: bool = False, uid: str = "") -> None:
         """Leave a cross-process deletion request for the owning supervisor.
@@ -187,7 +200,7 @@ class JobStore:
             return []
         keys = []
         for p in self.persist_dir.glob("*.delete"):
-            keys.append(p.stem.replace("_", "/", 1))
+            keys.append(fs_to_key(p.stem))
         return keys
 
     def _read_deletion_marker(self, key: str) -> dict:
@@ -266,7 +279,7 @@ class JobStore:
                 job_dict = None
             claimed.unlink(missing_ok=True)
             if job_dict is not None:
-                out.append((p.stem.replace("_", "/", 1), job_dict))
+                out.append((fs_to_key(p.stem), job_dict))
         return out
 
     def mark_suspend(self, key: str, suspend: bool) -> None:
@@ -301,7 +314,7 @@ class JobStore:
             flag = {"0": False, "1": True}.get(content)
             claimed.unlink(missing_ok=True)
             if flag is not None:
-                out.append((p.stem.replace("_", "/", 1), flag))
+                out.append((fs_to_key(p.stem), flag))
         return out
 
     def mark_scale(self, key: str, workers: int) -> None:
@@ -335,7 +348,7 @@ class JobStore:
                 workers = None
             claimed.unlink(missing_ok=True)
             if workers is not None:
-                out.append((p.stem.replace("_", "/", 1), workers))
+                out.append((fs_to_key(p.stem), workers))
         return out
 
 
@@ -349,6 +362,6 @@ def purge_job_artifacts(state_dir: Path, key: str) -> None:
     import shutil
 
     for root in ARTIFACT_ROOTS:
-        d = Path(state_dir) / root / key.replace("/", "_")
+        d = Path(state_dir) / root / key_to_fs(key)
         if d.exists():
             shutil.rmtree(d, ignore_errors=True)

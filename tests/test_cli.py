@@ -212,3 +212,57 @@ class TestEvents:
     def test_events_empty_state(self, tmp_path, capsys):
         assert run_cli("--state-dir", tmp_path / "fresh", "events") == 0
         assert "no events" in capsys.readouterr().out
+
+
+class TestEventRecorder:
+    def test_consecutive_duplicates_aggregate_with_count(self, tmp_path):
+        """k8s-style aggregation: a restart-looping job must not grow the
+        event log (memory OR sink file) without bound."""
+        from pytorch_operator_tpu.controller.events import EventRecorder
+
+        rec = EventRecorder(sink_dir=tmp_path / "events")
+        for _ in range(500):
+            rec.warning("default/loop", "TPUJobRestarting", "restarting replica(s) x.")
+        evs = rec.for_job("default/loop")
+        assert len(evs) == 1
+        assert evs[0].count == 500
+        sink = tmp_path / "events" / "default_loop.events.jsonl"
+        assert len(sink.read_text().splitlines()) == 1  # first occurrence only
+
+    def test_memory_cap_keeps_newest(self, tmp_path):
+        from pytorch_operator_tpu.controller.events import (
+            MAX_EVENTS_PER_JOB,
+            EventRecorder,
+        )
+
+        rec = EventRecorder()
+        for i in range(MAX_EVENTS_PER_JOB + 50):
+            rec.normal("default/busy", "R", f"msg {i}")  # all distinct
+        evs = rec.for_job("default/busy")
+        assert len(evs) == MAX_EVENTS_PER_JOB
+        assert evs[-1].message == f"msg {MAX_EVENTS_PER_JOB + 49}"
+
+    def test_drop_job_removes_sink_file(self, tmp_path):
+        """A resubmitted incarnation's describe must not open with the
+        deleted incarnation's history."""
+        from pytorch_operator_tpu.controller.events import EventRecorder
+
+        rec = EventRecorder(sink_dir=tmp_path / "events")
+        rec.warning("default/gone", "TPUJobFailed", "boom")
+        sink = tmp_path / "events" / "default_gone.events.jsonl"
+        assert sink.exists()
+        rec.drop_job("default/gone")
+        assert not sink.exists()
+        assert rec.for_job("default/gone") == []
+
+    def test_sink_write_failure_does_not_raise(self, tmp_path):
+        """The sink is a best-effort mirror: an unwritable events dir must
+        not crash the reconcile path (the daemon's crash teardown would
+        kill live training worlds over a log line)."""
+        from pytorch_operator_tpu.controller.events import EventRecorder
+
+        blocked = tmp_path / "events"
+        blocked.write_text("a file where the dir should be")
+        rec = EventRecorder(sink_dir=blocked)
+        rec.normal("default/ok", "R", "m")  # must not raise
+        assert rec.for_job("default/ok")[0].reason == "R"
