@@ -331,20 +331,18 @@ def cmd_get(args) -> int:
 def cmd_events(args) -> int:
     """kubectl get events analog: merged per-job event logs, oldest first,
     bounded by --tail."""
+    from pytorch_operator_tpu.controller.events import load_merged_events
+
     ev_dir = _state_dir(args) / "events"
     records = []
     if ev_dir.is_dir():
         for p in sorted(ev_dir.glob("*.events.jsonl")):
             obj = fs_to_key(p.name[: -len(".events.jsonl")])
-            for line in p.read_text().splitlines():
-                if not line.strip():
-                    continue
-                try:
-                    rec = json.loads(line)
-                    ts = float(rec.get("timestamp", 0.0))
-                except (ValueError, TypeError, AttributeError):
-                    continue  # skip torn/foreign lines, not the whole command
-                records.append((ts, obj, rec))
+            # A repeating event appends updated records (cumulative
+            # count); the loader collapses runs so one crash-loop warning
+            # shows once with its live count, not once per flush.
+            for rec in load_merged_events(p):
+                records.append((float(rec.get("timestamp", 0.0)), obj, rec))
     records.sort(key=lambda r: r[0])
     if args.tail > 0:
         records = records[-args.tail :]
@@ -353,13 +351,17 @@ def cmd_events(args) -> int:
         return 0
     rows = [("AGE", "TYPE", "OBJECT", "REASON", "MESSAGE")]
     for ts, obj, rec in records:
+        count = int(rec.get("count", 1) or 1)
+        msg = str(rec.get("message", ""))
+        if count > 1:
+            msg += f" (x{count})"
         rows.append(
             (
                 _age(ts),
                 str(rec.get("type", "?")),
                 obj,
                 str(rec.get("reason", "?")),
-                str(rec.get("message", "")),
+                msg,
             )
         )
     widths = [max(len(r[i]) for r in rows) for i in range(4)]
@@ -429,14 +431,13 @@ def cmd_describe(args) -> int:
         )
     ev_path = state / "events" / (key_to_fs(key) + ".events.jsonl")
     print("Events:")
-    if ev_path.exists():
-        for line in ev_path.read_text().splitlines():
-            try:
-                ev = json.loads(line)
-            except ValueError:
-                continue
-            print(f"  [{ev['type']}] {ev['reason']}: {ev['message']}")
-    else:
+    from pytorch_operator_tpu.controller.events import load_merged_events
+
+    merged = load_merged_events(ev_path)
+    for ev in merged:
+        tail = f" (x{ev['count']})" if int(ev.get("count", 1) or 1) > 1 else ""
+        print(f"  [{ev.get('type', '?')}] {ev.get('reason', '?')}: {ev.get('message', '')}{tail}")
+    if not merged:
         print("  <none>")
     return 0
 

@@ -23,9 +23,74 @@ from typing import Dict, List, Optional
 EVENT_NORMAL = "Normal"
 EVENT_WARNING = "Warning"
 
-# In-memory cap per job (the JSONL sink keeps first occurrences only, and
-# is reset with the job — see drop_job).
+# In-memory cap per job (the JSONL sink is reset with the job — see
+# drop_job).
 MAX_EVENTS_PER_JOB = 1000
+
+# Aggregated duplicates are flushed to the JSONL sink when the count has
+# doubled since the last flush OR this much time has passed — O(log n)
+# disk growth for n repeats, while the CLI (which reads only the sink)
+# sees a count/timestamp at most this stale.
+AGGREGATE_FLUSH_INTERVAL_S = 30.0
+
+
+def load_merged_events(path) -> List[dict]:
+    """Read one JSONL sink file and return its merged records — THE way
+    to consume a sink (CLI events/describe and tests all go through
+    here, so parsing robustness and format changes have one fix point).
+    Torn, foreign, or malformed lines are skipped, never fatal: the sink
+    is a best-effort observability mirror."""
+    records = []
+    try:
+        text = Path(path).read_text()
+    except OSError:
+        return []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+            float(rec.get("timestamp", 0.0))
+            int(rec.get("count", 1) or 1)
+        except (ValueError, TypeError, AttributeError):
+            continue
+        records.append(rec)
+    return merge_event_records(records)
+
+
+def merge_event_records(records: List[dict]) -> List[dict]:
+    """Collapse consecutive sink records of the same (type, reason,
+    message) into one. The reader-side half of the aggregation protocol:
+    the recorder appends cumulative-count update records for a repeating
+    event instead of rewriting the file.
+
+    Counts are cumulative WITHIN a recorder incarnation but reset when a
+    restarted supervisor re-emits the same event, so a consecutive run is
+    summed per incarnation: a count <= the running maximum marks a new
+    incarnation whose occurrences add to (not replace) the prior ones.
+    Timestamp/ordering come from the last record of the run."""
+    out: List[dict] = []
+    base = cur_max = 0
+    for rec in records:
+        count = int(rec.get("count", 1) or 1)
+        if (
+            out
+            and out[-1].get("type") == rec.get("type")
+            and out[-1].get("reason") == rec.get("reason")
+            and out[-1].get("message") == rec.get("message")
+        ):
+            if count > cur_max:
+                cur_max = count  # same incarnation, fresher cumulative count
+            else:
+                base += cur_max  # count reset: a new incarnation's first record
+                cur_max = count
+            merged = dict(rec)
+            merged["count"] = base + cur_max
+            out[-1] = merged
+        else:
+            out.append(rec)
+            base, cur_max = 0, count
+    return out
 
 
 @dataclass
@@ -83,8 +148,26 @@ class EventRecorder:
             ):
                 # Consecutive duplicate: aggregate instead of appending
                 # (a fast restart loop must not grow memory/disk forever).
-                log[-1].count += 1
-                log[-1].timestamp = ev.timestamp
+                last = log[-1]
+                last.count += 1
+                last.timestamp = ev.timestamp
+                # The CLI reads only the sink; without a write-through a
+                # crash-looping job's repeated warning would show count=1
+                # with the first occurrence's timestamp forever. Flush on
+                # count-doubling or age so disk stays O(log n) per repeat
+                # run; readers collapse via merge_event_records.
+                if self.sink_dir is not None and (
+                    last.count >= 2 * getattr(last, "_flushed_count", 1)
+                    or ev.timestamp - getattr(last, "_flushed_time", 0.0)
+                    >= AGGREGATE_FLUSH_INTERVAL_S
+                ):
+                    try:
+                        with self._sink_path(job_key).open("a") as f:
+                            f.write(json.dumps(last.to_dict()) + "\n")
+                        last._flushed_count = last.count
+                        last._flushed_time = ev.timestamp
+                    except OSError:
+                        pass
                 return
             log.append(ev)
             if len(log) > MAX_EVENTS_PER_JOB:

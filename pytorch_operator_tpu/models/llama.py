@@ -267,7 +267,10 @@ class Attention(nn.Module):
         CONTRACT: positions must be batch-uniform (every row at the same
         offsets — the standard unpadded generate loop). The cache write
         offset and mask read row 0; left-padded/ragged batches would need
-        per-row offsets and are not supported here.
+        per-row offsets and are not supported here. Because a violation
+        is silently wrong (not an error), ``TPUJOB_DEBUG_CHECKS=1``
+        installs a host-callback assert at the model top level (see
+        ``Llama.__call__`` — once per step, not per layer).
         """
         cfg = self.cfg
         B, S, K, G, D = q.shape
@@ -459,11 +462,34 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, return_hidden: bool = False):
+        import os
+
         cfg = self.cfg
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[-1], dtype=jnp.int32), tokens.shape
             )
+        elif (
+            cfg.decode
+            and os.environ.get("TPUJOB_DEBUG_CHECKS", "").lower()
+            not in ("", "0", "false", "no")
+            and not self.is_initializing()
+        ):
+            # The decode path's KV-cache write offset and validity mask
+            # read positions row 0 (_decode_attend contract) — a ragged
+            # batch is silently wrong, not an error. Debug mode asserts
+            # batch-uniformity ONCE here at the model top (not per
+            # layer); costs one device->host sync per decode step.
+
+            def _assert_uniform(pos):
+                if not (pos == pos[0:1]).all():
+                    raise ValueError(
+                        "decode positions must be batch-uniform (unpadded "
+                        f"equal-length batch); got rows {pos}. Bucket "
+                        "ragged prompts to equal length first."
+                    )
+
+            jax.debug.callback(_assert_uniform, positions)
 
         embed = nn.Embed(
             cfg.vocab_size,

@@ -29,7 +29,15 @@ from ..runtime import rendezvous
 def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
     """Build a jitted ``generate(params, cache, prompt, rng) ->
     (tokens [B, max_new_tokens], cache)``. ``model`` must be built with
-    ``cfg.decode=True``; greedy when ``temperature == 0``."""
+    ``cfg.decode=True``; greedy when ``temperature == 0``.
+
+    CONTRACT (inherited from ``Llama._decode_attend``): every prompt row
+    must occupy the same positions — i.e. an unpadded, equal-length
+    prompt batch. Left-padded/ragged prompts would attend wrongly (the
+    KV-cache write offset and mask read row 0); ragged batches must be
+    bucketed to equal length (or generated row-by-row) by the caller.
+    Set ``TPUJOB_DEBUG_CHECKS=1`` to assert this at runtime.
+    """
     import functools
 
     import jax

@@ -285,6 +285,25 @@ def run(
             if resumed is not None:
                 start_step, state = resumed
                 log(f"[llama] resumed from checkpoint at step {start_step}")
+                if (
+                    lr_schedule == "cosine"
+                    and not lr_decay_steps
+                    and not max_steps
+                    and start_step > 0
+                ):
+                    # The cosine horizon defaulted to THIS life's
+                    # steps+warmup, but the restored optimizer count is
+                    # global (= start_step + this life's steps): the whole
+                    # tail of this run sits past the decay horizon at
+                    # LR ~= 0 and trains in place.
+                    log(
+                        "[llama] WARNING: resuming at step "
+                        f"{start_step} with --lr-schedule cosine but no "
+                        "--max-steps/--lr-decay-steps: the decay horizon "
+                        f"defaulted to this life's {steps + max(warmup, 1)} "
+                        "steps, so the resumed run trains at LR~0. Pass "
+                        "--max-steps (global budget) or --lr-decay-steps."
+                    )
                 if loader is not None and start_step > 0:
                     # Fast-forward the data stream to where the previous
                     # life stopped (fixed seed ⇒ deterministic order):

@@ -85,18 +85,20 @@ def open_image_feed(
     ``[chunk, B, ...]`` as device arrays (bf16 images, i32 labels, one
     host transfer each). The loader hands out zero-copy views into a
     reused slot, so the copy into the stacked buffers is mandatory.
-    Labels are range-checked against ``classes`` on the first call
-    (out-of-range labels one_hot to all-zero rows and silently deflate
-    the loss). ``square=True`` additionally requires H == W (ViT's
-    position embeddings; ResNet is spatial-size-independent).
-    Caller owns ``loader.close()``.
+    Labels are range-checked against ``classes`` up front with a
+    whole-file streaming scan — a first-chunk sample would miss
+    out-of-range labels in later records, which one_hot to all-zero
+    rows and silently deflate the loss (the same gap the token path's
+    field_range scan closes). ``square=True`` additionally requires
+    H == W (ViT's position embeddings; ResNet is
+    spatial-size-independent). Caller owns ``loader.close()``.
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from ..data import open_training_loader, read_meta
+    from ..data import field_range, open_training_loader, read_meta
     from ..parallel.data import put_global
 
     if meta is None:
@@ -122,28 +124,24 @@ def open_image_feed(
         raise ValueError(
             f"--data-file holds {meta.n_records} records < global batch {batch}"
         )
+    lo, hi = field_range(data_file, meta, "y")
+    if int(lo) < 0 or int(hi) >= classes:
+        raise ValueError(
+            f"--data-file labels span [{int(lo)}, {int(hi)}] but the model "
+            f"head has {classes} classes (pass --classes)"
+        )
     loader = open_training_loader(
         data_file, batch, seed=seed, processes=jax.process_count()
     )
     x_sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
-    checked = False
 
     def next_batches():
-        nonlocal checked
         sx = np.empty((chunk, batch) + field_x.shape, jnp.bfloat16)
         sy = np.empty((chunk, batch), np.int32)
         for i in range(chunk):
             _, _, fields = loader.next_batch()
             sx[i] = fields["x"]  # casts f32 → bf16 in place
             sy[i] = fields["y"]
-        if not checked:
-            top = int(sy.max())
-            if top >= classes:
-                raise ValueError(
-                    f"--data-file labels reach {top} but the model head has "
-                    f"{classes} classes (pass --classes)"
-                )
-            checked = True
         return put_global(sx, x_sh), put_global(sy, x_sh)
 
     return next_batches, loader

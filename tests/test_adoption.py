@@ -142,15 +142,18 @@ class TestRunnerAdoption:
 
 
 def _creation_events(state_dir: Path, key: str) -> int:
-    """Count SuccessfulCreateReplica in the PERSISTED event log — it spans
-    supervisor incarnations (the in-memory recorder dies with each one)."""
+    """Count SuccessfulCreateReplica OCCURRENCES in the PERSISTED event
+    log — it spans supervisor incarnations (the in-memory recorder dies
+    with each one). The sink may hold cumulative-count update records for
+    a repeating event (the aggregation write-through), so raw lines
+    over-count: merge first, then sum the merged counts."""
+    from pytorch_operator_tpu.controller.events import load_merged_events
+
     p = state_dir / "events" / (key.replace("/", "_") + ".events.jsonl")
-    if not p.exists():
-        return 0
     return sum(
-        1
-        for line in p.read_text().splitlines()
-        if line.strip() and json.loads(line)["reason"] == "SuccessfulCreateReplica"
+        int(rec.get("count", 1) or 1)
+        for rec in load_merged_events(p)
+        if rec["reason"] == "SuccessfulCreateReplica"
     )
 
 

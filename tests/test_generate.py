@@ -93,6 +93,41 @@ class TestGenerate:
         with pytest.raises(ValueError, match="max_decode_len"):
             gen(params, cache, prompt, jax.random.key(0))
 
+    def test_debug_checks_reject_ragged_positions(self, monkeypatch):
+        """ADVICE r2: _decode_attend's batch-uniform-positions contract is
+        silently wrong when violated (cache offset/mask read row 0); with
+        TPUJOB_DEBUG_CHECKS=1 a ragged-prompt caller must get an error,
+        not wrong attention."""
+        import jax
+        import jax.numpy as jnp
+        import pytest
+
+        monkeypatch.setenv("TPUJOB_DEBUG_CHECKS", "1")
+        cfg, train_model, decode_model, params, prompt = _setup()
+        cache = init_cache(decode_model, prompt.shape[0], prompt.shape[1])
+        ragged = jnp.stack(
+            [jnp.arange(prompt.shape[1]), jnp.arange(prompt.shape[1]) + 1]
+        ).astype(jnp.int32)
+        with pytest.raises(Exception, match="batch-uniform"):
+            out, _ = decode_model.apply(
+                {"params": params, "cache": cache},
+                prompt,
+                positions=ragged,
+                mutable=["cache"],
+            )
+            jax.block_until_ready(out)
+        # Uniform positions pass the guard unchanged.
+        uniform = jnp.broadcast_to(
+            jnp.arange(prompt.shape[1], dtype=jnp.int32), prompt.shape
+        )
+        out, _ = decode_model.apply(
+            {"params": params, "cache": cache},
+            prompt,
+            positions=uniform,
+            mutable=["cache"],
+        )
+        jax.block_until_ready(out)
+
     def test_garbage_cache_contents_cannot_leak(self):
         """Every cache slot the mask allows reading is written by the
         current run first — a cache pre-filled with garbage must produce

@@ -150,6 +150,36 @@ class TestDataFileMode:
                 data_file=str(out), log=lambda *_: None,
             )
 
+    def test_bad_label_beyond_first_chunk_rejected(self, tmp_path):
+        """ADVICE r2: the old first-chunk latch sampled only the first
+        drawn batches; a bad label in a later record one-hotted to a zero
+        row and silently deflated the loss. The whole-file field_range
+        scan must reject it up front — before any batch is drawn."""
+        import numpy as np
+
+        from pytorch_operator_tpu.data import pack_arrays
+        from pytorch_operator_tpu.workloads.resnet_bench import run_benchmark
+
+        n = 64
+        x = np.random.default_rng(0).random((n, 16, 16, 3), np.float32)
+        y = np.full((n,), 3, np.int32)
+        y[-1] = 10  # out of range, and outside any first-chunk sample
+        out = tmp_path / "bad-tail.bin"
+        pack_arrays(out, {"x": x, "y": y})
+        with pytest.raises(ValueError, match="classes"):
+            run_benchmark(
+                depth=18, batch_size=16, classes=10, steps=2, warmup=1,
+                data_file=str(out), log=lambda *_: None,
+            )
+        y[-1] = -1  # negative ids are just as silent in one_hot
+        out2 = tmp_path / "bad-neg.bin"
+        pack_arrays(out2, {"x": x, "y": y})
+        with pytest.raises(ValueError, match="classes"):
+            run_benchmark(
+                depth=18, batch_size=16, classes=10, steps=2, warmup=1,
+                data_file=str(out2), log=lambda *_: None,
+            )
+
     def test_file_smaller_than_batch_rejected(self, tmp_path):
         from pytorch_operator_tpu.data.pack import main as pack_main
         from pytorch_operator_tpu.workloads.resnet_bench import run_benchmark

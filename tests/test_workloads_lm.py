@@ -211,6 +211,29 @@ def test_llama_async_checkpoint_resume(tmp_path, monkeypatch):
     assert r2["end_step"] == r1["end_step"] + 5
 
 
+def test_llama_cosine_resume_without_horizon_warns(tmp_path, monkeypatch):
+    """ADVICE r2: with --lr-schedule cosine and no --max-steps /
+    --lr-decay-steps the decay horizon defaults to this LIFE's steps, so
+    a resumed run (global optimizer count) trains its whole tail at
+    LR~0 — detectable at resume time, so it must warn."""
+    monkeypatch.setenv("TPUJOB_CHECKPOINT_DIR", str(tmp_path / "ck"))
+    kw = dict(
+        config="tiny", mesh_spec="fsdp=8", batch_size=8, seq_len=32,
+        steps=4, warmup=1, checkpoint_every=3, lr_schedule="cosine",
+    )
+    logs = []
+    llama_train.run(**kw, log=logs.append)
+    assert not any("LR~0" in m for m in logs), logs  # fresh run: no warning
+    logs = []
+    llama_train.run(**kw, log=logs.append)
+    assert any("resumed from checkpoint" in m for m in logs), logs
+    assert any("LR~0" in m for m in logs), logs
+    # An explicit global horizon silences it.
+    logs = []
+    llama_train.run(**kw, lr_decay_steps=64, log=logs.append)
+    assert not any("LR~0" in m for m in logs), logs
+
+
 def test_llama_max_steps_caps_work(tmp_path, monkeypatch):
     monkeypatch.setenv("TPUJOB_CHECKPOINT_DIR", str(tmp_path / "ck"))
     r1 = llama_train.run(
