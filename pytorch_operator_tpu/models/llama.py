@@ -85,6 +85,27 @@ class LlamaConfig:
     decode: bool = False
     max_decode_len: int = 2048
 
+    def __post_init__(self):
+        if (
+            self.n_experts > 0
+            and self.moe_dispatch == "sparse"
+            and not self.moe_aux_weight
+        ):
+            # Capacity-factor dispatch DROPS over-capacity tokens, so an
+            # unregularized router collapsing onto a few experts (the
+            # moe_aux_weight docstring's failure mode) also silently
+            # drops most of the batch — warn at construction, where every
+            # entry path (workload flags, library use, import) passes.
+            import warnings
+
+            warnings.warn(
+                "moe_dispatch='sparse' with moe_aux_weight=0: without the "
+                "load-balance loss the router can collapse onto a few "
+                "experts and capacity-factor dispatch then drops most "
+                "tokens. Set moe_aux_weight~1e-2.",
+                stacklevel=2,
+            )
+
     @property
     def q_per_kv(self) -> int:
         return self.n_heads // self.n_kv_heads
@@ -547,6 +568,14 @@ class Llama(nn.Module):
             mesh=mesh, microbatches=microbatches, return_hidden=return_hidden,
         )
 
+    @nn.nowrap
+    def pp_value_and_grad(self, params, tokens, *, mesh, microbatches):
+        """Model-owned 1F1B train gradients (the make_lm_train_step hook
+        for ``--pp-schedule 1f1b``); see :func:`train_value_and_grad_pp`."""
+        return train_value_and_grad_pp(
+            self, params, tokens, mesh=mesh, microbatches=microbatches
+        )
+
 
 def forward_pp(
     model: "Llama",
@@ -572,9 +601,36 @@ def forward_pp(
     Constraints: ``cfg.n_layers % pp == 0``; ring attention (sp) cannot
     nest inside the pp pipeline.
     """
-    import jax
-
     from ..parallel.pipeline import pipeline_apply
+
+    cfg = model.cfg
+    p, stage_params, stage = _pp_parts(model, params, mesh)
+
+    # Embedding lookup, matching nn.Embed(dtype=cfg.dtype) semantics
+    # (table cast to the compute dtype, then take).
+    x = p["embed"]["embedding"].astype(cfg.dtype)[tokens]
+
+    x = pipeline_apply(
+        stage, stage_params, x, mesh=mesh, microbatches=microbatches
+    )
+
+    x = RMSNorm(cfg.rms_eps, name="final_norm").apply(
+        {"params": p["final_norm"]}, x
+    )
+    if return_hidden:
+        return x
+    # DenseGeneral(dtype=float32) semantics: promote input and kernel.
+    w = p["lm_head"]["kernel"]
+    return x.astype(jnp.float32) @ w.astype(jnp.float32)
+
+
+def _pp_parts(model: "Llama", params, mesh):
+    """The shared pp decomposition behind forward_pp and
+    train_value_and_grad_pp: ``(unboxed_params, stage_params, stage_fn)``
+    — the scan-stacked layer params (leading axis n_layers) regrouped
+    into P stages of n_layers/P consecutive layers, and the per-stage
+    computation over them."""
+    import jax
 
     cfg = model.cfg
     n_stages = mesh.shape["pp"]
@@ -585,15 +641,9 @@ def forward_pp(
     if cfg.attn_impl == "ring":
         raise ValueError("attn_impl='ring' cannot run inside the pp pipeline")
     p = nn.meta.unbox(params)
-
-    # Embedding lookup, matching nn.Embed(dtype=cfg.dtype) semantics
-    # (table cast to the compute dtype, then take).
-    x = p["embed"]["embedding"].astype(cfg.dtype)[tokens]
-
-    layers = p["layers"]
     stage_params = jax.tree.map(
         lambda l: l.reshape((n_stages, cfg.n_layers // n_stages) + l.shape[1:]),
-        layers,
+        p["layers"],
     )
     # Blocks inside the pipeline get mesh=None: pp is already manual in
     # pipeline_apply, and the remaining axes (dp/fsdp) are compiler-
@@ -621,15 +671,86 @@ def forward_pp(
         (act_out, _pos), _ = jax.lax.scan(layer, (act, pos), sp)
         return act_out
 
-    x = pipeline_apply(
-        stage, stage_params, x, mesh=mesh, microbatches=microbatches
-    )
+    return p, stage_params, stage
 
-    x = RMSNorm(cfg.rms_eps, name="final_norm").apply(
-        {"params": p["final_norm"]}, x
+
+def train_value_and_grad_pp(
+    model: "Llama",
+    params,
+    tokens,
+    *,
+    mesh,
+    microbatches: int,
+):
+    """1F1B fused train gradients for the llama stack: returns
+    ``(loss, grads)`` with grads matching the (boxed) params tree —
+    numerically equal to ``jax.value_and_grad`` over the GPipe forward,
+    but with per-stage activation residency bounded by the schedule
+    depth O(P·mb) instead of O(M·mb)
+    (parallel/pipeline.pipeline_value_and_grad).
+
+    The embed lookup runs outside the pipeline (its input-cotangent
+    stream dx comes back from the pipeline's backward); the final norm +
+    LM head + next-token loss run INSIDE as the per-microbatch loss tail
+    at the last stage, honoring ``cfg.xent_impl`` (chunked or dense).
+    MoE aux losses are not supported on pp meshes (same restriction as
+    the GPipe path — flax sow collections don't thread the pipeline).
+    """
+    import jax
+    import optax
+
+    from ..parallel.pipeline import pipeline_value_and_grad
+
+    cfg = model.cfg
+    if getattr(cfg, "moe_aux_weight", 0.0):
+        raise ValueError(
+            "moe_aux_weight is not supported on a pp mesh (the pipeline "
+            "path bypasses flax sow collections)"
+        )
+    p, stage_params, stage = _pp_parts(model, params, mesh)
+
+    x, embed_vjp = jax.vjp(
+        lambda table: table.astype(cfg.dtype)[tokens], p["embed"]["embedding"]
     )
-    if return_hidden:
-        return x
-    # DenseGeneral(dtype=float32) semantics: promote input and kernel.
-    w = p["lm_head"]["kernel"]
-    return x.astype(jnp.float32) @ w.astype(jnp.float32)
+    lp = {"final_norm": p["final_norm"], "lm_head": p["lm_head"]}
+
+    def loss_fn(lp_, y_mb, tok_mb):
+        h = RMSNorm(cfg.rms_eps).apply({"params": lp_["final_norm"]}, y_mb)
+        w = lp_["lm_head"]["kernel"]
+        if cfg.xent_impl == "chunked":
+            from ..ops.chunked_xent import chunked_softmax_xent
+
+            hh = h[:, :-1].reshape(-1, h.shape[-1])
+            return chunked_softmax_xent(
+                hh, w, tok_mb[:, 1:].reshape(-1)
+            ).mean()
+        logits = h.astype(jnp.float32) @ w.astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tok_mb[:, 1:]
+        ).mean()
+
+    loss, (d_stage, d_lp, dx) = pipeline_value_and_grad(
+        stage, loss_fn, stage_params, lp, x, tokens,
+        mesh=mesh, microbatches=microbatches, schedule="1f1b",
+    )
+    (d_embed,) = embed_vjp(dx)
+    grads_unboxed = {
+        "embed": {"embedding": d_embed},
+        "layers": jax.tree.map(
+            lambda g, ref: g.reshape(ref.shape), d_stage, p["layers"]
+        ),
+        "final_norm": d_lp["final_norm"],
+        "lm_head": d_lp["lm_head"],
+    }
+    # Re-box to the params tree's flax metadata so the optimizer sees the
+    # exact params structure (Partitioned leaves and all).
+    return loss, jax.tree.map(
+        lambda box, g: (
+            box.replace_boxed(g)
+            if isinstance(box, nn.meta.Partitioned)
+            else g
+        ),
+        params,
+        grads_unboxed,
+        is_leaf=lambda v: isinstance(v, nn.meta.Partitioned),
+    )

@@ -53,6 +53,23 @@ def chunked_softmax_xent(hidden, w, labels, *, chunk: int = 8192):
     return _xent(hidden, w, labels, n_chunks, c)
 
 
+def _match_vma(tree, ref):
+    """pcast every leaf of ``tree`` to carry ``ref``'s varying manual
+    axes (shard_map vma) — makes freshly-built scan carries type-stable
+    when this op runs inside a manual region. Identity elsewhere."""
+    vma = getattr(jax.typeof(ref), "vma", frozenset())
+    if not vma:
+        return tree
+    return jax.tree.map(
+        lambda v: (
+            v
+            if set(getattr(jax.typeof(v), "vma", frozenset())) >= set(vma)
+            else jax.lax.pcast(v, tuple(vma), to="varying")
+        ),
+        tree,
+    )
+
+
 def _chunk_slice(w, c_idx, chunk):
     """``w[:, start : start+chunk]`` with the clamped start dynamic_slice
     uses; returns (w_chunk, start). For the tail chunk start < c_idx*chunk,
@@ -100,6 +117,11 @@ def _xent_fwd(hidden, w, labels, n_chunks: int, chunk: int):
         jnp.zeros((N,), jnp.float32),
         jnp.zeros((N,), jnp.float32),
     )
+    # Inside a shard_map manual region (the 1F1B pipeline's loss tail)
+    # the scan body is axis-varying via hidden/w while these fresh zeros
+    # are invariant — pcast so the carry types agree. No-op outside
+    # manual regions (vma is empty there).
+    init = _match_vma(init, hidden)
     (m, s, lab_logit), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
     lse = m + jnp.log(s)
     return lse - lab_logit, (hidden, w, labels, lse)
@@ -143,7 +165,10 @@ def _xent_bwd(n_chunks: int, chunk: int, res, ct):
 
     (dh, dw), _ = jax.lax.scan(
         body,
-        (jnp.zeros((N, D), jnp.float32), jnp.zeros(w.shape, jnp.float32)),
+        _match_vma(
+            (jnp.zeros((N, D), jnp.float32), jnp.zeros(w.shape, jnp.float32)),
+            hidden,
+        ),
         jnp.arange(n_chunks),
     )
     zeros_lab = np.zeros(labels.shape, jax.dtypes.float0)

@@ -156,3 +156,254 @@ def pipeline_apply(
         axis_names={axis},
     )(stage_params, xm)
     return outs.reshape(x.shape)
+
+
+def pipeline_value_and_grad(
+    fn: Callable,
+    loss_fn: Callable,
+    stage_params,
+    loss_params,
+    x,
+    targets,
+    *,
+    mesh,
+    microbatches: int,
+    axis: str = "pp",
+    schedule: str = "1f1b",
+):
+    """Fused pipelined train-step gradients: returns
+    ``(loss, (d_stage_params, d_loss_params, dx))`` for
+
+        L = mean_j loss_fn(loss_params, fn(params_{P-1}, ... fn(params_0,
+            x_j)), targets_j)
+
+    over ``microbatches`` microbatches j.
+
+    ``schedule="gpipe"`` is ``jax.value_and_grad`` over
+    :func:`pipeline_apply` (autodiff's reverse pipeline): simple, but
+    every stage's backward holds residuals for ALL M of its microbatches
+    — per-stage activation residency O(M·mb).
+
+    ``schedule="1f1b"`` interleaves one-forward-one-backward in a single
+    ``lax.scan``: at tick t stage s forwards microbatch ``t - s`` and
+    backwards microbatch ``t - 2(P-1) + s`` (the last stage backwards a
+    microbatch the same tick its forward finishes — the 1F1B signature).
+    Only the stage INPUT of each in-flight microbatch is saved, in a ring
+    buffer of depth 2P whose size is set by the schedule's in-flight
+    window 2(P-1-s)+1 <= 2P-1 ticks — per-stage residency O(P·mb),
+    INDEPENDENT of M (the memory regression test pins this), with the
+    stage body recomputed from the saved input during backward
+    (remat-equivalent FLOPs). Numerics match "gpipe" exactly: same fn,
+    same loss, same masked-psum stream layout — only the execution order
+    differs. Cotangents ride the reverse ring (``ppermute`` i -> i-1)
+    while forward activations ride i -> i+1, so steady-state ticks carry
+    1F + 1B concurrently and the schedule finishes in M + 2(P-1) ticks.
+
+    ``loss_fn(loss_params, y_mb, target_mb) -> scalar`` (mean over the
+    microbatch); its gradients are accumulated at the last stage and
+    psum-replicated out. Note the loss body is computed per-stage inside
+    the manual-pp region (masked to the last stage's result), so its
+    FLOPs duplicate P-fold over pp — keep loss_fn to the cheap tail
+    (norm + head + xent), which is a sliver of the stack.
+
+    Like :func:`pipeline_apply`: pure, call under your own ``jit``;
+    only ``axis`` is taken manual, other mesh axes stay with the
+    compiler. ``targets`` must lead with the same batch axis as ``x``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    if schedule == "gpipe":
+
+        def total_loss(sp, lp, xx):
+            y = pipeline_apply(
+                fn, sp, xx, mesh=mesh, microbatches=microbatches, axis=axis
+            )
+            ym = y.reshape((microbatches, y.shape[0] // microbatches) + y.shape[1:])
+            tm = targets.reshape(
+                (microbatches, targets.shape[0] // microbatches)
+                + targets.shape[1:]
+            )
+
+            def one(j):
+                return loss_fn(lp, ym[j], tm[j])
+
+            return jnp.mean(jax.vmap(one)(jnp.arange(microbatches)))
+
+        loss, grads = jax.value_and_grad(total_loss, argnums=(0, 1, 2))(
+            stage_params, loss_params, x
+        )
+        return loss, grads
+    if schedule != "1f1b":
+        raise ValueError(f"schedule={schedule!r} not in ('gpipe', '1f1b')")
+
+    n_stages = mesh.shape[axis]
+    M = microbatches
+    B = x.shape[0]
+    if M < 1:
+        raise ValueError("microbatches must be >= 1")
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    if M % n_stages:
+        raise ValueError(
+            f"microbatches {M} not divisible by pp extent {n_stages} "
+            "(the microbatch stream is sharded over pp)"
+        )
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stage_params leading axes {leading} != pp extent {n_stages}"
+        )
+
+    param_spec = jax.tree.map(lambda _: P(axis), stage_params)
+    loss_spec = jax.tree.map(lambda _: P(), loss_params)
+    mb_per_dev = M // n_stages
+    D = 2 * n_stages  # saved-input ring depth: covers the 2(P-1)+1 window
+    xm = x.reshape((M, B // M) + x.shape[1:])
+    tm = targets.reshape((M, B // M) + targets.shape[1:])
+
+    def per_stage(params_local, lp, xm_local, tm_local):
+        params_local = jax.tree.map(lambda l: l[0], params_local)
+        s = jax.lax.axis_index(axis)
+        zero_mb = jnp.zeros_like(xm_local[0])
+        last = n_stages - 1
+
+        def _varying(v):
+            if axis in getattr(jax.typeof(v), "vma", ()):
+                return v
+            return jax.lax.pcast(v, (axis,), to="varying")
+
+        # CRITICAL: lp arrives pp-INVARIANT (replicated in_spec), and
+        # jax.vjp inside a manual region inserts an automatic psum on the
+        # cotangent of an invariant primal — which would sum every
+        # stage's dlp (including the P-1 stages' garbage contributions)
+        # BEFORE the at_last mask can drop them. pcast to varying so the
+        # loss vjp stays stage-local; the masked accumulate + final psum
+        # then count exactly the last stage's real contributions.
+        lp = jax.tree.map(_varying, lp)
+
+        def tick(carry, t):
+            act_in, cot_in, inbuf, dp_acc, dlp_acc, loss_acc, dx_local = carry
+
+            # ---- forward half (the GPipe wavefront) ----
+            t_in = jnp.clip(t, 0, M - 1)
+            feed = jnp.where(
+                s == t_in // mb_per_dev,
+                jax.lax.dynamic_index_in_dim(
+                    xm_local, t_in % mb_per_dev, 0, keepdims=False
+                ),
+                zero_mb,
+            )
+            mb = jax.lax.psum(feed, axis)
+            inp = jnp.where(s == 0, mb, act_in)
+            jf = t - s  # the microbatch this stage forwards this tick
+            f_valid = (jf >= 0) & (jf < M)
+            # Save the stage INPUT for the backward recompute — the ONLY
+            # per-microbatch state 1F1B keeps (ring slot jf mod D; the
+            # slot is free again after 2P ticks > the in-flight window).
+            slot_f = jnp.clip(jf, 0, M - 1) % D
+            cur = jax.lax.dynamic_index_in_dim(inbuf, slot_f, 0, keepdims=False)
+            inbuf = jax.lax.dynamic_update_index_in_dim(
+                inbuf, jnp.where(f_valid, inp, cur), slot_f, 0
+            )
+            y = fn(params_local, inp)
+            act_next = jax.lax.ppermute(
+                y, axis, [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            )
+
+            # ---- loss at the last stage (for the mb finishing there) ----
+            jt = jnp.clip(t - last, 0, M - 1)
+            tfeed = jnp.where(
+                s == jt // mb_per_dev,
+                jax.lax.dynamic_index_in_dim(
+                    tm_local, jt % mb_per_dev, 0, keepdims=False
+                ),
+                jnp.zeros_like(tm_local[0]),
+            )
+            tgt = jax.lax.psum(tfeed, axis)
+            lval, loss_vjp = jax.vjp(lambda l, yy: loss_fn(l, yy, tgt), lp, y)
+            dlp, dy = loss_vjp(jnp.ones_like(lval))
+            at_last = (s == last) & (t - last >= 0) & (t - last < M)
+            loss_acc = loss_acc + jnp.where(at_last, lval, 0.0)
+            dlp_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(at_last, g, jnp.zeros_like(g)),
+                dlp_acc,
+                dlp,
+            )
+
+            # ---- backward half (1F1B: starts while forwards still run) ----
+            jb = t - 2 * last + s  # the microbatch this stage backwards
+            b_valid = (jb >= 0) & (jb < M)
+            cot = jnp.where(s == last, dy, cot_in)
+            slot_b = jnp.clip(jb, 0, M - 1) % D
+            saved = jax.lax.dynamic_index_in_dim(inbuf, slot_b, 0, keepdims=False)
+            _, stage_vjp = jax.vjp(fn, params_local, saved)
+            dparams, dx = stage_vjp(cot)
+            dp_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)),
+                dp_acc,
+                dparams,
+            )
+            # Stage 0 finishes the INPUT cotangent of mb j0 = t - 2(P-1)
+            # this tick: ship it back to j0's owner (masked psum,
+            # mirroring the forward feed) for the caller's embedding/
+            # input grads. NB: the owner/slot must come from j0 (stage
+            # 0's backward index), not this stage's jb.
+            j0 = t - 2 * last
+            j0_valid = (j0 >= 0) & (j0 < M)
+            j0s = jnp.clip(j0, 0, M - 1)
+            done_cot = jax.lax.psum(
+                jnp.where((s == 0) & b_valid, dx, jnp.zeros_like(dx)), axis
+            )
+            write = j0_valid & (s == j0s // mb_per_dev)
+            slot_o = j0s % mb_per_dev
+            cur_o = jax.lax.dynamic_index_in_dim(
+                dx_local, slot_o, 0, keepdims=False
+            )
+            dx_local = jax.lax.dynamic_update_index_in_dim(
+                dx_local, jnp.where(write, done_cot, cur_o), slot_o, 0
+            )
+            cot_next = jax.lax.ppermute(
+                dx, axis, [(i, (i - 1) % n_stages) for i in range(n_stages)]
+            )
+            return (
+                act_next, cot_next, inbuf, dp_acc, dlp_acc, loss_acc, dx_local
+            ), None
+
+        # Freshly-constructed zeros start axis-invariant, but every carry
+        # leaf becomes pp-varying inside the tick (stage-index masks) —
+        # pcast the whole init so the scan carry types are stable. Leaves
+        # already varying (derived from sharded params/inputs) must pass
+        # through untouched — pcast rejects varying->varying.
+        init = jax.tree.map(
+            _varying,
+            (
+                zero_mb,
+                zero_mb,
+                jnp.zeros((D,) + zero_mb.shape, zero_mb.dtype),
+                jax.tree.map(jnp.zeros_like, params_local),
+                jax.tree.map(jnp.zeros_like, lp),
+                jnp.zeros((), jnp.float32),
+                jnp.zeros_like(xm_local),
+            ),
+        )
+        (_, _, _, dp_acc, dlp_acc, loss_acc, dx_local), _ = jax.lax.scan(
+            tick, init, jnp.arange(M + 2 * last)
+        )
+        # Mean over microbatches; loss/dlp live only on the last stage,
+        # psum replicates them (making the replicated out_specs valid).
+        loss_out = jax.lax.psum(loss_acc, axis) / M
+        dlp_out = jax.tree.map(lambda a: jax.lax.psum(a, axis) / M, dlp_acc)
+        dp_out = jax.tree.map(lambda a: a[None] / M, dp_acc)
+        return loss_out, dp_out, dlp_out, dx_local / M
+
+    loss, d_stage, d_loss, dxm = shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(param_spec, loss_spec, P(axis), P(axis)),
+        out_specs=(P(), param_spec, loss_spec, P(axis)),
+        axis_names={axis},
+    )(stage_params, loss_params, xm, tm)
+    return loss, (d_stage, d_loss, dxm.reshape(x.shape))

@@ -73,6 +73,7 @@ def run(
     moe_capacity_factor: float | None = None,
     moe_aux_weight: float | None = None,
     pp_microbatches: int | None = None,
+    pp_schedule: str = "gpipe",
     preempt_at: int | None = None,
     profile_dir: str | None = None,
     log=print,
@@ -121,6 +122,15 @@ def run(
             "without experts no router exists, so the aux loss would be "
             "silently inert"
         )
+    if cfg.n_experts > 0 and cfg.moe_dispatch == "sparse" and not cfg.moe_aux_weight:
+        # LlamaConfig.__post_init__ raises a Python warning for library
+        # users; repeat on the job-log surface, where training output goes.
+        log(
+            "[llama] WARNING: --moe-dispatch sparse with no "
+            "--moe-aux-weight: an unbalanced router collapses onto a few "
+            "experts and capacity-factor dispatch then DROPS most tokens. "
+            "Pass --moe-aux-weight 1e-2."
+        )
 
     n_dev = jax.device_count()
     import os
@@ -165,7 +175,9 @@ def run(
     n_params = sum(p.size for p in jax.tree.leaves(state["params"]))
     log(f"[llama] {n_params/1e6:.1f}M params, sharded init +{time.time()-t_init:.1f}s")
 
-    train_step = make_lm_train_step(model, tx, mesh, microbatches=pp_microbatches)
+    train_step = make_lm_train_step(
+        model, tx, mesh, microbatches=pp_microbatches, pp_schedule=pp_schedule
+    )
     batch_sharding = named_sharding(mesh, "batch", "seq")
 
     # Fault injection (SURVEY.md §5 "fault injection = kill a worker
@@ -507,6 +519,13 @@ def main(argv=None) -> int:
         "(default 2 x pp extent; must be a multiple of it)",
     )
     p.add_argument(
+        "--pp-schedule", choices=("gpipe", "1f1b"), default="gpipe",
+        help="pipeline schedule on a pp mesh: gpipe (autodiff reverse "
+        "schedule, backward holds all M microbatch residuals per stage) "
+        "or 1f1b (fused one-forward-one-backward scan, residency bounded "
+        "by stage depth; identical numerics)",
+    )
+    p.add_argument(
         "--preempt-at", type=int, default=None,
         help="fault injection: die with a retryable exit code at this step "
         "on the replica's first life (simulated TPU preemption)",
@@ -546,6 +565,7 @@ def main(argv=None) -> int:
         moe_capacity_factor=args.moe_capacity_factor,
         moe_aux_weight=args.moe_aux_weight,
         pp_microbatches=args.pp_microbatches,
+        pp_schedule=args.pp_schedule,
         preempt_at=args.preempt_at,
         profile_dir=args.profile_dir,
         log=lambda msg: print(

@@ -267,7 +267,7 @@ def make_lm_loss_fn(model, mesh, microbatches=None, include_aux=True):
     return loss_fn
 
 
-def make_lm_train_step(model, tx, mesh, microbatches=None):
+def make_lm_train_step(model, tx, mesh, microbatches=None, pp_schedule="gpipe"):
     """Jitted LM train step, WITHOUT state donation.
 
     Keep it donation-free: async checkpointing (llama_train
@@ -276,9 +276,47 @@ def make_lm_train_step(model, tx, mesh, microbatches=None):
     under the save. (XLA still updates params efficiently; donation here
     buys little for the LM workloads.) Objective semantics are
     :func:`make_lm_loss_fn`'s.
+
+    On a pp mesh, ``pp_schedule`` picks the pipeline execution:
+    "gpipe" (autodiff's reverse schedule over the model's pp_forward —
+    per-stage backward residency O(M·mb)) or "1f1b" (the model's fused
+    pp_value_and_grad hook — residency O(P·mb), same numerics).
     """
     import jax
     import optax
+
+    pp = mesh.shape.get("pp", 1) > 1
+    if pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"pp_schedule={pp_schedule!r} not in ('gpipe', '1f1b')"
+        )
+    if pp_schedule == "1f1b" and not pp:
+        # Silently falling back to the sequential step would let a
+        # typo'd mesh spec masquerade as a 1F1B measurement.
+        raise ValueError(
+            "pp_schedule='1f1b' requested but the mesh has no pp axis "
+            f"(mesh axes: {dict(mesh.shape)})"
+        )
+    if pp and pp_schedule == "1f1b":
+        if not hasattr(model, "pp_value_and_grad"):
+            raise ValueError(
+                f"pp_schedule='1f1b' but {type(model).__name__} defines no "
+                "pp_value_and_grad hook"
+            )
+        mb = microbatches or 2 * mesh.shape["pp"]
+
+        @jax.jit
+        def train_step_1f1b(state, tokens):
+            loss, grads = model.pp_value_and_grad(
+                state["params"], tokens, mesh=mesh, microbatches=mb
+            )
+            updates, opt_state = tx.update(
+                grads, state["opt_state"], state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
+            return {"params": params, "opt_state": opt_state}, loss
+
+        return train_step_1f1b
 
     loss_fn = make_lm_loss_fn(model, mesh, microbatches)
 
