@@ -69,7 +69,15 @@ class CheckpointManager:
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore onto the structure/shardings of ``state_like`` (pass the
-        freshly initialized, already-sharded train state)."""
+        freshly initialized, already-sharded train state).
+
+        ``state_like``'s mesh need NOT match the one the checkpoint was
+        saved on: orbax re-lays the saved shards out onto the target
+        shardings, so an elastic world that shrank or grew between lives
+        (fsdp=4 save -> fsdp=2 restore) resumes losslessly — the
+        world-size-change case preemption recovery exists for
+        (tests/test_checkpoint.py::test_restore_reshards_across_mesh_shapes
+        and the shrink e2e in test_elastic_e2e.py pin this)."""
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
