@@ -398,6 +398,19 @@ def cmd_describe(args) -> int:
     lat = schedule_to_first_step_latency(job)
     if lat is not None:
         print(f"Schedule-to-first-step: {lat:.3f}s")
+    from pytorch_operator_tpu.controller.progress import (
+        format_progress,
+        job_status_dir,
+        read_latest_progress,
+    )
+
+    rec = read_latest_progress(job_status_dir(state / "status", key))
+    if rec is not None:
+        # Live while the job runs; last-known afterward. Read straight
+        # from the status files, so it works with or without a daemon.
+        print("Training:")
+        for line in format_progress(rec, time.time()):
+            print(f"  {line}")
     spans = job_timeline(job)
     if spans:
         print("Timeline:")

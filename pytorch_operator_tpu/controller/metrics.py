@@ -147,6 +147,29 @@ class MetricsRegistry:
         self.queue_slots_capacity = self.gauge(
             "tpujob_queue_slots_capacity", "Per-queue device-slot caps (--queue-slots)"
         )
+        # Live workload telemetry (SURVEY §5 "steps/sec + images/sec/chip
+        # meters"): folded from the newest per-replica progress heartbeat
+        # each sync pass (controller/progress.py).
+        self.job_step = self.gauge(
+            "tpujob_job_step", "Latest reported training step per job"
+        )
+        self.job_steps_per_sec = self.gauge(
+            "tpujob_job_steps_per_sec", "Live training steps/sec per job"
+        )
+        self.job_throughput = self.gauge(
+            "tpujob_job_throughput",
+            "Live training throughput per job (unit label = e.g. "
+            "images/sec/chip, tokens/sec/chip)",
+        )
+        self.job_loss = self.gauge(
+            "tpujob_job_loss", "Latest reported training loss per job"
+        )
+        self.job_progress_age = self.gauge(
+            "tpujob_job_progress_age_seconds",
+            "Seconds since the job's newest heartbeat — the staleness "
+            "signal: a healthy steps/sec with a growing age means the "
+            "workload stopped reporting (hung), not that it is training",
+        )
 
     def counter(self, name: str, help_text: str = "") -> Counter:
         if name not in self._counters:

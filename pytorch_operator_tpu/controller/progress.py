@@ -1,0 +1,122 @@
+"""Live training telemetry on the operator surface.
+
+SURVEY.md §5 ("Metrics / logging / observability") requires the rebuild
+to expose "steps/sec + images/sec/chip meters (the BASELINE.json:2
+metric)" — the one question a training operator's user asks is "how fast
+is my job training right now". The reference has no analog (its operator
+never looks inside pods); this is TPU-native completeness work.
+
+Pipeline: workloads append ``progress`` records to their per-replica
+status JSONL (``rendezvous.report_progress`` — same channel as the
+first-step latency records); this module tail-reads the newest record;
+the supervisor folds it into per-job Prometheus gauges
+(``tpujob_job_steps_per_sec`` / ``_throughput`` / ``_loss`` / ``_step``)
+every sync pass, and ``tpujob describe`` renders it as a "Training"
+block. The CLI path reads the files directly, so live telemetry works
+with or without a daemon.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+# Tail window per replica file. Progress records are ~150 bytes; the
+# newest record is always within the last few. Bounding the read keeps
+# the per-sync-pass cost O(1) no matter how long the job has trained.
+TAIL_BYTES = 8192
+
+
+def _tail_lines(path: Path, nbytes: int = TAIL_BYTES) -> list[str]:
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size > nbytes:
+                f.seek(size - nbytes)
+                f.readline()  # drop the partial first line
+            return f.read().decode("utf-8", "replace").splitlines()
+    except OSError:
+        return []
+
+
+def job_status_dir(status_root, key: str) -> Optional[Path]:
+    """THE per-job status-dir layout, mkdir-free (read paths — the CLI,
+    the supervisor's gauge fold, the reconciler's scans — must not
+    create directories; creation belongs to the reconciler's launch
+    path). One definition so a layout change cannot silently turn the
+    telemetry surface into 'no data'."""
+    if status_root is None:
+        return None
+    from .store import key_to_fs
+
+    return Path(status_root) / key_to_fs(key)
+
+
+_NUMERIC_FIELDS = ("ts", "step", "loss", "steps_per_sec", "throughput")
+
+
+def _sanitize(rec: dict) -> Optional[dict]:
+    """A progress record with every consumed field coerced to float (or
+    absent), or None if any present field is non-numeric — one bad line
+    from a foreign writer must not crash describe or degrade every
+    daemon sync pass downstream."""
+    out = {"ts": 0.0}
+    for f in _NUMERIC_FIELDS:
+        if rec.get(f) is not None:
+            try:
+                out[f] = float(rec[f])
+            except (TypeError, ValueError):
+                return None
+    if rec.get("unit") is not None:
+        out["unit"] = str(rec["unit"])
+    return out
+
+
+def read_latest_progress(status_dir) -> Optional[dict]:
+    """The newest ``progress`` record across a job's replica status files
+    (plus which replica reported it), or None. Torn/foreign/malformed
+    lines are skipped — the status dir is written by live workload
+    processes. Every numeric field in the result is a float; consumers
+    need no further validation."""
+    if status_dir is None:
+        return None
+    d = Path(status_dir)
+    if not d.is_dir():
+        return None
+    best: Optional[dict] = None
+    for p in d.glob("*.jsonl"):
+        for line in reversed(_tail_lines(p)):
+            try:
+                rec = json.loads(line)
+                if rec.get("event") != "progress":
+                    continue
+            except (ValueError, TypeError, AttributeError):
+                continue
+            clean = _sanitize(rec)
+            if clean is None:
+                continue  # malformed progress record: keep looking back
+            if best is None or clean["ts"] > best["ts"]:
+                clean["replica"] = p.stem
+                best = clean
+            break  # newest valid progress in this file found
+    return best
+
+
+def format_progress(rec: dict, now: float) -> list[str]:
+    """Human lines for the describe "Training" block."""
+    lines = []
+    step = rec.get("step")
+    if step is not None:
+        lines.append(f"Step:        {int(step)}")
+    if rec.get("loss") is not None:
+        lines.append(f"Loss:        {float(rec['loss']):.4f}")
+    if rec.get("steps_per_sec") is not None:
+        lines.append(f"Steps/sec:   {float(rec['steps_per_sec']):.2f}")
+    if rec.get("throughput") is not None:
+        unit = rec.get("unit") or "units/sec"
+        lines.append(f"Throughput:  {float(rec['throughput']):.1f} {unit}")
+    age = max(now - float(rec.get("ts", now)), 0.0)
+    lines.append(f"Reported:    {age:.0f}s ago by {rec.get('replica', '?')}")
+    return lines

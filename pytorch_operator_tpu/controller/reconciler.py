@@ -304,7 +304,9 @@ class Reconciler:
         dir — their reports are still this job's."""
         if self.status_root is None:
             return
-        d = self.status_root / key_to_fs(key)
+        from .progress import job_status_dir
+
+        d = job_status_dir(self.status_root, key)
         if d.is_dir():
             import shutil
 
@@ -319,7 +321,9 @@ class Reconciler:
         the schedule-to-first-step latency probe (BASELINE.json:2)."""
         if job.status.first_step_time is not None or self.status_root is None:
             return
-        d = self.status_root / key_to_fs(key)
+        from .progress import job_status_dir
+
+        d = job_status_dir(self.status_root, key)
         if not d.is_dir():
             return
         earliest = None

@@ -345,6 +345,49 @@ class Supervisor:
             for qname, cap in self.reconciler.queue_slots.items():
                 m.queue_slots_capacity.set(cap, queue=qname)
                 m.queue_slots_used.set(queue_usage.get(qname, 0), queue=qname)
+        self._update_progress_gauges(jobs)
+
+    def _update_progress_gauges(self, jobs) -> None:
+        """Fold each unfinished job's newest workload heartbeat
+        (controller/progress.py) into the per-job training gauges — the
+        SURVEY §5 "steps/sec + images/sec/chip meters" on /metrics.
+        Cleared-and-rebuilt per pass so finished/deleted jobs don't
+        linger as stale series; tail-reads keep the cost O(1) per job."""
+        from .progress import read_latest_progress
+
+        m = self.metrics
+        g_step, g_sps, g_tp, g_loss, g_age = (
+            m.job_step, m.job_steps_per_sec, m.job_throughput, m.job_loss,
+            m.job_progress_age,
+        )
+        for g in (g_step, g_sps, g_tp, g_loss, g_age):
+            g.clear()
+        from .progress import job_status_dir
+
+        root = self.reconciler.status_root
+        if root is None:
+            return
+        for key, job in jobs:
+            if job.is_finished():
+                continue
+            rec = read_latest_progress(job_status_dir(root, key))
+            if rec is None:
+                continue
+            if rec.get("step") is not None:
+                g_step.set(float(rec["step"]), job=key)
+            if rec.get("steps_per_sec") is not None:
+                g_sps.set(float(rec["steps_per_sec"]), job=key)
+            if rec.get("throughput") is not None:
+                g_tp.set(
+                    float(rec["throughput"]),
+                    job=key,
+                    unit=str(rec.get("unit") or "units/sec"),
+                )
+            if rec.get("loss") is not None:
+                g_loss.set(float(rec["loss"]), job=key)
+            # Staleness signal: without it a hung job's meter reads as a
+            # healthy rate forever.
+            g_age.set(max(time.time() - rec["ts"], 0.0), job=key)
 
     def _maybe_preempt(self, jobs, now: float) -> None:
         """volcano ``preempt``: evict lower-priority running worlds so the

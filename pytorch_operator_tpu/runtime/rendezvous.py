@@ -118,9 +118,42 @@ def report(event: str, **fields) -> None:
         pass
 
 
+def progress_enabled() -> bool:
+    """Is anyone listening? Workloads gate their heartbeat on this so a
+    standalone benchmark run (no supervisor, no status dir) pays zero
+    telemetry fences and stays A/B-comparable with older numbers."""
+    return _status_path() is not None
+
+
 def report_first_step(step: int = 0) -> None:
     report("first_step", step=step)
 
 
 def report_metrics(step: int, **metrics) -> None:
     report("metrics", step=step, **metrics)
+
+
+def report_progress(
+    step: int,
+    *,
+    loss: Optional[float] = None,
+    steps_per_sec: Optional[float] = None,
+    throughput: Optional[float] = None,
+    unit: Optional[str] = None,
+) -> None:
+    """Live training heartbeat (step/loss/throughput) for the operator
+    surface: the supervisor folds the newest record into per-job
+    /metrics gauges and ``tpujob describe``'s "Training" block
+    (controller/progress.py). Emit every ~10s, not every step — each
+    record is a host write and the caller usually pays a device fence
+    to know the loss."""
+    fields = {}
+    if loss is not None:
+        fields["loss"] = round(float(loss), 6)
+    if steps_per_sec is not None:
+        fields["steps_per_sec"] = round(float(steps_per_sec), 4)
+    if throughput is not None:
+        fields["throughput"] = round(float(throughput), 4)
+    if unit is not None:
+        fields["unit"] = unit
+    report("progress", step=step, **fields)

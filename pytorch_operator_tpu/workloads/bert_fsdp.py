@@ -120,7 +120,8 @@ def run(
 
     with mesh:
         state, (final_loss, final_acc), steps_per_sec, end_step = _loop(
-            train_step, state, batches, steps, warmup, log, profile_dir
+            train_step, state, batches, steps, warmup, log, profile_dir,
+            seqs_per_step_per_chip=batch / n_dev,
         )
 
     seqs_per_sec = steps_per_sec * batch
@@ -148,7 +149,10 @@ def run(
     }
 
 
-def _loop(train_step, state, batches, steps, warmup, log, profile_dir=None):
+def _loop(
+    train_step, state, batches, steps, warmup, log, profile_dir=None,
+    seqs_per_step_per_chip=None,
+):
     """throughput_loop variant for (loss, acc) tuples."""
     import jax
 
@@ -169,6 +173,16 @@ def _loop(train_step, state, batches, steps, warmup, log, profile_dir=None):
         on_first_step=lambda: rendezvous.report_first_step(0),
         log=lambda m: log(f"[bert] {m}"),
         profile_dir=profile_dir,
+        progress=(
+            None
+            if seqs_per_step_per_chip is None
+            or not rendezvous.progress_enabled()
+            else lambda s, l, sps: rendezvous.report_progress(
+                s, loss=l, steps_per_sec=sps,
+                throughput=sps * seqs_per_step_per_chip,
+                unit="sequences/sec/chip",
+            )
+        ),
     )
     loss, acc = jax.device_get(wrapped_step.last)
     return state, (loss, acc), steps_per_sec, end_step

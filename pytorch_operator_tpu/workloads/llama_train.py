@@ -357,6 +357,19 @@ def run(
                 start_step=start_step,
                 log=lambda m: log(f"[llama] {m}"),
                 profile_dir=profile_dir,
+                # Live heartbeat for `tpujob describe` / /metrics gauges
+                # (None standalone: no listener, no telemetry fences).
+                progress=(
+                    (
+                        lambda s, l, sps: rendezvous.report_progress(
+                            s, loss=l, steps_per_sec=sps,
+                            throughput=sps * batch * seq_len / n_dev,
+                            unit="tokens/sec/chip",
+                        )
+                    )
+                    if rendezvous.progress_enabled()
+                    else None
+                ),
             )
     finally:
         if loader is not None:

@@ -149,8 +149,23 @@ def main(argv=None) -> int:
         def epoch_iter(epoch):
             yield from epoch_batches(x_train, y_train, batch, seed=args.seed + epoch)
 
+    from .trainer import ProgressHeartbeat
+
     step = 0
     loss = None
+    # Live telemetry heartbeat (the shared throttle, so cadence/rate
+    # semantics match throughput_loop's workloads). None standalone:
+    # no listener, no telemetry fences.
+    hb = ProgressHeartbeat(
+        (
+            lambda s, l, sps: rendezvous.report_progress(
+                s, loss=l, steps_per_sec=sps,
+                throughput=sps * batch / dp, unit="images/sec/chip",
+            )
+        )
+        if rendezvous.progress_enabled()
+        else None
+    )
     try:
         for epoch in range(args.epochs):
             for bx, by in epoch_iter(epoch):
@@ -164,7 +179,11 @@ def main(argv=None) -> int:
                         f"[mnist] first step done at +{time.time() - t0:.2f}s",
                         flush=True,
                     )
+                    # The clock started before data load + compile; a
+                    # rate over that window would read as a stall.
+                    hb.reset(1)
                 step += 1
+                hb.tick(step, lambda: float(jax.device_get(loss)))
             if loss is not None:
                 rendezvous.report_metrics(step, epoch=epoch, loss=float(loss))
     finally:

@@ -275,7 +275,7 @@ def run_benchmark(
                 )
         float(jax.device_get(loss))
 
-        from .trainer import timed_windows
+        from .trainer import timed_windows, window_progress
 
         if profile_dir and windows > 1:
             # The trace must show exactly the run the reported number
@@ -298,6 +298,13 @@ def run_benchmark(
             windows=windows,
             profile_dir=profile_dir,
             log=lambda m: log(f"[resnet] {m}"),
+            # Live meter for `tpujob describe` / /metrics: one record per
+            # fenced window (+ one for the sustained aggregate).
+            progress=window_progress(
+                rendezvous.report_progress,
+                steps=steps, batch=batch, n_dev=n_dev,
+                unit="images/sec/chip",
+            ),
         )
         final_loss = float(jax.device_get(loss))
     finally:

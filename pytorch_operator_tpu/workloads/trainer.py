@@ -340,7 +340,76 @@ def make_lm_eval_step(model, mesh, microbatches=None):
     return jax.jit(make_lm_loss_fn(model, mesh, microbatches, include_aux=False))
 
 
-def timed_windows(run_window, fence, *, windows, profile_dir=None, log=print):
+class ProgressHeartbeat:
+    """The ONE throttled steps/sec meter behind every live-telemetry
+    heartbeat (throughput_loop and the loops that can't use it, e.g.
+    mnist's epoch loop) — one definition so cadence and rate semantics
+    cannot drift per workload.
+
+    ``tick(step, loss_fn)`` fires at most every ``every_s`` seconds:
+    calls ``loss_fn()`` (a real device fence), reports the rolling
+    steps/sec over the interval MINUS any time the caller flagged via
+    ``exclude()`` (checkpoint saves — the final throughput number
+    excludes them, so the live meter must too or every save reads as a
+    training stall), and returns the time spent reporting so callers
+    timing their loop can exclude it. NB the FENCE is deliberately not
+    excluded — it drains real queued compute, it just moves where the
+    wait happens. With ``report=None`` every call is a free no-op
+    (workloads pass None when no operator is listening — see
+    ``rendezvous.progress_enabled`` — so standalone benchmark runs pay
+    no fences and stay A/B-comparable with pre-telemetry numbers).
+    """
+
+    def __init__(self, report, every_s: float = 10.0, start_step: int = 0):
+        self.report = report
+        self.every_s = every_s
+        self._t = time.time()
+        self._step = start_step
+        self._excl = 0.0
+
+    def reset(self, step: int) -> None:
+        """Restart the interval clock (call after compile/warmup — a
+        clock started before the first-step compile would report the
+        compile wait as a near-zero training rate)."""
+        self._t, self._step, self._excl = time.time(), step, 0.0
+
+    def exclude(self, dt: float) -> None:
+        self._excl += dt
+
+    def tick(self, step: int, loss_fn) -> float:
+        if self.report is None or time.time() - self._t < self.every_s:
+            return 0.0
+        loss = loss_fn()  # fences: all work dispatched through `step` is done
+        now = time.time()
+        interval = max((now - self._t) - self._excl, 1e-9)
+        self.report(step, loss, (step - self._step) / interval)
+        done = time.time()
+        self._t, self._step, self._excl = done, step, 0.0
+        return done - now  # report time only; the fence was real compute
+
+
+def window_progress(report_progress, *, steps: int, batch: int, n_dev: int,
+                    unit: str):
+    """The shared rate math behind the image benches' per-window live
+    meter (resnet/vit both feed :func:`timed_windows` — one definition
+    so a fix to the rate accounting cannot skew one bench's telemetry
+    relative to the other): maps timed_windows' ``(windows_done,
+    windows_measured, dt)`` into a progress record."""
+
+    def progress(done, measured, dt):
+        report_progress(
+            done * steps,
+            steps_per_sec=measured * steps / dt,
+            throughput=batch * measured * steps / dt / n_dev,
+            unit=unit,
+        )
+
+    return progress
+
+
+def timed_windows(
+    run_window, fence, *, windows, profile_dir=None, log=print, progress=None
+):
     """The dual benchmark protocol shared by the image benches
     (resnet_bench / vit_bench — one definition so protocol fixes cannot
     skew one benchmark relative to the other):
@@ -357,17 +426,27 @@ def timed_windows(run_window, fence, *, windows, profile_dir=None, log=print):
     ``run_window()`` dispatches one window and returns a fence token;
     ``fence(token)`` performs a REAL host transfer on it. Returns
     ``(dt_min_window | None, dt_sustained_total, n_win)``.
+
+    ``progress(windows_done, window_steps, dt_window)``, when given, is
+    called after every fenced window (protocol A) and once after the
+    sustained run with the aggregate — the live-telemetry hook the image
+    benches use for the operator surface (controller/progress.py).
     """
     import math as _math
     import time as _time
 
     n_win = max(windows, 1)
     dt = _math.inf
+    wins_done = 0  # ALL windows run real steps on the same state
     if not profile_dir and n_win > 1:
         for _ in range(n_win):
             t0 = _time.time()
             fence(run_window())
-            dt = min(dt, _time.time() - t0)
+            dt_w = _time.time() - t0
+            dt = min(dt, dt_w)
+            wins_done += 1
+            if progress is not None:
+                progress(wins_done, 1, dt_w)
     with maybe_profile(profile_dir, log):
         t0 = _time.time()
         prev = None
@@ -379,6 +458,9 @@ def timed_windows(run_window, fence, *, windows, profile_dir=None, log=print):
         fence(prev)
         # dt_sustained is taken here, before stop_trace() flushes.
         dt_sustained = _time.time() - t0
+    wins_done += n_win
+    if progress is not None:
+        progress(wins_done, n_win, dt_sustained)
     if not _math.isfinite(dt):
         dt = None if profile_dir else dt_sustained / n_win
     return dt, dt_sustained, n_win
@@ -398,6 +480,8 @@ def throughput_loop(
     start_step: int = 0,
     log=print,
     profile_dir: Optional[str] = None,
+    progress: Optional[Callable[[int, float, float], None]] = None,
+    progress_every_s: float = 10.0,
 ):
     """Run warmup + timed steps; returns (state, final_loss, steps_per_sec,
     end_step).
@@ -409,6 +493,15 @@ def throughput_loop(
     ``profile_dir`` wraps the timed window in a ``jax.profiler`` trace
     (SURVEY.md §5 tracing: workload-side profiling is jax.profiler's job),
     viewable with tensorboard/xprof.
+
+    ``progress(step, loss, steps_per_sec)``, when given, is the live
+    heartbeat for the operator surface: called at most every
+    ``progress_every_s`` seconds with the rolling rate since the last
+    heartbeat. Each heartbeat pays one device fence (to know the loss)
+    — real queued compute draining, NOT excluded from the throughput
+    window; only the report-write time is excluded (like checkpoint-save
+    time). Pass ``progress=None`` when no operator is listening
+    (``rendezvous.progress_enabled``) so standalone runs pay nothing.
     """
     step = start_step
     t0 = time.time()
@@ -422,9 +515,10 @@ def throughput_loop(
             log(f"first step (compile) +{time.time() - t0:.1f}s")
     device_get(loss)
 
-    t_saving = 0.0
+    t_excluded = 0.0
     with maybe_profile(profile_dir, log):
         t0 = time.time()
+        hb = ProgressHeartbeat(progress, progress_every_s, start_step=step)
         for _ in range(steps):
             state, loss = train_step(state, batches(step))
             step += 1
@@ -432,8 +526,11 @@ def throughput_loop(
                 device_get(loss)  # fence before leaving the hot loop
                 t_save = time.time()
                 save(step, state)
-                t_saving += time.time() - t_save
+                dt_save = time.time() - t_save
+                t_excluded += dt_save
+                hb.exclude(dt_save)  # the live meter excludes it too
+            t_excluded += hb.tick(step, lambda: float(device_get(loss)))
         final_loss = float(device_get(loss))
         # dt is taken here, before stop_trace() flushes the trace to disk.
-        dt = time.time() - t0 - t_saving
+        dt = time.time() - t0 - t_excluded
     return state, final_loss, steps / dt, step

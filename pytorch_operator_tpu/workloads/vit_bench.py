@@ -185,7 +185,7 @@ def run_benchmark(
                 log(f"[vit] first chunk ({chunk} steps, compile) +{time.time() - t_start:.1f}s")
         float(jax.device_get(loss))
 
-        from .trainer import timed_windows
+        from .trainer import timed_windows, window_progress
 
         if profile_dir and windows > 1:
             log("[vit] --profile-dir set: timing a single window")
@@ -204,6 +204,13 @@ def run_benchmark(
             windows=windows,
             profile_dir=profile_dir,
             log=lambda m: log(f"[vit] {m}"),
+            # Live meter for `tpujob describe` / /metrics (one record per
+            # fenced window + the sustained aggregate).
+            progress=window_progress(
+                rendezvous.report_progress,
+                steps=steps, batch=batch, n_dev=n_dev,
+                unit="images/sec/chip",
+            ),
         )
         final_loss = float(jax.device_get(loss))
     finally:

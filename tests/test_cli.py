@@ -190,6 +190,47 @@ spec:
         assert run_cli("--state-dir", state, "scale", "cli-job", "--workers", "2") == 2
 
 
+class TestTrainingTelemetry:
+    def test_describe_shows_training_block_for_resnet(self, tmp_path, capsys):
+        """VERDICT r2 Missing #1 'done' criterion: `tpujob describe` of a
+        resnet job answers "how fast is my job training" — live steps/sec
+        + images/sec/chip from the workload's progress heartbeats (the
+        same records shown while running; last-known after completion)."""
+        state = tmp_path / "state"
+        yml = tmp_path / "resnet.yaml"
+        yml.write_text(
+            """
+api_version: tpujob.dev/v1
+kind: TPUJob
+metadata: {name: resnet-meter}
+spec:
+  replica_specs:
+    Master:
+      replicas: 1
+      template:
+        module: pytorch_operator_tpu.workloads.resnet_bench
+        args: ["--depth", "18", "--batch-size", "8", "--image-size", "32",
+               "--classes", "10", "--steps", "2", "--warmup", "1",
+               "--windows", "2"]
+        resources: {cpu_devices: 1}
+"""
+        )
+        assert run_cli("--state-dir", state, "run", str(yml), "--timeout", "300") == 0
+        capsys.readouterr()
+        assert run_cli("--state-dir", state, "describe", "resnet-meter") == 0
+        out = capsys.readouterr().out
+        assert "Training:" in out
+        assert "Steps/sec:" in out
+        assert "images/sec/chip" in out
+        # The meter reports a real positive rate from a real window.
+        rate = next(
+            float(ln.split()[1])
+            for ln in out.splitlines()
+            if ln.strip().startswith("Throughput:")
+        )
+        assert rate > 0
+
+
 class TestEvents:
     def test_events_merged_across_jobs(self, tmp_path, job_yaml, capsys):
         state = tmp_path / "state"

@@ -1,0 +1,102 @@
+"""Live-telemetry plumbing (controller/progress.py): tail-reads of the
+per-replica status JSONL that workload heartbeats append to."""
+
+from __future__ import annotations
+
+import json
+
+from pytorch_operator_tpu.controller.progress import (
+    TAIL_BYTES,
+    format_progress,
+    read_latest_progress,
+)
+
+
+def _write(path, records):
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+def test_newest_progress_across_replicas_wins(tmp_path):
+    _write(
+        tmp_path / "master-0.jsonl",
+        [
+            {"event": "first_step", "ts": 1.0, "step": 0},
+            {"event": "progress", "ts": 5.0, "step": 10, "steps_per_sec": 2.0},
+        ],
+    )
+    _write(
+        tmp_path / "worker-0.jsonl",
+        [{"event": "progress", "ts": 7.0, "step": 14, "steps_per_sec": 2.5}],
+    )
+    rec = read_latest_progress(tmp_path)
+    assert rec["step"] == 14 and rec["replica"] == "worker-0"
+
+
+def test_missing_dir_and_no_progress_records(tmp_path):
+    assert read_latest_progress(tmp_path / "nope") is None
+    assert read_latest_progress(None) is None
+    _write(tmp_path / "master-0.jsonl", [{"event": "metrics", "ts": 1.0}])
+    assert read_latest_progress(tmp_path) is None
+
+
+def test_torn_and_foreign_lines_skipped(tmp_path):
+    p = tmp_path / "master-0.jsonl"
+    p.write_text(
+        json.dumps({"event": "progress", "ts": 3.0, "step": 6}) + "\n"
+        + "{torn json...\n"
+        + "42\n"
+    )
+    rec = read_latest_progress(tmp_path)
+    assert rec["step"] == 6
+
+
+def test_malformed_numeric_fields_rejected_per_record(tmp_path):
+    """A foreign writer's record with a non-numeric field must not crash
+    describe or poison the daemon's gauge pass — the reader skips THE
+    RECORD and falls back to the previous valid one, and every field in
+    the result is already a float."""
+    p = tmp_path / "master-0.jsonl"
+    p.write_text(
+        json.dumps({"event": "progress", "ts": 3.0, "step": 6,
+                    "steps_per_sec": 2.0}) + "\n"
+        + json.dumps({"event": "progress", "ts": 9.0, "step": "resuming",
+                      "throughput": ["not", "a", "number"]}) + "\n"
+    )
+    rec = read_latest_progress(tmp_path)
+    assert rec["step"] == 6.0
+    assert isinstance(rec["steps_per_sec"], float)
+
+
+def test_tail_read_finds_newest_in_long_file(tmp_path):
+    """A long-trained job's file exceeds the tail window; the newest
+    record (at the end) must still be found — and the bounded read must
+    not degrade into a whole-file scan."""
+    records = [
+        {"event": "progress", "ts": float(i), "step": i} for i in range(5000)
+    ]
+    p = tmp_path / "master-0.jsonl"
+    _write(p, records)
+    assert p.stat().st_size > 4 * TAIL_BYTES  # precondition: truly long
+    rec = read_latest_progress(tmp_path)
+    assert rec["step"] == 4999
+
+
+def test_format_progress_renders_fields():
+    lines = format_progress(
+        {
+            "ts": 90.0,
+            "step": 120,
+            "loss": 1.23456,
+            "steps_per_sec": 3.5,
+            "throughput": 448.0,
+            "unit": "images/sec/chip",
+            "replica": "master-0",
+        },
+        now=100.0,
+    )
+    text = "\n".join(lines)
+    assert "Step:        120" in text
+    assert "Loss:        1.2346" in text
+    assert "Steps/sec:   3.50" in text
+    assert "448.0 images/sec/chip" in text
+    assert "10s ago by master-0" in text
