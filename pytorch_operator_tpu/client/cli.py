@@ -198,6 +198,7 @@ def cmd_supervisor(args) -> int:
         leader_elect=not args.no_leader_elect,
         queue_slots=_parse_queue_slots(getattr(args, "queue_slots", None)),
         preempt=getattr(args, "preempt", False),
+        standby=getattr(args, "standby", 0) or 0,
     )
     # Monitoring comes up BEFORE the lease wait: a standby must answer
     # /healthz while blocked (it reports is_leader=false), or liveness
@@ -684,6 +685,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-leader-elect",
         action="store_true",
         help="skip the leader lease (single-daemon setups)",
+    )
+    sp.add_argument(
+        "--standby",
+        type=int,
+        default=0,
+        help="keep N pre-warmed standby processes (interpreter + jax "
+        "imports already paid) and hand module-template replicas to "
+        "them — cuts schedule-to-first-step latency (0 = off)",
     )
     sp.set_defaults(func=cmd_supervisor)
 

@@ -338,7 +338,12 @@ class SubprocessRunner(ProcessRunner):
     ``replica_slots(template)`` of it (a 4-chip replica weighs 4).
     """
 
-    def __init__(self, state_dir: Path, max_slots: Optional[int] = None):
+    def __init__(
+        self,
+        state_dir: Path,
+        max_slots: Optional[int] = None,
+        standby: int = 0,
+    ):
         self.state_dir = Path(state_dir)
         self.log_dir = self.state_dir / "logs"
         self.log_dir.mkdir(parents=True, exist_ok=True)
@@ -349,6 +354,15 @@ class SubprocessRunner(ProcessRunner):
         self.replica_dir = self.state_dir / "replicas"
         self.replica_dir.mkdir(parents=True, exist_ok=True)
         self.max_slots = max_slots
+        # Pre-warmed standby processes (controller/standby.py): create()
+        # hands module-template jobs to one instead of spawning cold,
+        # cutting schedule-to-first-step by the interpreter+import tax.
+        self._standby_pool = None
+        if standby > 0:
+            from .standby import StandbyPool
+
+            self._standby_pool = StandbyPool(self.state_dir, standby)
+            self._standby_pool.replenish()
         self.handles: Dict[str, ReplicaHandle] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
         self._log_files: Dict[str, object] = {}
@@ -356,6 +370,11 @@ class SubprocessRunner(ProcessRunner):
         # (they are not our children, so no Popen/waitpid).
         self._adopted: Dict[str, int] = {}  # name -> pid
         self._pid_starts: Dict[str, Optional[int]] = {}
+        # Standby-run replicas have NO sh wrapper: the handle's pid IS the
+        # workload, so "wrapper dead but group alive" does NOT mean the
+        # replica survives — liveness for these is pid-only (persisted in
+        # the record for adoption across supervisor restarts).
+        self._wrapperless: set = set()
         self._lock = threading.RLock()
         self._load_records()
 
@@ -375,6 +394,7 @@ class SubprocessRunner(ProcessRunner):
             return
         rec = h.to_dict()
         rec["pid_start"] = self._pid_starts.get(h.name)
+        rec["wrapperless"] = h.name in self._wrapperless
         tmp = self._record_path(h.name).with_suffix(".json.tmp")
         tmp.write_text(json.dumps(rec))
         tmp.replace(self._record_path(h.name))
@@ -447,13 +467,20 @@ class SubprocessRunner(ProcessRunner):
                 continue
             pid_start = rec.get("pid_start")
             self._pid_starts[h.name] = pid_start
+            if rec.get("wrapperless"):
+                self._wrapperless.add(h.name)
             if h.is_active():
                 # Exit-capture file first: the wrapper writes it when the
                 # replica's MAIN process exits, so its presence means done
                 # even if a stray background child keeps the group alive.
+                alive = (
+                    _pid_alive(h.pid, pid_start)
+                    if h.name in self._wrapperless
+                    else _replica_alive(h.pid, pid_start)
+                )
                 if self._read_exit_file(h.name) is not None:
                     self._finish_dead_adopted(h, save=persist_classification)
-                elif _replica_alive(h.pid, pid_start):
+                elif alive:
                     h.phase = ReplicaPhase.RUNNING
                     self._adopted[h.name] = h.pid
                 else:
@@ -501,6 +528,51 @@ class SubprocessRunner(ProcessRunner):
                 parts.insert(0, pkg_root)
             full_env["PYTHONPATH"] = os.pathsep.join(parts)
             self._forget_files(name)  # stale record/exit file of a prior run
+        # Pre-warmed path: hand the job to a ready standby (module
+        # templates only — exec'ing a command argv would discard the warm
+        # imports). OUTSIDE the handle lock: assign() can block up to its
+        # ack timeout when a standby dies mid-handoff, and sync/delete/
+        # list must not freeze for that. Per-key reconcile serialization
+        # already prevents same-name concurrent creates; the handle is
+        # installed under the lock below. Ack failure falls through to
+        # the cold spawn.
+        if self._standby_pool is not None and template.module:
+            taken = self._standby_pool.take()
+            if taken is not None:
+                sid, proc = taken
+                ok = self._standby_pool.assign(
+                    sid,
+                    proc,
+                    {
+                        "module": template.module,
+                        "args": list(template.args),
+                        "env": full_env,
+                        "cwd": template.working_dir or None,
+                        "log_path": str(log_path),
+                        "exit_path": str(self._exit_path(name)),
+                    },
+                )
+                if ok:
+                    with self._lock:
+                        h = ReplicaHandle(
+                            name=name,
+                            job_key=job_key,
+                            replica_type=rtype,
+                            index=index,
+                            phase=ReplicaPhase.RUNNING,
+                            pid=proc.pid,
+                            created_at=time.time(),
+                            log_path=str(log_path),
+                            slots=replica_slots(template),
+                        )
+                        self.handles[name] = h
+                        self._procs[name] = proc
+                        stat = _proc_stat(proc.pid)
+                        self._pid_starts[name] = stat[0] if stat else None
+                        self._wrapperless.add(name)
+                        self._save(h)
+                        return h
+        with self._lock:
             log_f = open(log_path, "ab")
             try:
                 proc = subprocess.Popen(
@@ -549,6 +621,9 @@ class SubprocessRunner(ProcessRunner):
             return h
 
     def sync(self):
+        if self._standby_pool is not None:
+            # Outside the handle lock: replenish spawns processes.
+            self._standby_pool.replenish()
         with self._lock:
             for name, proc in list(self._procs.items()):
                 code = proc.poll()
@@ -560,7 +635,12 @@ class SubprocessRunner(ProcessRunner):
                     f.close()
                 h = self.handles[name]
                 file_code = self._read_exit_file(name)
-                if code < 0 and file_code is None and _group_members_alive(proc.pid):
+                if (
+                    code < 0
+                    and file_code is None
+                    and name not in self._wrapperless
+                    and _group_members_alive(proc.pid)
+                ):
                     # The wrapper was killed by a signal but the replica's
                     # group survives (TERM-trapping replica, stray kill of
                     # the sh): the replica is NOT dead — demote to
@@ -587,9 +667,12 @@ class SubprocessRunner(ProcessRunner):
             # wrapper too (preemption) → 137.
             live_pgids = _live_pgids() if self._adopted else None
             for name, pid in list(self._adopted.items()):
-                if self._read_exit_file(name) is None and _replica_alive(
-                    pid, self._pid_starts.get(name), live_pgids
-                ):
+                alive = (
+                    _pid_alive(pid, self._pid_starts.get(name))
+                    if name in self._wrapperless
+                    else _replica_alive(pid, self._pid_starts.get(name), live_pgids)
+                )
+                if self._read_exit_file(name) is None and alive:
                     continue
                 self._adopted.pop(name)
                 self._finish_dead_adopted(self.handles[name])
@@ -729,6 +812,7 @@ class SubprocessRunner(ProcessRunner):
                 raise RuntimeError(f"cannot remove record of live replica {name}")
             self.handles.pop(name, None)
             self._pid_starts.pop(name, None)
+            self._wrapperless.discard(name)
             self._forget_files(name)
 
     def set_slots(self, name, slots):
@@ -766,3 +850,5 @@ class SubprocessRunner(ProcessRunner):
         with self._lock:
             names = list(self._procs.keys())
         self.delete_many(names, grace_seconds=2.0)
+        if self._standby_pool is not None:
+            self._standby_pool.shutdown()  # idle standbys die with us

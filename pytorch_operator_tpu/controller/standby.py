@@ -1,0 +1,296 @@
+"""Pre-warmed standby replicas — the schedule-to-first-step accelerator.
+
+BASELINE.md's latency breakdown puts a ~5s floor under even a warm
+(compile-cached) job start: process spawn + ``import jax`` (and friends)
++ backend init, all paid serially before the workload's first line runs.
+The reference has no analog (kubelet image pulls / container starts are
+its version of this cost, and it never attacks them); this is TPU-native
+performance work on the BASELINE.json:2 north-star metric.
+
+Design: the supervisor keeps N **standby** processes that have already
+paid the interpreter + heavy-import cost (jax/flax/optax/numpy — NO
+device client: standbys must not contend with live jobs for the TPU, per
+BASELINE.md's contention note; the client is acquired lazily after
+assignment). ``SubprocessRunner.create`` hands a job to a ready standby
+instead of spawning cold:
+
+1. runner writes ``<id>.assign.json`` (atomic tmp+rename) into the pool
+   dir and waits briefly for the claim ack;
+2. the standby (polling) renames it to ``<id>.assign.claimed``, applies
+   the injected env wholesale, re-applies the jax options whose env vars
+   were already consumed at import (config.update), redirects
+   stdout/stderr onto the replica's log file, and runs the template
+   module in-process via ``runpy`` as ``__main__``;
+3. on completion it writes the exit-capture file (same protocol as the
+   cold path's sh wrapper) and exits with the workload's code.
+
+One job per standby — the process dies with its job and the pool
+replenishes on the next sync pass, so replica isolation semantics are
+unchanged: the handle's pid IS the workload's pid, signals/kill
+escalation/adoption all behave exactly as for cold spawns. Only
+``module`` templates are eligible (exec'ing an arbitrary ``command``
+argv would discard the warm imports); anything else falls back to a cold
+spawn, as does an assignment whose ack times out (standby died between
+readiness check and claim).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+# jax options whose environment variables are read ONCE at import time:
+# the standby imported jax long before the job's env existed, so these
+# must be re-applied through jax.config after the env lands.
+_JAX_ENV_CONFIG = (
+    ("JAX_COMPILATION_CACHE_DIR", "jax_compilation_cache_dir"),
+    ("JAX_PLATFORMS", "jax_platforms"),
+)
+
+
+# ---- the standby process ----
+
+
+def _preimport() -> None:
+    """Pay the heavy imports up front. Deliberately NO jax.devices() /
+    backend creation — device acquisition stays lazy (contention)."""
+    import numpy  # noqa: F401
+    import jax  # noqa: F401
+    import flax.linen  # noqa: F401
+    import optax  # noqa: F401
+
+
+def _run_assignment(spec: dict) -> int:
+    """Become the replica: env, log redirect, cwd, run the module."""
+    import runpy
+    import traceback
+
+    env = spec.get("env") or {}
+    os.environ.clear()
+    os.environ.update(env)
+    import jax
+
+    for env_key, cfg_key in _JAX_ENV_CONFIG:
+        if env.get(env_key):
+            try:
+                jax.config.update(cfg_key, env[env_key])
+            except Exception:
+                pass  # unknown option on this jax version: env route only
+    # Route all output to the replica's log file (kubectl-logs analog) —
+    # fd-level dup2 so subprocesses and C extensions follow too.
+    log_fd = os.open(
+        spec["log_path"], os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
+    )
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.dup2(log_fd, 1)
+    os.dup2(log_fd, 2)
+    os.close(log_fd)
+    if spec.get("cwd"):
+        os.chdir(spec["cwd"])
+    sys.argv = [spec["module"]] + list(spec.get("args") or [])
+    code = 0
+    try:
+        runpy.run_module(spec["module"], run_name="__main__", alter_sys=True)
+    except SystemExit as e:
+        if isinstance(e.code, int):
+            code = e.code
+        elif e.code is not None:
+            print(e.code, file=sys.stderr)
+            code = 1
+    except BaseException:
+        traceback.print_exc()
+        code = 1
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # Exit-capture protocol (same file the cold path's sh wrapper writes).
+    try:
+        ef = spec["exit_path"]
+        tmp = ef + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(code))
+        os.replace(tmp, ef)
+    except OSError:
+        pass
+    return code
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", required=True, help="pool directory")
+    p.add_argument("--id", required=True, help="this standby's id")
+    args = p.parse_args(argv)
+    pool = Path(args.dir)
+    assign = pool / f"{args.id}.assign.json"
+    claimed = pool / f"{args.id}.assign.claimed"
+    stop = pool / f"{args.id}.stop"
+    _preimport()
+    ready_tmp = pool / f"{args.id}.ready.tmp"
+    ready_tmp.write_text(str(os.getpid()))
+    ready_tmp.replace(pool / f"{args.id}.ready")
+    while True:
+        # Orphan guards: a supervisor that died without shutdown() (crash,
+        # SIGKILL) must not leak a 50 Hz poll loop pinning jax-sized RSS
+        # forever. start_new_session reparents us to init on parent death.
+        if stop.exists() or not pool.is_dir() or os.getppid() == 1:
+            return 0
+        if assign.exists():
+            try:
+                spec = json.loads(assign.read_text())
+            except (OSError, ValueError):
+                time.sleep(0.01)
+                continue
+            try:
+                assign.replace(claimed)  # the ack the runner waits on
+            except OSError:
+                return 0  # pool dir torn down underneath us
+            return _run_assignment(spec)
+        time.sleep(0.02)
+
+
+# ---- the supervisor-side pool ----
+
+
+class StandbyPool:
+    """Spawn/track/assign standby processes (supervisor side).
+
+    Thread-safe; ``replenish()`` is called from the runner's sync pass.
+    Standbys consume no scheduler slots — they hold no devices.
+    """
+
+    ACK_TIMEOUT_S = 2.0
+
+    def __init__(self, state_dir: Path, size: int):
+        self.dir = Path(state_dir) / "standby"
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.log_dir = Path(state_dir) / "logs"
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        self.size = size
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def _files(self, sid: str):
+        return [
+            self.dir / f"{sid}{suffix}"
+            for suffix in (".ready", ".assign.json", ".assign.claimed", ".stop")
+        ]
+
+    def _spawn_one(self) -> None:
+        sid = f"s{os.getpid()}-{self._counter}"
+        self._counter += 1
+        env = dict(os.environ)
+        pkg_root = str(Path(__file__).resolve().parents[2])
+        parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+        if pkg_root not in parts:
+            parts.insert(0, pkg_root)
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+        env["PYTHONUNBUFFERED"] = "1"
+        log_f = open(self.log_dir / f"standby-{sid}.log", "ab")
+        try:
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m",
+                    "pytorch_operator_tpu.controller.standby",
+                    "--dir", str(self.dir), "--id", sid,
+                ],
+                env=env,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        except OSError:
+            log_f.close()
+            return
+        log_f.close()  # the child owns the fd now
+        self._procs[sid] = proc
+
+    def set_size(self, size: int) -> None:
+        """Retarget the pool (takes effect on the next replenish; shrink
+        does not kill live standbys). size=0 pauses replenishment — e.g.
+        while a latency measurement must not share the host core with a
+        fresh standby's import burst."""
+        with self._lock:
+            self.size = size
+
+    def replenish(self) -> None:
+        """Reap dead standbys, top the pool back up to ``size``."""
+        with self._lock:
+            for sid, proc in list(self._procs.items()):
+                if proc.poll() is not None:
+                    self._procs.pop(sid)
+                    for f in self._files(sid):
+                        f.unlink(missing_ok=True)
+            while len(self._procs) < self.size:
+                self._spawn_one()
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(
+                1
+                for sid, proc in self._procs.items()
+                if proc.poll() is None and (self.dir / f"{sid}.ready").exists()
+            )
+
+    def take(self) -> Optional[Tuple[str, subprocess.Popen]]:
+        """Pop a ready, live standby (or None). The caller MUST follow
+        with assign() or kill()."""
+        with self._lock:
+            for sid, proc in list(self._procs.items()):
+                if proc.poll() is None and (self.dir / f"{sid}.ready").exists():
+                    self._procs.pop(sid)
+                    return sid, proc
+        return None
+
+    def assign(self, sid: str, proc: subprocess.Popen, spec: dict) -> bool:
+        """Hand a job spec to a taken standby; True once the standby
+        acked the claim. On timeout (it died under us) the standby is
+        killed and False returned — the caller cold-spawns instead."""
+        tmp = self.dir / f"{sid}.assign.json.tmp"
+        target = self.dir / f"{sid}.assign.json"
+        claimed = self.dir / f"{sid}.assign.claimed"
+        try:
+            tmp.write_text(json.dumps(spec))
+            tmp.replace(target)
+        except OSError:
+            self.kill(sid, proc)
+            return False
+        deadline = time.time() + self.ACK_TIMEOUT_S
+        while time.time() < deadline:
+            if claimed.exists():
+                claimed.unlink(missing_ok=True)
+                return True
+            if proc.poll() is not None:
+                break
+            time.sleep(0.01)
+        self.kill(sid, proc)
+        target.unlink(missing_ok=True)
+        return False
+
+    def kill(self, sid: str, proc: subprocess.Popen) -> None:
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, 9)
+            except (ProcessLookupError, PermissionError):
+                pass
+        for f in self._files(sid):
+            f.unlink(missing_ok=True)
+
+    def shutdown(self) -> None:
+        """Kill every idle standby (assigned ones became job replicas and
+        belong to the runner's normal teardown path)."""
+        with self._lock:
+            for sid, proc in list(self._procs.items()):
+                self.kill(sid, proc)
+            self._procs.clear()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

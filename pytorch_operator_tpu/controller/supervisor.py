@@ -53,6 +53,7 @@ class Supervisor:
         leader_elect: bool = False,
         queue_slots: Optional[dict] = None,
         preempt: bool = False,
+        standby: int = 0,
     ):
         self.state_dir = Path(state_dir) if state_dir is not None else default_state_dir()
         self.state_dir.mkdir(parents=True, exist_ok=True)
@@ -67,7 +68,7 @@ class Supervisor:
         self.events = EventRecorder(sink_dir=self.state_dir / "events")
         self.metrics = MetricsRegistry()
         self.runner = runner if runner is not None else SubprocessRunner(
-            self.state_dir, max_slots=max_slots
+            self.state_dir, max_slots=max_slots, standby=standby
         )
         self.gang = GangScheduler(enabled=gang_enabled)
         # volcano `preempt` action analog; opt-in (--preempt).

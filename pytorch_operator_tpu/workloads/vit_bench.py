@@ -97,6 +97,7 @@ def run_benchmark(
     lr: float = 1e-3,
     windows: int = 1,
     attn_impl: str = "dense",
+    remat: bool = False,
     data_file: str | None = None,
     profile_dir: str | None = None,
     log=print,
@@ -121,7 +122,8 @@ def run_benchmark(
         if field_x is not None:
             image_size = field_x.shape[0]
     cfg = vit_lib.BY_NAME[variant](
-        image_size=image_size, num_classes=classes, attn_impl=attn_impl
+        image_size=image_size, num_classes=classes, attn_impl=attn_impl,
+        remat=remat,
     )
     model = vit_lib.ViT(cfg)
     n_dev = jax.device_count()
@@ -261,6 +263,12 @@ def main(argv=None) -> int:
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument(
+        "--remat", action="store_true",
+        help="rematerialize encoder blocks in backward (jax.checkpoint "
+        "under the layer scan): ~1/3 more FLOPs for O(depth) activation "
+        "memory -- unlocks larger batches",
+    )
     p.add_argument("--windows", type=int, default=1)
     p.add_argument("--attn-impl", choices=("dense", "flash"), default="dense")
     p.add_argument(
@@ -284,6 +292,7 @@ def main(argv=None) -> int:
         lr=args.lr,
         windows=args.windows,
         attn_impl=args.attn_impl,
+        remat=args.remat,
         data_file=args.data_file,
         profile_dir=args.profile_dir,
         log=lambda msg: print(

@@ -1,0 +1,23 @@
+"""Tiny probe workload for standby tests: prints an env var, optionally
+sleeps, exits with a configurable code."""
+
+import os
+import sys
+import time
+
+
+def main() -> int:
+    print("probe-env", os.environ.get("PROBE_VAL", ""), flush=True)
+    if os.environ.get("PROBE_SPAWN_CHILD"):
+        # A same-process-group descendant that outlives the main process
+        # (data-loader-worker stand-in for the wrapperless-death test).
+        import subprocess
+
+        subprocess.Popen(["sleep", os.environ["PROBE_SPAWN_CHILD"]])
+    if os.environ.get("PROBE_SLEEP"):
+        time.sleep(float(os.environ["PROBE_SLEEP"]))
+    return int(os.environ.get("PROBE_EXIT", "0"))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,11 +92,22 @@ class TestBench:
             "value",
             "unit",
             "vs_baseline",
+            "llama",
             "schedule_to_first_step_s",
         }
         assert result["value"] > 0
         assert result["unit"] == "images/sec/chip"
-        # The latency probe runs REAL supervisor jobs even in smoke mode;
+        # The flagship LM rides in the same artifact (VERDICT r2 #1:
+        # driver-captured numbers can't drift), with the MFU block.
+        lm = result["llama"]
+        assert lm["unit"] == "tokens/sec/chip" and lm["value"] > 0
+        assert set(lm["mfu"]) == {
+            "model_tflops_per_sec",
+            "vs_peak_pct",
+            "vs_sustained_matmul_pct",
+        }
+        # The latency probe runs REAL supervisor jobs even in smoke mode
+        # (with a pre-warmed standby, the production daemon config);
         # both phases must come back measured, not None.
         lat = result["schedule_to_first_step_s"]
         assert lat["cold"] > 0 and lat["warm"] > 0
@@ -107,7 +118,18 @@ class TestBench:
         result = bench.run(
             ["--smoke", "--steps", "2", "--warmup", "1", "--no-latency"]
         )
-        assert set(result) == {"metric", "value", "unit", "vs_baseline"}
+        assert set(result) == {"metric", "value", "unit", "vs_baseline", "llama"}
+
+    def test_mfu_math(self):
+        import bench
+
+        # 164 TF/s of model FLOPs == 100% of sustained, ~83% of peak.
+        m = bench.mfu(164e12)
+        assert m["vs_sustained_matmul_pct"] == 100.0
+        assert 80 < m["vs_peak_pct"] < 85
+        # The LM formula: 6N dominates at short S.
+        f = bench.lm_train_flops_per_token(1e9, 16, 1024, 64)
+        assert abs(f - (6e9 + 6 * 16 * 64 * 1024)) < 1
 
 
 class TestDataFileMode:

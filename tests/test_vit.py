@@ -61,6 +61,54 @@ class TestViTModel:
             np.asarray(yd), np.asarray(yf), rtol=2e-4, atol=2e-4
         )
 
+    def test_remat_same_numerics_less_backward_memory(self):
+        """cfg.remat must not change the math (same loss/grads) while
+        cutting the compiled backward's activation residency — the lever
+        that unlocks larger ViT batches (VERDICT r2 Weak #2)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        x = jnp.asarray(
+            np.random.default_rng(0).random((64, 16, 16, 3), np.float32)
+        )
+        y = jnp.asarray(np.arange(64) % 10, np.int32)
+        results = {}
+        for remat in (False, True):
+            cfg = tiny_cfg(depth=6, remat=remat)
+            model = vit_lib.ViT(cfg)
+            params = jax.tree.map(
+                lambda l: l.unbox() if hasattr(l, "unbox") else l,
+                model.init(jax.random.key(0), x[:1])["params"],
+                is_leaf=lambda l: hasattr(l, "unbox"),
+            )
+
+            def loss_fn(p, _model=model):
+                logits = _model.apply({"params": p}, x)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                ).mean()
+
+            g = jax.jit(jax.value_and_grad(loss_fn))
+            loss, grads = g(params)
+            ma = g.lower(params).compile().memory_analysis()
+            results[remat] = (float(loss), grads, ma)
+        l0, g0, ma0 = results[False]
+        l1, g1, ma1 = results[True]
+        assert l0 == pytest.approx(l1, rel=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+            ),
+            g0,
+            g1,
+        )
+        if ma0 is not None and ma1 is not None:
+            assert ma1.temp_size_in_bytes < ma0.temp_size_in_bytes, (
+                ma1.temp_size_in_bytes,
+                ma0.temp_size_in_bytes,
+            )
+
     def test_trains_loss_decreases(self):
         import jax
 
