@@ -75,6 +75,12 @@ def _run_assignment(spec: dict) -> int:
     env = spec.get("env") or {}
     os.environ.clear()
     os.environ.update(env)
+    # PYTHONPATH was consumed by the interpreter at standby startup; the
+    # job's entries must land on sys.path too, or a module that imports
+    # fine on the cold path ImportErrors on the warm one.
+    for entry in reversed(env.get("PYTHONPATH", "").split(os.pathsep)):
+        if entry and entry not in sys.path:
+            sys.path.insert(0, entry)
     import jax
 
     for env_key, cfg_key in _JAX_ENV_CONFIG:
@@ -126,11 +132,14 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--dir", required=True, help="pool directory")
     p.add_argument("--id", required=True, help="this standby's id")
+    p.add_argument(
+        "--parent", type=int, default=None,
+        help="supervisor pid: exit when reparented away from it",
+    )
     args = p.parse_args(argv)
     pool = Path(args.dir)
     assign = pool / f"{args.id}.assign.json"
     claimed = pool / f"{args.id}.assign.claimed"
-    stop = pool / f"{args.id}.stop"
     _preimport()
     ready_tmp = pool / f"{args.id}.ready.tmp"
     ready_tmp.write_text(str(os.getpid()))
@@ -138,8 +147,13 @@ def main(argv=None) -> int:
     while True:
         # Orphan guards: a supervisor that died without shutdown() (crash,
         # SIGKILL) must not leak a 50 Hz poll loop pinning jax-sized RSS
-        # forever. start_new_session reparents us to init on parent death.
-        if stop.exists() or not pool.is_dir() or os.getppid() == 1:
+        # forever. Reparenting away from the RECORDED parent pid (not a
+        # bare ppid==1 test, which would misfire when the supervisor
+        # itself is pid 1 in a container) or the pool dir vanishing both
+        # mean the pool is gone.
+        if not pool.is_dir() or (
+            args.parent is not None and os.getppid() != args.parent
+        ):
             return 0
         if assign.exists():
             try:
@@ -180,10 +194,10 @@ class StandbyPool:
     def _files(self, sid: str):
         return [
             self.dir / f"{sid}{suffix}"
-            for suffix in (".ready", ".assign.json", ".assign.claimed", ".stop")
+            for suffix in (".ready", ".assign.json", ".assign.claimed")
         ]
 
-    def _spawn_one(self) -> None:
+    def _spawn_one(self) -> bool:
         sid = f"s{os.getpid()}-{self._counter}"
         self._counter += 1
         env = dict(os.environ)
@@ -200,6 +214,7 @@ class StandbyPool:
                     sys.executable, "-m",
                     "pytorch_operator_tpu.controller.standby",
                     "--dir", str(self.dir), "--id", sid,
+                    "--parent", str(os.getpid()),
                 ],
                 env=env,
                 stdout=log_f,
@@ -208,9 +223,10 @@ class StandbyPool:
             )
         except OSError:
             log_f.close()
-            return
+            return False
         log_f.close()  # the child owns the fd now
         self._procs[sid] = proc
+        return True
 
     def set_size(self, size: int) -> None:
         """Retarget the pool (takes effect on the next replenish; shrink
@@ -228,8 +244,12 @@ class StandbyPool:
                     self._procs.pop(sid)
                     for f in self._files(sid):
                         f.unlink(missing_ok=True)
-            while len(self._procs) < self.size:
-                self._spawn_one()
+            # Bounded: a persistent spawn failure (fork limit, ENOMEM)
+            # must not busy-loop under the pool lock — try once per
+            # missing slot, retry on the next sync pass.
+            for _ in range(max(self.size - len(self._procs), 0)):
+                if not self._spawn_one():
+                    break
 
     def ready_count(self) -> int:
         with self._lock:
@@ -266,6 +286,9 @@ class StandbyPool:
         while time.time() < deadline:
             if claimed.exists():
                 claimed.unlink(missing_ok=True)
+                # The sid leaves the pool here: drop its ready marker so
+                # a long-lived daemon doesn't leak one file per warm job.
+                (self.dir / f"{sid}.ready").unlink(missing_ok=True)
                 return True
             if proc.poll() is not None:
                 break

@@ -50,7 +50,10 @@ class LlamaConfig:
     # Attention implementation: "dense" (materialized S×S scores), "flash"
     # (pallas blockwise kernel, O(S·D) HBM traffic — ops/flash_attention.py),
     # "ring" (sequence-parallel ring attention over the mesh's ``sp`` axis —
-    # parallel/ring.py; requires passing the mesh to the model).
+    # parallel/ring.py), "ulysses" (all-to-all seq↔head swap over ``sp`` —
+    # parallel/ulysses.py; 2 collectives vs ring's P rotations, full-S
+    # scores per local kv head, needs n_kv_heads % sp == 0). ring/ulysses
+    # require passing the mesh to the model.
     attn_impl: str = "dense"
     # Loss implementation: "dense" ([B,S,V] logits then optax xent) or
     # "chunked" (fused head+loss over vocab chunks — ops/chunked_xent.py;
@@ -244,6 +247,17 @@ class Attention(nn.Module):
             from ..parallel.ring import ring_self_attention
 
             out = ring_self_attention(q, k, v, positions, self.mesh)
+        elif cfg.attn_impl == "ulysses":
+            # All-to-all sequence parallelism (parallel/ulysses.py):
+            # attention runs with full S and 1/sp of the kv heads per
+            # device — two collectives total vs ring's P rotations.
+            if self.mesh is None:
+                raise ValueError(
+                    "attn_impl='ulysses' needs the mesh: Llama(cfg, mesh=mesh)"
+                )
+            from ..parallel.ulysses import ulysses_self_attention
+
+            out = ulysses_self_attention(q, k, v, positions, self.mesh)
         elif cfg.attn_impl == "flash":
             # Blockwise pallas kernel; assumes the standard causal layout
             # (positions = arange), which Llama.__call__ defaults to.
@@ -638,8 +652,10 @@ def _pp_parts(model: "Llama", params, mesh):
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={n_stages}"
         )
-    if cfg.attn_impl == "ring":
-        raise ValueError("attn_impl='ring' cannot run inside the pp pipeline")
+    if cfg.attn_impl in ("ring", "ulysses"):
+        raise ValueError(
+            f"attn_impl={cfg.attn_impl!r} cannot run inside the pp pipeline"
+        )
     p = nn.meta.unbox(params)
     stage_params = jax.tree.map(
         lambda l: l.reshape((n_stages, cfg.n_layers // n_stages) + l.shape[1:]),

@@ -35,9 +35,13 @@ from .data import (  # noqa: F401
     shard_batch_size,
 )
 from .moe import moe_mlp  # noqa: F401
-from .pipeline import pipeline_apply  # noqa: F401
+from .pipeline import pipeline_apply, pipeline_value_and_grad  # noqa: F401
 from .ring import (  # noqa: F401
     ring_attention_shard,
     ring_self_attention,
+)
+from .ulysses import (  # noqa: F401
+    ulysses_attention_shard,
+    ulysses_self_attention,
 )
 from . import collectives  # noqa: F401

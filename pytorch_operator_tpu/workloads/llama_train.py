@@ -489,9 +489,12 @@ def main(argv=None) -> int:
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument("--remat", action="store_true")
     p.add_argument(
-        "--attn-impl", choices=("dense", "flash", "ring"), default=None,
+        "--attn-impl", choices=("dense", "flash", "ring", "ulysses"),
+        default=None,
         help="attention implementation (flash = pallas blockwise kernel; "
-        "ring = sequence-parallel over sp)",
+        "ring = sequence-parallel K/V rotation over sp; ulysses = "
+        "all-to-all head/seq swap over sp — 2 collectives vs ring's P, "
+        "full-S scores per local head)",
     )
     p.add_argument(
         "--xent", choices=("dense", "chunked"), default=None, dest="xent_impl",
