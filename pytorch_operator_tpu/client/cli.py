@@ -626,6 +626,19 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_manifests(args) -> int:
+    # Deploy-manifest generation (SURVEY.md §1 layer 6): the CRD schema is
+    # introspected from api/types.py so it cannot drift (api/crdgen.py).
+    from pytorch_operator_tpu.api import crdgen
+
+    argv = []
+    if args.out_dir:
+        argv += ["--out-dir", args.out_dir]
+    if args.check:
+        argv.append("--check")
+    return crdgen.main(argv)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpujob", description="TPU-native distributed training jobs"
@@ -765,6 +778,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("name")
     add_ns(sp)
     sp.set_defaults(func=cmd_resume)
+
+    sp = sub.add_parser(
+        "manifests", help="generate deploy manifests (CRD/RBAC/Deployment)"
+    )
+    sp.add_argument("--out-dir", default=None, help="default: repo manifests/")
+    sp.add_argument("--check", action="store_true", help="verify no drift")
+    sp.set_defaults(func=cmd_manifests)
 
     sp = sub.add_parser("metrics", help="print supervisor metrics")
     sp.set_defaults(func=cmd_metrics)

@@ -205,7 +205,16 @@ def make_hybrid_mesh(
 
     axis_names = tuple(dcn_axes) + tuple(ici_axes)  # dcn outermost
     shape = tuple(dcn_axes.values()) + tuple(ici_axes.values())
-    if getattr(devices[0], "slice_index", None) is not None:
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_ids and len(slice_ids) > 1:
+        # Real multi-slice topology: the dcn spec must match it exactly —
+        # a mismatched reshape would silently put ici axes across slice
+        # boundaries (fsdp/tp collectives riding DCN).
+        if len(slice_ids) != n_slices:
+            raise ValueError(
+                f"dcn spec {dcn_axes} wants {n_slices} slices but the "
+                f"devices span {len(slice_ids)}"
+            )
         from jax.experimental import mesh_utils
 
         # create_hybrid_device_mesh takes same-length per-axis shapes,
@@ -217,5 +226,12 @@ def make_hybrid_mesh(
             devices=devices,
         )
         return Mesh(dev_array.reshape(shape), axis_names)
+    # Reshape fallback: virtual/test topologies, and multi-PROCESS worlds
+    # whose devices all share one slice id (forced-CPU hosts report
+    # slice_index=0 — slice topology carries no information there).
+    # jax.devices() is process-major, so dcn-outermost puts the dcn axes
+    # across hosts, which is the hybrid layout's intent. Genuinely
+    # multi-slice device sets never reach here (matched specs take the
+    # hybrid path above; mismatches raise).
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, axis_names)

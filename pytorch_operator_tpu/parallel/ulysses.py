@@ -28,26 +28,11 @@ from __future__ import annotations
 def _attend_full_seq(q, k, v, positions, *, causal: bool):
     """Dense softmax attention over the full sequence for the LOCAL head
     subset (heads are embarrassingly parallel, so per-device numerics are
-    identical to the unsharded computation)."""
-    import jax
-    import jax.numpy as jnp
+    identical to the unsharded computation). Shares ring.py's oracle so
+    the two sp schemes cannot drift numerically."""
+    from .ring import _single_shard
 
-    D = q.shape[-1]
-    s = jnp.einsum(
-        "bskgd,btkd->bkgst",
-        q.astype(jnp.float32),
-        k.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    ) / (D**0.5)
-    if causal:
-        ok = positions[:, None, None, None, :] <= positions[:, None, None, :, None]
-        s = jnp.where(ok, s, jnp.finfo(jnp.float32).min)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum(
-        "bkgst,btkd->bskgd", p, v.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
-    return out.astype(q.dtype)
+    return _single_shard(q, k, v, positions, causal=causal)
 
 
 def ulysses_attention_shard(

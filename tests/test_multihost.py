@@ -1,0 +1,80 @@
+"""Multi-host realism: N processes × M devices each, one global mesh.
+
+The reference's multi-node story is N pods × M GPUs with NCCL spanning
+them (SURVEY.md §2 "Comm backend"). TPU-native, a "host" is a process
+owning several local chips and the global mesh spans all processes, with
+the cross-host axis marked ``@dcn`` so only bandwidth-light collectives
+(data-parallel gradient psums) cross the slow network (parallel/mesh.py
+``make_hybrid_mesh``; dcn axes outermost).
+
+The existing smoke/elastic e2es run N processes × 1 device. This is the
+missing shape: the supervisor gang-launches 2 processes that each hold 4
+forced-CPU devices, rendezvous into ONE 8-device world, and train the
+flagship LM on a hybrid dp(across hosts)×fsdp(within host) mesh. The
+final loss must match a single-process 8-device run of the same global
+batch — sharding layout and process topology must not change numerics.
+
+Marked slow: two jax imports + gloo setup + CPU training.
+"""
+
+import re
+
+import pytest
+
+import tests.jaxenv  # noqa: F401  (CPU platform, 8 local devices)
+from pytorch_operator_tpu.api import ProcessTemplate, ReplicaType, Resources
+from pytorch_operator_tpu.controller import Supervisor
+from pytorch_operator_tpu.workloads import llama_train
+from tests.testutil import new_job
+
+ARGS = [
+    "--config", "tiny",
+    "--seq-len", "32",
+    "--batch-size", "4",
+    "--steps", "6",
+    "--warmup", "1",
+]
+
+
+@pytest.mark.slow
+def test_two_hosts_four_devices_each_train_one_hybrid_mesh(tmp_path):
+    sup = Supervisor(state_dir=tmp_path / "state", poll_interval=0.1)
+    job = new_job(name="multihost", workers=1)
+    job.spec.port = None  # auto-allocate: avoid TIME_WAIT across test runs
+    for rs in job.spec.replica_specs.values():
+        rs.template = ProcessTemplate(
+            module="pytorch_operator_tpu.workloads.llama_train",
+            args=ARGS + ["--mesh", "dp=2@dcn,fsdp=4"],
+            resources=Resources(cpu_devices=4),
+        )
+    done = sup.run(job, timeout=300)
+    logs = {
+        role: (
+            tmp_path / "state" / "logs" / f"default_multihost-{role}-0.log"
+        ).read_text()
+        for role in ("master", "worker")
+    }
+    assert done.is_succeeded(), f"master:\n{logs['master']}\nworker:\n{logs['worker']}"
+    sup.shutdown()
+
+    # One world: every process sees all 8 devices and the hybrid mesh.
+    assert "mesh={'dp': 2, 'fsdp': 4}" in logs["master"], logs["master"]
+    m = re.search(r"final loss (\d+\.\d+)", logs["master"])
+    assert m, logs["master"]
+    multihost_loss = float(m.group(1))
+
+    # Numerics pin: the same global batch on a single-process 8-device
+    # mesh must land on the same loss (reduction-order tolerance only).
+    ref = llama_train.run(
+        config="tiny",
+        mesh_spec="dp=2,fsdp=4",
+        batch_size=4,
+        seq_len=32,
+        steps=6,
+        warmup=1,
+        log=lambda *a, **k: None,
+    )
+    assert multihost_loss == pytest.approx(ref["final_loss"], abs=2e-3), (
+        multihost_loss,
+        ref["final_loss"],
+    )
