@@ -47,6 +47,13 @@ class LlamaConfig:
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     remat: bool = False  # checkpoint each block (jax.checkpoint under scan)
+    # Remat policy when remat=True: "full" (save only block boundaries —
+    # minimum HBM, recompute everything in backward) or "dots" (save the
+    # outputs of non-batch matmuls via XLA's offloadable-names policy —
+    # backward skips recomputing the big GEMMs at the price of holding
+    # their outputs; the right trade when HBM has headroom, since the
+    # recompute being avoided is exactly the MXU-bound work).
+    remat_policy: str = "full"
     # Attention implementation: "dense" (materialized S×S scores), "flash"
     # (pallas blockwise kernel, O(S·D) HBM traffic — ops/flash_attention.py),
     # "ring" (sequence-parallel ring attention over the mesh's ``sp`` axis —
@@ -112,6 +119,23 @@ class LlamaConfig:
     @property
     def q_per_kv(self) -> int:
         return self.n_heads // self.n_kv_heads
+
+
+def remat_policy(cfg):
+    """Resolve ``cfg.remat_policy`` to a jax.checkpoint policy (None =
+    save nothing beyond block boundaries, i.e. full remat). Duck-typed:
+    any config with a ``remat_policy`` field (LlamaConfig, ViTConfig)."""
+    if cfg.remat_policy == "full":
+        return None
+    if cfg.remat_policy == "dots":
+        # Saves outputs of batch-dim-free dot_generals — the projection
+        # and MLP GEMMs — so backward recomputes only the cheap
+        # elementwise/norm work (and attention, whose score einsums carry
+        # batch dims; the flash kernel recomputes internally regardless).
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(
+        f"remat_policy={cfg.remat_policy!r} not in ('full', 'dots')"
+    )
 
 
 def llama3_8b(**over) -> LlamaConfig:
@@ -540,7 +564,9 @@ class Llama(nn.Module):
 
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False)
+            block = nn.remat(
+                Block, prevent_cse=False, policy=remat_policy(cfg)
+            )
         ScanBlocks = nn.scan(
             block,
             # Per-layer stacking for params, the decode KV cache, and
@@ -683,7 +709,9 @@ def _pp_parts(model: "Llama", params, mesh):
             return out, None
 
         if cfg.remat:
-            layer = jax.checkpoint(layer, prevent_cse=False)
+            layer = jax.checkpoint(
+                layer, prevent_cse=False, policy=remat_policy(cfg)
+            )
         (act_out, _pos), _ = jax.lax.scan(layer, (act, pos), sp)
         return act_out
 

@@ -65,6 +65,8 @@ def run(
     async_checkpoint: bool = False,
     max_steps: int | None = None,
     remat: bool | None = None,
+    remat_policy: str | None = None,
+    donate: bool | None = None,
     attn_impl: str | None = None,
     xent_impl: str | None = None,
     n_experts: int | None = None,
@@ -90,6 +92,8 @@ def run(
     over = {}
     if remat is not None:
         over["remat"] = remat
+    if remat_policy is not None:
+        over["remat_policy"] = remat_policy
     if attn_impl is not None:
         over["attn_impl"] = attn_impl
     if xent_impl is not None:
@@ -109,6 +113,12 @@ def run(
     if moe_aux_weight is not None:
         over["moe_aux_weight"] = moe_aux_weight
     cfg = getattr(llama_lib, CONFIGS[config])(**over)
+    if remat_policy is not None and not cfg.remat:
+        # Silently measuring the no-remat path while the user believes
+        # the selective policy is active is a benchmarking trap.
+        raise ValueError(
+            f"--remat-policy {remat_policy} has no effect without --remat"
+        )
     # Validate the routing config up front — otherwise a bad top_k only
     # surfaces as a ValueError deep inside model tracing.
     if cfg.n_experts > 0 and not (1 <= cfg.moe_top_k <= cfg.n_experts):
@@ -175,8 +185,20 @@ def run(
     n_params = sum(p.size for p in jax.tree.leaves(state["params"]))
     log(f"[llama] {n_params/1e6:.1f}M params, sharded init +{time.time()-t_init:.1f}s")
 
+    # Donate the train state into the step (in-place update, ~one state
+    # copy of HBM freed) unless async checkpointing needs the returned
+    # state alive under an in-flight save.
+    if donate is None:
+        donate = not async_checkpoint
+    elif donate and async_checkpoint:
+        raise ValueError(
+            "--donate is incompatible with --async-checkpoint: the "
+            "overlapped orbax save reads the state the next step would "
+            "donate (write into in place)"
+        )
     train_step = make_lm_train_step(
-        model, tx, mesh, microbatches=pp_microbatches, pp_schedule=pp_schedule
+        model, tx, mesh, microbatches=pp_microbatches,
+        pp_schedule=pp_schedule, donate=donate,
     )
     batch_sharding = named_sharding(mesh, "batch", "seq")
 
@@ -345,10 +367,12 @@ def run(
                 on_first_step=on_first,
                 checkpoint_every=checkpoint_every,
                 # Async saves overlap the orbax write with the next training
-                # steps (the step fn does not donate state, so the buffers stay
-                # valid); mgr.close()/the final save below still commit
-                # everything before exit. Blocking is the default — preemption
-                # tests need the just-saved step to be durable.
+                # steps — safe ONLY because the donate guard above forces
+                # donate=False under --async-checkpoint (a donating step
+                # would invalidate the buffers mid-save); mgr.close()/the
+                # final save below still commit everything before exit.
+                # Blocking is the default — preemption tests need the
+                # just-saved step to be durable.
                 save=(
                     (lambda s, st: mgr.save(s, st, block=not async_checkpoint))
                     if mgr is not None
@@ -489,6 +513,19 @@ def main(argv=None) -> int:
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument("--remat", action="store_true")
     p.add_argument(
+        "--remat-policy", choices=("full", "dots"), default=None,
+        help="with --remat: 'full' recomputes the whole block in backward "
+        "(min HBM); 'dots' saves the projection/MLP GEMM outputs so "
+        "backward skips recomputing the MXU-bound work (more HBM)",
+    )
+    p.add_argument(
+        "--donate", action=argparse.BooleanOptionalAction, default=None,
+        help="donate the train state into the jitted step (in-place "
+        "update, ~one state copy of HBM freed). Default: on unless "
+        "--async-checkpoint (whose overlapped save needs the old "
+        "buffers intact)",
+    )
+    p.add_argument(
         "--attn-impl", choices=("dense", "flash", "ring", "ulysses"),
         default=None,
         help="attention implementation (flash = pallas blockwise kernel; "
@@ -573,6 +610,8 @@ def main(argv=None) -> int:
         async_checkpoint=args.async_checkpoint,
         max_steps=args.max_steps,
         remat=True if args.remat else None,
+        remat_policy=args.remat_policy,
+        donate=args.donate,
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,
         n_experts=args.n_experts,

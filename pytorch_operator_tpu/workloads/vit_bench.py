@@ -98,6 +98,7 @@ def run_benchmark(
     windows: int = 1,
     attn_impl: str = "dense",
     remat: bool = False,
+    remat_policy: str = "full",
     data_file: str | None = None,
     profile_dir: str | None = None,
     log=print,
@@ -121,9 +122,15 @@ def run_benchmark(
         file_meta, field_x = probe_image_file(data_file)
         if field_x is not None:
             image_size = field_x.shape[0]
+    if remat_policy != "full" and not remat:
+        # Silently measuring the no-remat path while the user believes
+        # the selective policy is active is a benchmarking trap.
+        raise ValueError(
+            f"--remat-policy {remat_policy} has no effect without --remat"
+        )
     cfg = vit_lib.BY_NAME[variant](
         image_size=image_size, num_classes=classes, attn_impl=attn_impl,
-        remat=remat,
+        remat=remat, remat_policy=remat_policy,
     )
     model = vit_lib.ViT(cfg)
     n_dev = jax.device_count()
@@ -269,6 +276,12 @@ def main(argv=None) -> int:
         "under the layer scan): ~1/3 more FLOPs for O(depth) activation "
         "memory -- unlocks larger batches",
     )
+    p.add_argument(
+        "--remat-policy", choices=("full", "dots"), default="full",
+        help="with --remat: 'full' recomputes whole blocks in backward; "
+        "'dots' saves the GEMM outputs so backward skips recomputing "
+        "the MXU-bound work (more HBM)",
+    )
     p.add_argument("--windows", type=int, default=1)
     p.add_argument("--attn-impl", choices=("dense", "flash"), default="dense")
     p.add_argument(
@@ -293,6 +306,7 @@ def main(argv=None) -> int:
         windows=args.windows,
         attn_impl=args.attn_impl,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         data_file=args.data_file,
         profile_dir=args.profile_dir,
         log=lambda msg: print(

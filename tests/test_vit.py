@@ -109,6 +109,48 @@ class TestViTModel:
                 ma0.temp_size_in_bytes,
             )
 
+    def test_remat_dots_policy_same_numerics_between_full_and_none(self):
+        """remat_policy='dots' (save GEMM outputs, recompute the rest)
+        must match no-remat numerics exactly, with backward residency
+        between no-remat and full remat."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        x = jnp.asarray(
+            np.random.default_rng(1).random((32, 16, 16, 3), np.float32)
+        )
+        y = jnp.asarray(np.arange(32) % 10, np.int32)
+        results = {}
+        for tag, kw in {
+            "none": dict(remat=False),
+            "dots": dict(remat=True, remat_policy="dots"),
+            "full": dict(remat=True, remat_policy="full"),
+        }.items():
+            cfg = tiny_cfg(depth=6, **kw)
+            model = vit_lib.ViT(cfg)
+            params = jax.tree.map(
+                lambda l: l.unbox() if hasattr(l, "unbox") else l,
+                model.init(jax.random.key(0), x[:1])["params"],
+                is_leaf=lambda l: hasattr(l, "unbox"),
+            )
+
+            def loss_fn(p, _model=model):
+                logits = _model.apply({"params": p}, x)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                ).mean()
+
+            g = jax.jit(jax.value_and_grad(loss_fn))
+            loss, _ = g(params)
+            ma = g.lower(params).compile().memory_analysis()
+            results[tag] = (float(loss), ma)
+        losses = {t: l for t, (l, _) in results.items()}
+        assert len(set(losses.values())) == 1, losses
+        temps = {t: ma.temp_size_in_bytes for t, (_, ma) in results.items() if ma}
+        if len(temps) == 3:
+            assert temps["full"] <= temps["dots"] <= temps["none"], temps
+
     def test_trains_loss_decreases(self):
         import jax
 

@@ -52,6 +52,42 @@ def test_llama_train_loss_decreases():
     assert result["final_loss"] < 5.0, result
 
 
+def test_donation_and_remat_policy_do_not_change_numerics():
+    """State donation and the 'dots' selective-remat policy are pure
+    execution-strategy knobs — the loss trajectory must be bit-identical
+    to the default path (same graph, different buffer/residual plans)."""
+    runs = {}
+    for tag, kw in {
+        "control": dict(donate=False),
+        "donated": dict(donate=True),
+        "dots": dict(donate=True, remat=True, remat_policy="dots"),
+        "full": dict(donate=True, remat=True, remat_policy="full"),
+    }.items():
+        runs[tag] = llama_train.run(
+            config="tiny", batch_size=4, seq_len=32, steps=8, warmup=1,
+            log=lambda *_: None, **kw,
+        )["final_loss"]
+    assert len(set(runs.values())) == 1, runs
+
+
+def test_remat_policy_without_remat_refused():
+    with pytest.raises(ValueError, match="no effect without --remat"):
+        llama_train.run(
+            config="tiny", batch_size=2, seq_len=16, steps=2,
+            remat_policy="dots", log=lambda *_: None,
+        )
+
+
+def test_donate_with_async_checkpoint_refused(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUJOB_CHECKPOINT_DIR", str(tmp_path))
+    with pytest.raises(ValueError, match="donate.*async"):
+        llama_train.run(
+            config="tiny", batch_size=2, seq_len=16, steps=2,
+            checkpoint_every=1, async_checkpoint=True, donate=True,
+            log=lambda *_: None,
+        )
+
+
 def test_llama_trains_from_packed_text_file(tmp_path):
     """The real-data LM path: a text file packed byte-level streams
     through the prefetch loader into training, with the cosine schedule
