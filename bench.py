@@ -243,6 +243,47 @@ def run(argv=None) -> dict:
     except Exception as e:  # the headline resnet bench must still run
         log(f"[bench] llama bench failed: {e!r}")
 
+    # ---- BERT + ViT: driver-captured like the LM (hand-recorded BASELINE
+    # rows drift; artifact numbers cannot). Short runs — each block is
+    # best-effort and must not sink the headline benches.
+    bert_block = vit_block = None
+    if not args.smoke:
+        try:
+            from pytorch_operator_tpu.workloads import bert_fsdp
+
+            bert_seq_len = 128
+            br = bert_fsdp.run(
+                bert_base=True, batch_size=64, seq_len=bert_seq_len,
+                steps=30, warmup=3, log=lambda m: log(f"[bench] {m}"),
+            )
+            # 6N weight FLOPs per trained token (encoder: no causal term).
+            bert_flops = br["value"] * bert_seq_len * 6.0 * br["params_m"] * 1e6
+            bert_block = {
+                "metric": br["metric"],
+                "value": br["value"],
+                "unit": br["unit"],
+                "mfu": mfu(bert_flops),
+            }
+        except Exception as e:
+            log(f"[bench] bert bench failed: {e!r}")
+        try:
+            from pytorch_operator_tpu.workloads import vit_bench
+
+            vr = vit_bench.run_benchmark(
+                variant="b16", batch_size=64, steps=30, warmup=3, windows=3,
+                remat=True, remat_policy="dots",
+                log=lambda m: log(f"[bench] {m}"),
+            )
+            # ViT-B/16 @224: ~17.6 GF fwd/img (x3 for train).
+            vit_block = {
+                "metric": vr["metric"],
+                "value": vr["value"],
+                "unit": vr["unit"],
+                "mfu": mfu(vr["value"] * 3 * 17.6e9),
+            }
+        except Exception as e:
+            log(f"[bench] vit bench failed: {e!r}")
+
     result = run_benchmark(
         steps=steps,
         warmup=warmup,
@@ -262,6 +303,10 @@ def run(argv=None) -> dict:
         out["mfu"] = mfu(result["value"] * RESNET50_TRAIN_FLOPS_PER_IMG)
     if llama_block is not None:
         out["llama"] = llama_block
+    if bert_block is not None:
+        out["bert"] = bert_block
+    if vit_block is not None:
+        out["vit"] = vit_block
     if latency is not None:
         # The second north-star metric rides along in the same JSON line.
         out["schedule_to_first_step_s"] = latency

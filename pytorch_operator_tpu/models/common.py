@@ -1,0 +1,26 @@
+"""Helpers shared across model families."""
+
+from __future__ import annotations
+
+
+def remat_policy(cfg):
+    """Resolve ``cfg.remat_policy`` to a jax.checkpoint policy (None =
+    save nothing beyond block boundaries, i.e. full remat). Duck-typed:
+    any config with a ``remat_policy`` field (LlamaConfig, ViTConfig).
+
+    ``"dots"`` saves outputs of batch-dim-free dot_generals — the
+    projection and MLP GEMMs — so backward recomputes only the cheap
+    elementwise/norm work (and attention, whose score einsums carry
+    batch dims; the flash kernel recomputes internally regardless).
+    Measured +8.5% on the 0.3b LM and +12% on ViT-B vs full remat
+    (BASELINE.md round-3 sweep).
+    """
+    import jax
+
+    if cfg.remat_policy == "full":
+        return None
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(
+        f"remat_policy={cfg.remat_policy!r} not in ('full', 'dots')"
+    )

@@ -121,21 +121,7 @@ class LlamaConfig:
         return self.n_heads // self.n_kv_heads
 
 
-def remat_policy(cfg):
-    """Resolve ``cfg.remat_policy`` to a jax.checkpoint policy (None =
-    save nothing beyond block boundaries, i.e. full remat). Duck-typed:
-    any config with a ``remat_policy`` field (LlamaConfig, ViTConfig)."""
-    if cfg.remat_policy == "full":
-        return None
-    if cfg.remat_policy == "dots":
-        # Saves outputs of batch-dim-free dot_generals — the projection
-        # and MLP GEMMs — so backward recomputes only the cheap
-        # elementwise/norm work (and attention, whose score einsums carry
-        # batch dims; the flash kernel recomputes internally regardless).
-        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-    raise ValueError(
-        f"remat_policy={cfg.remat_policy!r} not in ('full', 'dots')"
-    )
+from .common import remat_policy  # shared with ViT (models/common.py)
 
 
 def llama3_8b(**over) -> LlamaConfig:

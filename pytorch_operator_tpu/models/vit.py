@@ -199,7 +199,7 @@ class ViT(nn.Module):
 
         block = EncoderBlock
         if cfg.remat:
-            from .llama import remat_policy as _policy
+            from .common import remat_policy as _policy
 
             block = nn.remat(
                 EncoderBlock, prevent_cse=False, policy=_policy(cfg)

@@ -76,6 +76,7 @@ def run(
     moe_aux_weight: float | None = None,
     pp_microbatches: int | None = None,
     pp_schedule: str = "gpipe",
+    grad_accum: int = 1,
     preempt_at: int | None = None,
     profile_dir: str | None = None,
     log=print,
@@ -185,6 +186,23 @@ def run(
     n_params = sum(p.size for p in jax.tree.leaves(state["params"]))
     log(f"[llama] {n_params/1e6:.1f}M params, sharded init +{time.time()-t_init:.1f}s")
 
+    if grad_accum > 1:
+        if batch % grad_accum:
+            raise ValueError(
+                f"--grad-accum {grad_accum} must divide the global batch "
+                f"{batch}"
+            )
+        data_extent = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        if (batch // grad_accum) % data_extent:
+            log(
+                f"[llama] WARNING: per-microbatch batch "
+                f"{batch // grad_accum} is not divisible by the data-"
+                f"parallel extent {data_extent} — XLA will replicate "
+                f"activations across the batch axes (SPMD 'involuntary "
+                f"full rematerialization'). Use batch >= grad_accum * "
+                f"{data_extent}."
+            )
+
     # Donate the train state into the step (in-place update, ~one state
     # copy of HBM freed) unless async checkpointing needs the returned
     # state alive under an in-flight save.
@@ -198,7 +216,7 @@ def run(
         )
     train_step = make_lm_train_step(
         model, tx, mesh, microbatches=pp_microbatches,
-        pp_schedule=pp_schedule, donate=donate,
+        pp_schedule=pp_schedule, donate=donate, grad_accum=grad_accum,
     )
     batch_sharding = named_sharding(mesh, "batch", "seq")
 
@@ -511,6 +529,12 @@ def main(argv=None) -> int:
         "earlier)",
     )
     p.add_argument("--max-steps", type=int, default=None)
+    p.add_argument(
+        "--grad-accum", type=int, default=1,
+        help="split the global batch into N sequential microbatches inside "
+        "one jitted step (mean grads, one optimizer update): ~N-fold less "
+        "activation memory for the same global batch",
+    )
     p.add_argument("--remat", action="store_true")
     p.add_argument(
         "--remat-policy", choices=("full", "dots"), default=None,
@@ -621,6 +645,7 @@ def main(argv=None) -> int:
         moe_aux_weight=args.moe_aux_weight,
         pp_microbatches=args.pp_microbatches,
         pp_schedule=args.pp_schedule,
+        grad_accum=args.grad_accum,
         preempt_at=args.preempt_at,
         profile_dir=args.profile_dir,
         log=lambda msg: print(

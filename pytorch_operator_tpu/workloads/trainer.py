@@ -269,7 +269,8 @@ def make_lm_loss_fn(model, mesh, microbatches=None, include_aux=True):
 
 
 def make_lm_train_step(
-    model, tx, mesh, microbatches=None, pp_schedule="gpipe", donate=False
+    model, tx, mesh, microbatches=None, pp_schedule="gpipe", donate=False,
+    grad_accum=1,
 ):
     """Jitted LM train step. Objective semantics are
     :func:`make_lm_loss_fn`'s.
@@ -283,6 +284,15 @@ def make_lm_train_step(
     callers must pass donate=False whenever saves overlap steps —
     blocking saves are fine (they complete before the next step call).
 
+    ``grad_accum=N`` splits the global batch into N sequential
+    microbatches inside ONE jitted step (``lax.scan`` over the leading
+    split, mean of per-microbatch grads, one optimizer update) — the
+    standard lever for global batches whose activations exceed HBM.
+    Activation memory drops ~N-fold; the params-sized grad accumulator
+    is the cost. Numerically equal to the unsplit step up to f32
+    reassociation in the mean. Not composable with a pp mesh (the
+    pipeline schedules already microbatch — use pp_microbatches).
+
     On a pp mesh, ``pp_schedule`` picks the pipeline execution:
     "gpipe" (autodiff's reverse schedule over the model's pp_forward —
     per-stage backward residency O(M·mb)) or "1f1b" (the model's fused
@@ -292,6 +302,16 @@ def make_lm_train_step(
     import optax
 
     pp = mesh.shape.get("pp", 1) > 1
+    # Validate BEFORE any schedule branch returns — grad_accum silently
+    # ignored on the 1f1b path would be the same silent-knob trap the
+    # remat-policy-without-remat guard exists for.
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if grad_accum > 1 and pp:
+        raise ValueError(
+            "grad_accum does not compose with a pp mesh — the pipeline "
+            "schedules already microbatch (use pp_microbatches)"
+        )
     if pp_schedule not in ("gpipe", "1f1b"):
         raise ValueError(
             f"pp_schedule={pp_schedule!r} not in ('gpipe', '1f1b')"
@@ -328,7 +348,32 @@ def make_lm_train_step(
 
     @partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
+        if grad_accum == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(state["params"], tokens)
+        else:
+            B = tokens.shape[0]
+            if B % grad_accum:
+                raise ValueError(
+                    f"global batch {B} not divisible by grad_accum={grad_accum}"
+                )
+            mbs = tokens.reshape(grad_accum, B // grad_accum, *tokens.shape[1:])
+
+            def body(carry, tb):
+                loss_sum, grad_sum = carry
+                loss, grads = jax.value_and_grad(loss_fn)(state["params"], tb)
+                return (
+                    loss_sum + loss,
+                    jax.tree.map(lambda a, g: a + g, grad_sum, grads),
+                ), None
+
+            import jax.numpy as jnp
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+            )
+            (loss_sum, grad_sum), _ = jax.lax.scan(body, (0.0, zeros), mbs)
+            loss = loss_sum / grad_accum
+            grads = jax.tree.map(lambda g: g / grad_accum, grad_sum)
         updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
         params = optax.apply_updates(state["params"], updates)
         return {"params": params, "opt_state": opt_state}, loss

@@ -70,6 +70,29 @@ def test_donation_and_remat_policy_do_not_change_numerics():
     assert len(set(runs.values())) == 1, runs
 
 
+def test_grad_accum_matches_unsplit_step():
+    """grad_accum=N (sequential microbatches, mean grads, one update)
+    must reproduce the unsplit step's loss trajectory up to f32
+    reassociation — same global batch, ~N-fold less activation memory."""
+    losses = {
+        n: llama_train.run(
+            config="tiny", batch_size=8, seq_len=32, steps=6, warmup=1,
+            grad_accum=n, log=lambda *_: None,
+        )["final_loss"]
+        for n in (1, 2, 4)
+    }
+    assert losses[2] == pytest.approx(losses[1], abs=2e-3), losses
+    assert losses[4] == pytest.approx(losses[1], abs=2e-3), losses
+
+
+def test_grad_accum_on_pp_mesh_refused():
+    with pytest.raises(ValueError, match="grad_accum.*pp"):
+        llama_train.run(
+            config="tiny", mesh_spec="dp=4,pp=2", batch_size=8, seq_len=32,
+            steps=2, grad_accum=2, log=lambda *_: None,
+        )
+
+
 def test_remat_policy_without_remat_refused():
     with pytest.raises(ValueError, match="no effect without --remat"):
         llama_train.run(
