@@ -1,0 +1,235 @@
+"""Profile-report tool: wall-time breakdown from a jax.profiler trace.
+
+SURVEY.md §5 "Tracing / profiling": the reference has none of its own
+(training-side profiling is user-container business); the rebuild's
+workloads write ``jax.profiler`` traces via ``--profile-dir``. This
+module closes the loop WITHOUT tensorboard: it parses the trace's
+``*.xplane.pb`` directly and prints where device time goes — per-step
+busy/idle split, op-category totals, and the top individual ops — the
+analysis used for the BASELINE.md bandwidth-wall findings, as a tool.
+
+Usage::
+
+    python -m pytorch_operator_tpu.workloads.llama_train ... --profile-dir /tmp/prof
+    python -m pytorch_operator_tpu.profiling /tmp/prof [--top 12] [--json]
+
+The xplane schema is stable across the jax/tf profiler family: planes
+(one per device) → lines (Steps / XLA Ops / ...) → timed events whose
+metadata names the HLO op. Parsing needs the ``xplane_pb2`` proto, which
+ships inside the installed tensorflow (cpu) package; anything missing
+degrades to a clear error, never a crash, since this is a diagnostics
+path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from collections import defaultdict
+from pathlib import Path
+from typing import Optional
+
+_PS = 1e-12
+
+
+def _import_xplane_pb2():
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # type: ignore
+
+        return xplane_pb2
+    except ImportError:
+        pass
+    try:  # newer layouts
+        from tsl.profiler.protobuf import xplane_pb2  # type: ignore
+
+        return xplane_pb2
+    except ImportError as e:
+        raise RuntimeError(
+            "no xplane_pb2 proto available (needs the tensorflow package "
+            "that ships in this image) — cannot parse the trace"
+        ) from e
+
+
+def find_xplane(profile_dir) -> Path:
+    """Newest ``*.xplane.pb`` under a ``--profile-dir`` tree."""
+    paths = sorted(
+        Path(profile_dir).rglob("*.xplane.pb"), key=lambda p: p.stat().st_mtime
+    )
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {profile_dir}")
+    return paths[-1]
+
+
+def _category(display_name: str) -> str:
+    """HLO op display names carry a ``kind.N`` suffix — strip the serial
+    to get the category (fusion, copy, all-reduce, custom-call, ...)."""
+    return re.sub(r"[.\-]?\d+$", "", display_name) or display_name
+
+
+def _aggregate_self_times(line, meta, by_cat, by_op) -> float:
+    """Charge each event its SELF time (duration minus enclosed children)
+    into the aggregates; returns the line's total busy seconds.
+
+    Events nest within a line (a layer-scan ``while`` contains its body
+    ops; a python frame contains its callees) — self-time keeps the
+    total equal to true busy time instead of double-counting every
+    nesting level. An interval stack over offset-sorted events recovers
+    the tree.
+    """
+    busy = 0.0
+    stack: list = []  # [end_ps, metadata_id, start_ps, child_ps]
+
+    def pop(ev_start_ps) -> None:
+        nonlocal busy
+        while stack and (ev_start_ps is None or stack[-1][0] <= ev_start_ps):
+            end, mid, start, child = stack.pop()
+            dur = end - start
+            if stack:
+                stack[-1][3] += dur
+            dt = (dur - child) * _PS
+            busy += dt
+            m = meta.get(mid)
+            name = (m.display_name or m.name) if m is not None else f"op{mid}"
+            by_cat[_category(name)] += dt
+            by_op[name] += dt
+
+    # Outer intervals must be pushed before children that share their
+    # start timestamp — longest-first at ties keeps the nesting upright
+    # (child-first would charge the child a negative self time).
+    for e in sorted(line.events, key=lambda e: (e.offset_ps, -e.duration_ps)):
+        pop(e.offset_ps)
+        stack.append([e.offset_ps + e.duration_ps, e.metadata_id, e.offset_ps, 0])
+    pop(None)
+    return busy
+
+
+def device_report(profile_dir, device_substr: str = "TPU") -> Optional[dict]:
+    """Aggregate the device plane into a wall breakdown dict.
+
+    Returns None when the trace has no matching device plane (e.g. a
+    CPU-only run asked for TPU).
+    """
+    xplane_pb2 = _import_xplane_pb2()
+    xs = xplane_pb2.XSpace()
+    xs.ParseFromString(find_xplane(profile_dir).read_bytes())
+
+    plane = next(
+        (p for p in xs.planes if device_substr in p.name and p.lines), None
+    )
+    if plane is None:
+        return None
+
+    lines = {l.name: l for l in plane.lines}
+    report: dict = {"device": plane.name}
+
+    steps = lines.get("Steps")
+    if steps is not None and steps.events:
+        durs = [e.duration_ps * _PS for e in steps.events]
+        report["steps"] = len(durs)
+        report["mean_step_s"] = sum(durs) / len(durs)
+        report["span_s"] = sum(durs)
+
+    # Per-op accounting: the device's "XLA Ops" line when present (TPU
+    # traces), else every thread line (host/CPU traces, where the events
+    # are python/runtime frames — still a useful where-does-time-go).
+    if "XLA Ops" in lines:
+        op_lines = [lines["XLA Ops"]]
+    else:
+        op_lines = [
+            l for l in plane.lines
+            if l.events and l.name not in ("Steps", "XLA Modules")
+        ]
+    if any(l.events for l in op_lines):
+        meta = plane.event_metadata
+        by_cat: dict = defaultdict(float)
+        by_op: dict = defaultdict(float)
+        busy = 0.0
+        for line in op_lines:
+            busy += _aggregate_self_times(line, meta, by_cat, by_op)
+        report["busy_s"] = busy
+        # Busy-vs-span is a utilization figure only for the single device
+        # op line; summing N concurrent host threads against wall time
+        # would read >100% and mean nothing.
+        if len(op_lines) == 1 and report.get("span_s", 0) > 0:
+            report["busy_frac_of_steps"] = busy / report["span_s"]
+        n = report.get("steps") or 1
+        report["categories"] = sorted(
+            (
+                {"category": c, "s_per_step": t / n, "pct_of_busy": 100 * t / busy}
+                for c, t in by_cat.items()
+            ),
+            key=lambda r: -r["s_per_step"],
+        )
+        report["top_ops"] = sorted(
+            (
+                {"op": o, "s_per_step": t / n, "pct_of_busy": 100 * t / busy}
+                for o, t in by_op.items()
+            ),
+            key=lambda r: -r["s_per_step"],
+        )
+    return report
+
+
+def format_report(report: dict, top: int = 12) -> str:
+    out = [f"device: {report['device']}"]
+    if "steps" in report:
+        out.append(
+            f"steps: {report['steps']}  mean {report['mean_step_s']*1e3:.2f} ms/step"
+        )
+    if "busy_s" in report:
+        n = report.get("steps") or 1
+        line = f"device busy: {report['busy_s']/n*1e3:.2f} ms/step"
+        if "busy_frac_of_steps" in report:
+            line += f" ({100*report['busy_frac_of_steps']:.1f}% of step span)"
+        out.append(line)
+    if report.get("categories"):
+        out.append("\nby op category (ms/step, % of busy):")
+        for r in report["categories"][:top]:
+            out.append(
+                f"  {r['s_per_step']*1e3:8.2f}  {r['pct_of_busy']:5.1f}%  "
+                f"{r['category']}"
+            )
+    if report.get("top_ops"):
+        out.append(f"\ntop {top} ops (ms/step, % of busy):")
+        for r in report["top_ops"][:top]:
+            out.append(
+                f"  {r['s_per_step']*1e3:8.2f}  {r['pct_of_busy']:5.1f}%  {r['op']}"
+            )
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("profile_dir", help="the --profile-dir a workload wrote")
+    p.add_argument("--device", default="TPU", help="device plane substring")
+    p.add_argument("--top", type=int, default=12)
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    try:
+        report = device_report(args.profile_dir, args.device)
+    except (RuntimeError, FileNotFoundError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except Exception as e:  # corrupt/truncated trace (protobuf DecodeError)
+        print(f"error: unreadable trace: {e!r}", file=sys.stderr)
+        return 1
+    if report is None:
+        print(
+            f"error: no '{args.device}' device plane in the trace "
+            "(try --device CPU)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.as_json:
+        # Trim the unbounded op table for machine consumers too.
+        report["top_ops"] = report.get("top_ops", [])[: args.top]
+        print(json.dumps(report, indent=2))
+    else:
+        print(format_report(report, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -164,6 +164,23 @@ def run(
         f"({jax.devices()[0].platform})"
     )
 
+    if grad_accum > 1:
+        if batch % grad_accum:
+            raise ValueError(
+                f"--grad-accum {grad_accum} must divide the global batch "
+                f"{batch}"
+            )
+        data_extent = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        if (batch // grad_accum) % data_extent:
+            log(
+                f"[llama] WARNING: per-microbatch batch "
+                f"{batch // grad_accum} is not divisible by the data-"
+                f"parallel extent {data_extent} — XLA will replicate "
+                f"activations across the batch axes (SPMD 'involuntary "
+                f"full rematerialization'). Use batch >= grad_accum * "
+                f"{data_extent}."
+            )
+
     # Optimizer via the shared recipe helper. Cosine horizon default:
     # --max-steps when set (the GLOBAL step budget, correct across
     # checkpoint resumes — the restored optimizer count is global), else
@@ -185,23 +202,6 @@ def run(
     )
     n_params = sum(p.size for p in jax.tree.leaves(state["params"]))
     log(f"[llama] {n_params/1e6:.1f}M params, sharded init +{time.time()-t_init:.1f}s")
-
-    if grad_accum > 1:
-        if batch % grad_accum:
-            raise ValueError(
-                f"--grad-accum {grad_accum} must divide the global batch "
-                f"{batch}"
-            )
-        data_extent = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-        if (batch // grad_accum) % data_extent:
-            log(
-                f"[llama] WARNING: per-microbatch batch "
-                f"{batch // grad_accum} is not divisible by the data-"
-                f"parallel extent {data_extent} — XLA will replicate "
-                f"activations across the batch axes (SPMD 'involuntary "
-                f"full rematerialization'). Use batch >= grad_accum * "
-                f"{data_extent}."
-            )
 
     # Donate the train state into the step (in-place update, ~one state
     # copy of HBM freed) unless async checkpointing needs the returned
