@@ -285,12 +285,70 @@ def cmd_supervisor(args) -> int:
 
 
 def cmd_get(args) -> int:
+    if getattr(args, "watch", False):
+        return _get_watch(args)
+    return _get_once(args)
+
+
+def _get_watch(args) -> int:
+    """kubectl get -w analog: re-render whenever a watched job's STATE
+    changes (poll the persisted store — it IS the watch surface; the
+    reconciler writes every transition through it). Change detection
+    runs on a state fingerprint, NOT the rendered text: the AGE column
+    ticks every second and must not trigger re-renders. ``--json``
+    streams bare snapshots with no separator (kubectl -w -o json)."""
+
+    def fingerprint(store) -> list:
+        # Read-only observer: re-read from disk every poll (list() serves
+        # this process's cached objects; the transitions we're watching
+        # are written by the owning supervisor process). rescan() picks
+        # up newly submitted jobs, reload() refreshes known ones.
+        store.rescan()
+        for key in store.keys():
+            store.reload(key)
+        jobs = store.list()
+        if args.name:
+            jobs = [
+                j for j in jobs
+                if j.metadata.name == args.name
+                and j.metadata.namespace == args.namespace
+            ]
+        return sorted(
+            (
+                job_key(j),
+                _phase_of(j),
+                j.status.restart_count,
+                j.spec.run_policy.scheduling_policy.queue,
+                j.spec.run_policy.scheduling_policy.priority,
+            )
+            for j in jobs
+        )
+
+    store = JobStore(persist_dir=_state_dir(args) / "jobs")
+    last = None
+    try:
+        while True:
+            fp = fingerprint(store)
+            if fp != last:
+                if last is not None and not getattr(args, "json", False):
+                    print("---")
+                rc = _get_once(args, missing_ok=True)
+                if rc != 0:
+                    return rc
+                sys.stdout.flush()
+                last = fp
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _get_once(args, missing_ok: bool = False) -> int:
     store = JobStore(persist_dir=_state_dir(args) / "jobs")
     jobs = store.list()
     if args.name:
         jobs = [j for j in jobs if j.metadata.name == args.name
                 and j.metadata.namespace == args.namespace]
-        if not jobs:
+        if not jobs and not missing_ok:
             print(f"error: tpujob {_resolve_key(args)} not found", file=sys.stderr)
             return 1
     if getattr(args, "json", False):
@@ -714,6 +772,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument(
         "--json", action="store_true",
         help="full job objects as JSON (kubectl -o json analog)",
+    )
+    sp.add_argument(
+        "-w", "--watch", action="store_true",
+        help="keep watching; re-print the table on any state change",
     )
     add_ns(sp)
     sp.set_defaults(func=cmd_get)

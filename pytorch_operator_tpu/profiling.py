@@ -148,6 +148,11 @@ def device_report(profile_dir, device_substr: str = "TPU") -> Optional[dict]:
         busy = 0.0
         for line in op_lines:
             busy += _aggregate_self_times(line, meta, by_cat, by_op)
+        if busy <= 0:
+            # All-zero-duration events (truncated capture, instant
+            # markers): no meaningful breakdown — report what exists
+            # rather than dividing by zero below.
+            return report
         report["busy_s"] = busy
         # Busy-vs-span is a utilization figure only for the single device
         # op line; summing N concurrent host threads against wall time

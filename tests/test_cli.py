@@ -48,6 +48,55 @@ class TestCLI:
         assert job.metadata.name == "cli-job"
         assert job.is_succeeded()
 
+    def test_get_watch_streams_state_changes(self, tmp_path, job_yaml, capsys):
+        """kubectl get -w analog: the watch loop re-prints the table when
+        a job's state changes and exits on interrupt."""
+        import threading
+        import time as _time
+
+        state = tmp_path / "state"
+        assert run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30") == 0
+        capsys.readouterr()
+
+        import pytorch_operator_tpu.client.cli as cli
+        from pytorch_operator_tpu.controller.store import JobStore
+
+        # Flip the persisted job's state from another thread mid-watch,
+        # then interrupt the watcher the way a user would (KeyboardInterrupt).
+        main_thread_id = threading.get_ident()
+
+        def flip_and_stop():
+            _time.sleep(1.2)
+            store = JobStore(persist_dir=state / "jobs")
+            job = store.reload("default/cli-job")
+            job.status.restart_count = 7
+            store.update(job)
+            _time.sleep(1.2)
+            import ctypes
+
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_long(main_thread_id),
+                ctypes.py_object(KeyboardInterrupt),
+            )
+
+        t = threading.Thread(target=flip_and_stop, daemon=True)
+        t.start()
+        rc = cli.main(["--state-dir", str(state), "get", "--watch"])
+        t.join(5)
+        out = capsys.readouterr().out
+        assert rc == 0
+        # State-fingerprint change detection: EXACTLY two renders (the
+        # AGE column ticking must not cause re-renders — the watch ran
+        # ~2.4s, so age churn would have produced more).
+        headers = [l for l in out.splitlines() if l.startswith("NAME")]
+        assert len(headers) == 2, out
+        # The flipped restart count reached the stream, read from the
+        # RESTARTS column of the final table (not a substring match an
+        # age like '7s' could satisfy).
+        final = out.split("---")[-1].strip().splitlines()
+        header, row = final[0].split(), final[1].split()
+        assert row[header.index("RESTARTS")] == "7", out
+
     def test_run_get_describe_logs(self, tmp_path, job_yaml, capsys):
         state = tmp_path / "state"
         rc = run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30")
