@@ -298,14 +298,32 @@ def _get_watch(args) -> int:
     ticks every second and must not trigger re-renders. ``--json``
     streams bare snapshots with no separator (kubectl -w -o json)."""
 
+    jobs_dir = _state_dir(args) / "jobs"
+    mtimes: dict = {}
+
+    def refresh(store) -> None:
+        # Read-only observer: the transitions being watched are written
+        # by the owning supervisor process, so list()'s in-process cache
+        # must be refreshed from disk — but only for files whose mtime
+        # actually moved (a flat rescan+reload would parse every job's
+        # JSON twice per 0.5s poll forever).
+        nonlocal mtimes
+        current: dict = {}
+        for p in jobs_dir.glob("*.json"):
+            try:
+                current[p.name] = p.stat().st_mtime
+            except OSError:
+                pass  # deleted mid-scan
+        if current == mtimes:
+            return
+        store.rescan()  # picks up newly submitted jobs
+        for name in set(mtimes) | set(current):
+            if mtimes.get(name) != current.get(name):
+                store.reload(fs_to_key(name[: -len(".json")]))
+        mtimes = current
+
     def fingerprint(store) -> list:
-        # Read-only observer: re-read from disk every poll (list() serves
-        # this process's cached objects; the transitions we're watching
-        # are written by the owning supervisor process). rescan() picks
-        # up newly submitted jobs, reload() refreshes known ones.
-        store.rescan()
-        for key in store.keys():
-            store.reload(key)
+        refresh(store)
         jobs = store.list()
         if args.name:
             jobs = [
@@ -332,7 +350,7 @@ def _get_watch(args) -> int:
             if fp != last:
                 if last is not None and not getattr(args, "json", False):
                     print("---")
-                rc = _get_once(args, missing_ok=True)
+                rc = _get_once(args, missing_ok=True, store=store)
                 if rc != 0:
                     return rc
                 sys.stdout.flush()
@@ -342,8 +360,9 @@ def _get_watch(args) -> int:
         return 0
 
 
-def _get_once(args, missing_ok: bool = False) -> int:
-    store = JobStore(persist_dir=_state_dir(args) / "jobs")
+def _get_once(args, missing_ok: bool = False, store=None) -> int:
+    if store is None:
+        store = JobStore(persist_dir=_state_dir(args) / "jobs")
     jobs = store.list()
     if args.name:
         jobs = [j for j in jobs if j.metadata.name == args.name

@@ -34,11 +34,52 @@ def rules_for_mesh(mesh, rules: LogicalRules = DEFAULT_RULES) -> LogicalRules:
 
 def logical_shardings(abstract_tree: Any, mesh, rules: LogicalRules = DEFAULT_RULES):
     """NamedShardings for a (possibly abstract) tree of flax ``Partitioned``
-    leaves — pass ``jax.eval_shape(model.init, ...)`` output."""
+    leaves — pass ``jax.eval_shape(model.init, ...)`` output.
+
+    Leaves whose rank is LOWER than their inherited spec fall back to
+    replicated: optimizer states that reduce over param axes (adafactor's
+    factored ``v_row``/``v_col`` vectors for a matrix param) inherit the
+    param's logical axes through the state pytree but cannot carry a
+    higher-rank PartitionSpec — and as reduced statistics they are small
+    enough that replication is the right layout.
+    """
     import flax.linen as nn
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
 
     specs = nn.get_partition_spec(abstract_tree)
-    return nn.logical_to_mesh_sharding(specs, mesh, rules_for_mesh(mesh, rules))
+    shardings = nn.logical_to_mesh_sharding(
+        specs, mesh, rules_for_mesh(mesh, rules)
+    )
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def fix(leaf, sh):
+        target = sh.value if hasattr(sh, "value") else sh
+        if (
+            isinstance(target, NamedSharding)
+            and hasattr(leaf, "ndim")
+            and leaf.ndim < len(target.spec)
+        ):
+            return sh.replace_boxed(replicated) if hasattr(sh, "replace_boxed") else replicated
+        return sh
+
+    leaves = jax.tree.leaves(
+        abstract_tree, is_leaf=lambda x: hasattr(x, "unbox")
+    )
+    sh_leaves = jax.tree.leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "unbox") or isinstance(x, NamedSharding)
+    )
+    if len(leaves) == len(sh_leaves):
+        fixed = [
+            fix(l.unbox() if hasattr(l, "unbox") else l, s)
+            for l, s in zip(leaves, sh_leaves)
+        ]
+        treedef = jax.tree.structure(
+            shardings,
+            is_leaf=lambda x: hasattr(x, "unbox") or isinstance(x, NamedSharding),
+        )
+        return jax.tree.unflatten(treedef, fixed)
+    return shardings
 
 
 def init_sharded(

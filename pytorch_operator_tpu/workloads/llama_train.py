@@ -54,6 +54,7 @@ def run(
     steps: int = 20,
     warmup: int = 2,
     lr: float = 3e-4,
+    optimizer: str = "adamw",
     lr_schedule: str = "constant",
     lr_warmup_steps: int = 0,
     lr_decay_steps: int | None = None,
@@ -190,6 +191,7 @@ def run(
 
     tx = make_optimizer(
         lr,
+        optimizer=optimizer,
         schedule=lr_schedule,
         warmup_steps=lr_warmup_steps,
         decay_steps=lr_decay_steps or max_steps or (steps + max(warmup, 1)),
@@ -530,6 +532,11 @@ def main(argv=None) -> int:
     )
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument(
+        "--optimizer", choices=("adamw", "adafactor"), default="adamw",
+        help="adafactor: factored second moments — optimizer state ~N/k "
+        "floats instead of AdamW's 2N (the memory lever at LM scale)",
+    )
+    p.add_argument(
         "--grad-accum", type=int, default=1,
         help="split the global batch into N sequential microbatches inside "
         "one jitted step (mean grads, one optimizer update): ~N-fold less "
@@ -623,6 +630,7 @@ def main(argv=None) -> int:
         steps=args.steps,
         warmup=args.warmup,
         lr=args.lr,
+        optimizer=args.optimizer,
         lr_schedule=args.lr_schedule,
         lr_warmup_steps=args.lr_warmup_steps,
         lr_decay_steps=args.lr_decay_steps,
