@@ -60,6 +60,17 @@ def mfu(flops_per_sec: float) -> dict:
     }
 
 
+def metric_block(result: dict, flops_per_sec: float) -> dict:
+    """The shared artifact shape for a workload bench: metric/value/unit
+    plus the MFU accounting against both denominators."""
+    return {
+        "metric": result["metric"],
+        "value": result["value"],
+        "unit": result["unit"],
+        "mfu": mfu(flops_per_sec),
+    }
+
+
 def lm_train_flops_per_token(n_params: float, n_layers: int, d_model: int,
                              seq_len: int) -> float:
     """Standard decoder-LM training estimate: 6N weight FLOPs/token plus
@@ -227,15 +238,12 @@ def run(argv=None) -> dict:
             lm_cfg.d_model,
             lm["seq_len"],
         )
-        llama_block = {
-            "metric": lm_result["metric"],
-            "value": lm_result["value"],
-            "unit": lm_result["unit"],
-            "config": lm["config"],
-            "seq_len": lm["seq_len"],
-            "final_loss": lm_result["final_loss"],
-            "mfu": mfu(lm_flops),
-        }
+        llama_block = metric_block(lm_result, lm_flops)
+        llama_block.update(
+            config=lm["config"],
+            seq_len=lm["seq_len"],
+            final_loss=lm_result["final_loss"],
+        )
         if not args.smoke:
             llama_block["vs_baseline"] = round(
                 lm_result["value"] / BASELINE_LLAMA_TOKENS_PER_SEC_PER_CHIP, 4
@@ -257,13 +265,9 @@ def run(argv=None) -> dict:
                 steps=30, warmup=3, log=lambda m: log(f"[bench] {m}"),
             )
             # 6N weight FLOPs per trained token (encoder: no causal term).
-            bert_flops = br["value"] * bert_seq_len * 6.0 * br["params_m"] * 1e6
-            bert_block = {
-                "metric": br["metric"],
-                "value": br["value"],
-                "unit": br["unit"],
-                "mfu": mfu(bert_flops),
-            }
+            bert_block = metric_block(
+                br, br["value"] * bert_seq_len * 6.0 * br["params_m"] * 1e6
+            )
         except Exception as e:
             log(f"[bench] bert bench failed: {e!r}")
         try:
@@ -275,12 +279,7 @@ def run(argv=None) -> dict:
                 log=lambda m: log(f"[bench] {m}"),
             )
             # ViT-B/16 @224: ~17.6 GF fwd/img (x3 for train).
-            vit_block = {
-                "metric": vr["metric"],
-                "value": vr["value"],
-                "unit": vr["unit"],
-                "mfu": mfu(vr["value"] * 3 * 17.6e9),
-            }
+            vit_block = metric_block(vr, vr["value"] * 3 * 17.6e9)
         except Exception as e:
             log(f"[bench] vit bench failed: {e!r}")
 

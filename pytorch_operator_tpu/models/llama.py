@@ -25,6 +25,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from .common import remat_policy  # shared with ViT (models/common.py)
+
 Dtype = Any
 
 # Logical axis vocabulary (see parallel/sharding.py DEFAULT_RULES):
@@ -119,9 +121,6 @@ class LlamaConfig:
     @property
     def q_per_kv(self) -> int:
         return self.n_heads // self.n_kv_heads
-
-
-from .common import remat_policy  # shared with ViT (models/common.py)
 
 
 def llama3_8b(**over) -> LlamaConfig:

@@ -29,6 +29,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from .common import remat_policy as _remat_policy
+
 Dtype = Any
 
 
@@ -199,10 +201,8 @@ class ViT(nn.Module):
 
         block = EncoderBlock
         if cfg.remat:
-            from .common import remat_policy as _policy
-
             block = nn.remat(
-                EncoderBlock, prevent_cse=False, policy=_policy(cfg)
+                EncoderBlock, prevent_cse=False, policy=_remat_policy(cfg)
             )
         ScanBlocks = nn.scan(
             block,

@@ -112,6 +112,12 @@ def run_benchmark(
     from ..parallel.data import global_batch
     from .datasets import synthetic_images
 
+    if remat_policy != "full" and not remat:
+        # Silently measuring the no-remat path while the user believes
+        # the selective policy is active is a benchmarking trap.
+        raise ValueError(
+            f"--remat-policy {remat_policy} has no effect without --remat"
+        )
     file_meta = None
     if data_file:
         from .trainer import probe_image_file
@@ -122,12 +128,6 @@ def run_benchmark(
         file_meta, field_x = probe_image_file(data_file)
         if field_x is not None:
             image_size = field_x.shape[0]
-    if remat_policy != "full" and not remat:
-        # Silently measuring the no-remat path while the user believes
-        # the selective policy is active is a benchmarking trap.
-        raise ValueError(
-            f"--remat-policy {remat_policy} has no effect without --remat"
-        )
     cfg = vit_lib.BY_NAME[variant](
         image_size=image_size, num_classes=classes, attn_impl=attn_impl,
         remat=remat, remat_policy=remat_policy,
