@@ -186,6 +186,11 @@ class StandbyPool:
         self.dir.mkdir(parents=True, exist_ok=True)
         self.log_dir = Path(state_dir) / "logs"
         self.log_dir.mkdir(parents=True, exist_ok=True)
+        # Crash-loop backoff: a standby that dies before ever reaching
+        # READY (broken env, jax ImportError) must not re-pay a full
+        # interpreter+jax import every sync pass forever.
+        self._fail_streak = 0
+        self._not_before = 0.0
         self.size = size
         self._procs: Dict[str, subprocess.Popen] = {}
         self._counter = 0
@@ -237,13 +242,47 @@ class StandbyPool:
             self.size = size
 
     def replenish(self) -> None:
-        """Reap dead standbys, top the pool back up to ``size``."""
+        """Reap dead standbys, top the pool back up to ``size``.
+
+        Crash-looping standbys back off exponentially (up to 60s between
+        spawn attempts): each reap of a standby that died before ever
+        reaching READY doubles the wait; any standby reaching READY
+        resets it. Dead standbys' log files are rotated into ONE
+        ``standby-last-failure.log`` (nonzero exits) or deleted (clean
+        exits) — a long-lived daemon must not grow logs/ unboundedly.
+        """
         with self._lock:
             for sid, proc in list(self._procs.items()):
                 if proc.poll() is not None:
                     self._procs.pop(sid)
+                    was_ready = (self.dir / f"{sid}.ready").exists()
                     for f in self._files(sid):
                         f.unlink(missing_ok=True)
+                    log = self.log_dir / f"standby-{sid}.log"
+                    if proc.returncode != 0:
+                        # Keep exactly one failure log for diagnosis.
+                        try:
+                            log.replace(self.log_dir / "standby-last-failure.log")
+                        except OSError:
+                            log.unlink(missing_ok=True)
+                    else:
+                        log.unlink(missing_ok=True)
+                    if not was_ready:
+                        self._fail_streak += 1
+                        delay = min(60.0, 2.0 ** min(self._fail_streak, 6))
+                        self._not_before = time.time() + delay
+                        print(
+                            f"[standby] {sid} died (exit {proc.returncode}) "
+                            f"before READY — backing off {delay:.0f}s "
+                            f"(see logs/standby-last-failure.log)",
+                            file=sys.stderr,
+                        )
+            if any(
+                (self.dir / f"{sid}.ready").exists() for sid in self._procs
+            ):
+                self._fail_streak = 0
+            if time.time() < self._not_before:
+                return
             # Bounded: a persistent spawn failure (fork limit, ENOMEM)
             # must not busy-loop under the pool lock — try once per
             # missing slot, retry on the next sync pass.
@@ -286,9 +325,12 @@ class StandbyPool:
         while time.time() < deadline:
             if claimed.exists():
                 claimed.unlink(missing_ok=True)
-                # The sid leaves the pool here: drop its ready marker so
-                # a long-lived daemon doesn't leak one file per warm job.
+                # The sid leaves the pool here: drop its ready marker AND
+                # its pre-handoff log (output goes to the replica's own
+                # log from the claim's dup2 onward) so a long-lived
+                # daemon doesn't leak files per warm job.
                 (self.dir / f"{sid}.ready").unlink(missing_ok=True)
+                (self.log_dir / f"standby-{sid}.log").unlink(missing_ok=True)
                 return True
             if proc.poll() is not None:
                 break
@@ -305,6 +347,7 @@ class StandbyPool:
                 pass
         for f in self._files(sid):
             f.unlink(missing_ok=True)
+        (self.log_dir / f"standby-{sid}.log").unlink(missing_ok=True)
 
     def shutdown(self) -> None:
         """Kill every idle standby (assigned ones became job replicas and

@@ -206,10 +206,17 @@ def make_hybrid_mesh(
     axis_names = tuple(dcn_axes) + tuple(ici_axes)  # dcn outermost
     shape = tuple(dcn_axes.values()) + tuple(ici_axes.values())
     slice_ids = {getattr(d, "slice_index", None) for d in devices}
-    if None not in slice_ids and len(slice_ids) > 1:
-        # Real multi-slice topology: the dcn spec must match it exactly —
-        # a mismatched reshape would silently put ici axes across slice
-        # boundaries (fsdp/tp collectives riding DCN).
+    is_tpu = getattr(devices[0], "platform", "") == "tpu"
+    if None not in slice_ids and (len(slice_ids) > 1 or is_tpu):
+        # Real TPU topology: the dcn spec must match the slice count
+        # exactly — a mismatched reshape would silently put ici axes
+        # across slice boundaries (fsdp/tp collectives riding DCN), and
+        # a multi-slice dcn spec on a single-slice reservation would
+        # fabricate a phantom dcn axis inside the slice. CPU/test
+        # devices also report slice_index=0, but there the ids carry no
+        # topology information — the platform check keeps the loud
+        # error on hardware without breaking forced-CPU multi-host
+        # worlds (the reshape below is correct for those).
         if len(slice_ids) != n_slices:
             raise ValueError(
                 f"dcn spec {dcn_axes} wants {n_slices} slices but the "

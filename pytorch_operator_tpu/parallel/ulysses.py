@@ -77,9 +77,12 @@ def ulysses_self_attention(
     Mirrors ``ring_self_attention``'s contract: global arrays in/out,
     shard_map manual over ``axis_name`` ONLY (batch/head dims stay
     compiler-managed so dp/fsdp/tp sharding composes). Falls back to the
-    single-shard path when the axis is absent/size-1 or the shapes don't
-    divide (S % P, K % P) — same one-code-path promise as ring's
-    degenerate handling.
+    single-shard path when the axis is absent/size-1 or the RUNTIME
+    shape doesn't divide (S % P) — same one-code-path promise as ring's
+    degenerate handling. A kv-head count that doesn't divide the sp
+    extent is a STATIC config error and raises: silently running dense
+    full-S attention at the long contexts ulysses exists for would lose
+    the entire memory/perf win while the operator believes sp is active.
     """
     import functools
 
@@ -89,7 +92,14 @@ def ulysses_self_attention(
     from .ring import _single_shard
 
     n = mesh.shape.get(axis_name, 1) if axis_name in mesh.axis_names else 1
-    if n == 1 or q.shape[1] % n or q.shape[2] % n:
+    if n > 1 and q.shape[2] % n:
+        raise ValueError(
+            f"attn_impl='ulysses' needs n_kv_heads % {axis_name} == 0 "
+            f"(kv heads are the resharding currency): got "
+            f"{q.shape[2]} kv heads, {axis_name}={n}. Use a config with "
+            f"divisible kv heads, a smaller {axis_name}, or attn_impl='ring'."
+        )
+    if n == 1 or q.shape[1] % n:
         return _single_shard(q, k, v, positions, causal=causal)
 
     body = functools.partial(

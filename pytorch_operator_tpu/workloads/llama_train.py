@@ -115,9 +115,11 @@ def run(
     if moe_aux_weight is not None:
         over["moe_aux_weight"] = moe_aux_weight
     cfg = getattr(llama_lib, CONFIGS[config])(**over)
-    if remat_policy is not None and not cfg.remat:
+    if remat_policy not in (None, "full") and not cfg.remat:
         # Silently measuring the no-remat path while the user believes
-        # the selective policy is active is a benchmarking trap.
+        # the selective policy is active is a benchmarking trap ('full'
+        # is the inert default, so passing it without --remat measures
+        # exactly what it says and is allowed — vit_bench agrees).
         raise ValueError(
             f"--remat-policy {remat_policy} has no effect without --remat"
         )
@@ -178,8 +180,9 @@ def run(
                 f"{batch // grad_accum} is not divisible by the data-"
                 f"parallel extent {data_extent} — XLA will replicate "
                 f"activations across the batch axes (SPMD 'involuntary "
-                f"full rematerialization'). Use batch >= grad_accum * "
-                f"{data_extent}."
+                f"full rematerialization'). Make batch/grad_accum a "
+                f"multiple of {data_extent} (e.g. batch="
+                f"{grad_accum * data_extent * max(1, batch // (grad_accum * data_extent))})."
             )
 
     # Optimizer via the shared recipe helper. Cosine horizon default:

@@ -82,6 +82,63 @@ class TestStandbyPool:
         finally:
             pool.shutdown()
 
+    def test_crash_looping_standby_backs_off_and_rotates_one_failure_log(
+        self, tmp_path, monkeypatch
+    ):
+        """A standby that dies before READY must not respawn every pass
+        (exponential backoff) nor grow logs/ unboundedly (one rotated
+        standby-last-failure.log, per-sid logs removed)."""
+        import subprocess
+        import sys as _sys
+        import time as _time
+
+        def dying_spawn(self):
+            sid = f"s{os.getpid()}-{self._counter}"
+            self._counter += 1
+            log_f = open(self.log_dir / f"standby-{sid}.log", "ab")
+            proc = subprocess.Popen(
+                [_sys.executable, "-c",
+                 "import sys; sys.stderr.write('boom'); sys.exit(3)"],
+                stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+            log_f.close()
+            self._procs[sid] = proc
+            return True
+
+        monkeypatch.setattr(StandbyPool, "_spawn_one", dying_spawn)
+        pool = StandbyPool(tmp_path, size=1)
+        try:
+            pool.replenish()  # spawns the dying standby
+            (sid, proc), = list(pool._procs.items())
+            assert wait_for(lambda: proc.poll() is not None)
+            pool.replenish()  # reaps -> backoff engaged
+            assert pool._fail_streak == 1
+            assert pool._not_before > _time.time()
+            assert not (pool.log_dir / f"standby-{sid}.log").exists()
+            assert (pool.log_dir / "standby-last-failure.log").exists()
+            assert "boom" in (
+                pool.log_dir / "standby-last-failure.log"
+            ).read_text()
+            # Backoff holds: no fresh spawn while _not_before is ahead.
+            assert pool._procs == {}
+            # ...and expires: clearing the gate spawns again.
+            pool._not_before = 0.0
+            pool.replenish()
+            assert len(pool._procs) == 1
+        finally:
+            pool.shutdown()
+
+    def test_no_log_files_leak_across_lifecycle(self, tmp_path):
+        """Clean kills (shutdown) remove per-standby logs."""
+        pool = StandbyPool(tmp_path, size=1)
+        try:
+            pool.replenish()
+            assert wait_for(lambda: pool.ready_count() == 1)
+        finally:
+            pool.shutdown()
+        assert list(pool.log_dir.glob("standby-*.log")) == []
+
     def test_assign_to_dead_standby_returns_false(self, tmp_path):
         pool = StandbyPool(tmp_path, size=1)
         pool.replenish()

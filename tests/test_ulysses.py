@@ -51,17 +51,24 @@ def test_ulysses_matches_ring():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
-@pytest.mark.parametrize(
-    "kwargs", [dict(S=30), dict(K=2)], ids=["seq-indivisible", "heads-indivisible"]
-)
-def test_ulysses_falls_back_when_shapes_dont_divide(kwargs):
-    """S % sp != 0 (can't shard the stream) or K % sp != 0 (heads are the
-    resharding currency) must take the single-shard path, not raise."""
-    q, k, v, pos = _qkv(**kwargs)
+def test_ulysses_falls_back_when_seq_indivisible():
+    """S % sp != 0 is a runtime-shape condition (ragged last batch):
+    take the single-shard path, not raise."""
+    q, k, v, pos = _qkv(S=30)
     mesh = make_mesh("sp=4", devices=jax.devices()[:4])
     out = ulysses_self_attention(q, k, v, pos, mesh)
     ref = _single_shard(q, k, v, pos, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_indivisible_kv_heads_raise():
+    """K % sp != 0 is a STATIC config error: a silent dense fallback at
+    the long contexts ulysses exists for would lose the whole win while
+    the operator believes sp is active."""
+    q, k, v, pos = _qkv(K=2)
+    mesh = make_mesh("sp=4", devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        ulysses_self_attention(q, k, v, pos, mesh)
 
 
 def test_ulysses_degenerate_mesh_no_sp_axis():
