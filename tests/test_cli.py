@@ -27,6 +27,33 @@ def run_cli(*argv) -> int:
     return main([str(a) for a in argv])
 
 
+def _flip_then_interrupt(state, mutate, delay=1.2):
+    """Mutate the persisted cli-job from a daemon thread, then interrupt
+    the main thread (the user's Ctrl-C on a watch). The interrupt fires
+    even if the mutation fails — otherwise a broken flip would hang the
+    watch loop (and the suite) forever."""
+    import _thread
+    import threading
+    import time as _time
+
+    from pytorch_operator_tpu.controller.store import JobStore
+
+    def run():
+        try:
+            _time.sleep(delay)
+            store = JobStore(persist_dir=state / "jobs")
+            job = store.reload("default/cli-job")
+            mutate(job)
+            store.update(job)
+        finally:
+            _time.sleep(delay)
+            _thread.interrupt_main()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
 class TestCLI:
     def test_get_describe_json_output(self, tmp_path, job_yaml, capsys):
         """kubectl -o json analog: parseable full objects round-trip."""
@@ -59,28 +86,11 @@ class TestCLI:
         capsys.readouterr()
 
         import pytorch_operator_tpu.client.cli as cli
-        from pytorch_operator_tpu.controller.store import JobStore
 
-        # Flip the persisted job's state from another thread mid-watch,
-        # then interrupt the watcher the way a user would (KeyboardInterrupt).
-        main_thread_id = threading.get_ident()
-
-        def flip_and_stop():
-            _time.sleep(1.2)
-            store = JobStore(persist_dir=state / "jobs")
-            job = store.reload("default/cli-job")
+        def bump(job):
             job.status.restart_count = 7
-            store.update(job)
-            _time.sleep(1.2)
-            import ctypes
 
-            ctypes.pythonapi.PyThreadState_SetAsyncExc(
-                ctypes.c_long(main_thread_id),
-                ctypes.py_object(KeyboardInterrupt),
-            )
-
-        t = threading.Thread(target=flip_and_stop, daemon=True)
-        t.start()
+        t = _flip_then_interrupt(state, bump)
         rc = cli.main(["--state-dir", str(state), "get", "--watch"])
         t.join(5)
         out = capsys.readouterr().out
@@ -96,6 +106,45 @@ class TestCLI:
         final = out.split("---")[-1].strip().splitlines()
         header, row = final[0].split(), final[1].split()
         assert row[header.index("RESTARTS")] == "7", out
+
+    def test_get_watch_json_streams_bare_snapshots(self, tmp_path, job_yaml, capsys):
+        """kubectl -w -o json analog: no '---' separators in the JSON
+        stream, and each snapshot is parseable."""
+        import json as _json
+        import threading
+        import time as _time
+
+        state = tmp_path / "state"
+        assert run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30") == 0
+        capsys.readouterr()
+
+        import pytorch_operator_tpu.client.cli as cli
+
+        def bump(job):
+            job.status.restart_count = 5
+
+        t = _flip_then_interrupt(state, bump)
+        rc = cli.main(["--state-dir", str(state), "get", "--watch", "--json"])
+        t.join(5)
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "---" not in out
+        # First snapshot parses on its own (stream of bare arrays).
+        first = out[: out.index("\n]") + 2]
+        jobs = _json.loads(first)
+        assert jobs[0]["metadata"]["name"] == "cli-job"
+        # The flipped state reached the stream.
+        assert '"restart_count": 5' in out
+
+    def test_manifests_subcommand_checks_and_generates(self, tmp_path, capsys):
+        assert run_cli("manifests", "--out-dir", tmp_path / "m") == 0
+        capsys.readouterr()
+        assert run_cli("manifests", "--out-dir", tmp_path / "m", "--check") == 0
+        assert "up to date" in capsys.readouterr().out
+        # ...and the stale path actually fires (non-tautological check).
+        (tmp_path / "m" / "base" / "crd.yaml").write_text("tampered")
+        assert run_cli("manifests", "--out-dir", tmp_path / "m", "--check") == 1
+        assert "stale" in capsys.readouterr().out
 
     def test_run_get_describe_logs(self, tmp_path, job_yaml, capsys):
         state = tmp_path / "state"
