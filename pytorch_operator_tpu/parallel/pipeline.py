@@ -170,6 +170,8 @@ def pipeline_value_and_grad(
     microbatches: int,
     axis: str = "pp",
     schedule: str = "1f1b",
+    sharded_loss: bool = False,
+    backward: str = "recompute",
 ):
     """Fused pipelined train-step gradients: returns
     ``(loss, (d_stage_params, d_loss_params, dx))`` for
@@ -201,10 +203,50 @@ def pipeline_value_and_grad(
 
     ``loss_fn(loss_params, y_mb, target_mb) -> scalar`` (mean over the
     microbatch); its gradients are accumulated at the last stage and
-    psum-replicated out. Note the loss body is computed per-stage inside
-    the manual-pp region (masked to the last stage's result), so its
-    FLOPs duplicate P-fold over pp — keep loss_fn to the cheap tail
-    (norm + head + xent), which is a sliver of the stack.
+    psum-replicated out. With ``sharded_loss=False`` the loss body is
+    computed per-stage inside the manual-pp region (masked to the last
+    stage's result), so its FLOPs duplicate P-fold over pp — fine ONLY
+    when loss_fn is a genuinely cheap tail. For an LM tail (head matmul
+    over a large vocab + xent) that duplication is a cliff: use
+    ``sharded_loss=True``.
+
+    ``sharded_loss=True`` partitions the loss itself over the pp axis
+    (the round-4 fix for the P-fold duplication): ``loss_params`` leaves
+    must carry a leading axis P (stage s owns slice s — e.g. a vocab-
+    chunked LM head ``[P, d, V/P]``; replicate tiny leaves by stacking P
+    copies), and ``loss_fn(lp_slice, y_mb, target_mb)`` runs SPMD on
+    EVERY stage each tick over the LAST stage's finished microbatch
+    (broadcast to all stages by one masked O(mb) psum). loss_fn must
+    combine its per-chunk partials with collectives over ``axis`` (psum
+    / pmax — e.g. the standard vocab-parallel log-sum-exp) and return
+    the combined scalar, identical on every stage (pp-invariant; the
+    vma checker rejects a loss_fn that forgets to combine). Total loss
+    FLOPs drop from P× to (M+2P-2)/M ≈ 1× and the work is load-balanced
+    across stages instead of riding the last one. Returned
+    ``d_loss_params`` then also carries the leading P axis: chunked
+    leaves get their own chunk's gradient; stacked-replicated leaves
+    must be summed over the leading axis by the caller (the total
+    gradient of a shared leaf is the sum of its per-stage partials).
+
+    ``backward`` (1f1b only) picks what the per-stage ring buffer holds:
+
+    - ``"recompute"`` (default, always correct): save each in-flight
+      microbatch's stage INPUT and re-run the stage forward during its
+      backward tick — full-remat 1F1B. Minimal memory, but one extra
+      stage forward per microbatch versus GPipe (which reuses the
+      forward pass's saved residuals).
+    - ``"stored"`` (Megatron-style compute parity): save the stage
+      forward's VJP RESIDUALS (``jax.vjp``'s function pytree — honoring
+      any ``jax.checkpoint`` policy inside ``fn``) for in-flight
+      microbatches, so backward reuses them — no recompute, FLOPs equal
+      GPipe's per application, residency still O(P) microbatches.
+      Residual leaves whose shapes do NOT change with the microbatch
+      size (weights, casted weights, position tables) are taken from the
+      current tick's forward instead of the ring — they are assumed
+      input-independent. That assumption is a shape heuristic: a ``fn``
+      whose residuals depend on input VALUES but not input SHAPES (no
+      transformer block does this; a batch-mean would) must use
+      ``"recompute"``.
 
     Like :func:`pipeline_apply`: pure, call under your own ``jit``;
     only ``axis`` is taken manual, other mesh axes stay with the
@@ -214,6 +256,22 @@ def pipeline_value_and_grad(
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
+
+    if backward not in ("recompute", "stored"):
+        raise ValueError(
+            f"backward={backward!r} not in ('recompute', 'stored')"
+        )
+
+    def _check_loss_chunks(lp_tree, n):
+        lead = {
+            leaf.shape[0] if leaf.ndim else None
+            for leaf in jax.tree.leaves(lp_tree)
+        }
+        if lead != {n}:
+            raise ValueError(
+                f"sharded_loss=True: loss_params leading axes {lead} != "
+                f"pp extent {n} (every leaf must be stage-chunked)"
+            )
 
     if schedule == "gpipe":
 
@@ -226,12 +284,39 @@ def pipeline_value_and_grad(
                 (microbatches, targets.shape[0] // microbatches)
                 + targets.shape[1:]
             )
+            if sharded_loss:
+                # Same contract as the 1f1b sharded path: lp is stage-
+                # chunked and loss_fn combines over ``axis`` internally,
+                # so it must run inside a manual-pp region. Each stage
+                # gathers the full microbatch stream (the pipeline
+                # output is pp-sharded over microbatch groups) and
+                # computes its chunk for every microbatch.
+                def per_stage_loss(lp_local, ym_local, tm_local):
+                    lp_local = jax.tree.map(lambda l: l[0], lp_local)
+                    y_all = jax.lax.all_gather(ym_local, axis, axis=0, tiled=True)
+                    t_all = jax.lax.all_gather(tm_local, axis, axis=0, tiled=True)
+                    return jnp.mean(
+                        jax.vmap(lambda a, b: loss_fn(lp_local, a, b))(
+                            y_all, t_all
+                        )
+                    )
+
+                lspec = jax.tree.map(lambda _: P(axis), lp)
+                return shard_map(
+                    per_stage_loss,
+                    mesh=mesh,
+                    in_specs=(lspec, P(axis), P(axis)),
+                    out_specs=P(),
+                    axis_names={axis},
+                )(lp, ym, tm)
 
             def one(j):
                 return loss_fn(lp, ym[j], tm[j])
 
             return jnp.mean(jax.vmap(one)(jnp.arange(microbatches)))
 
+        if sharded_loss:
+            _check_loss_chunks(loss_params, mesh.shape[axis])
         loss, grads = jax.value_and_grad(total_loss, argnums=(0, 1, 2))(
             stage_params, loss_params, x
         )
@@ -258,7 +343,11 @@ def pipeline_value_and_grad(
         )
 
     param_spec = jax.tree.map(lambda _: P(axis), stage_params)
-    loss_spec = jax.tree.map(lambda _: P(), loss_params)
+    if sharded_loss:
+        _check_loss_chunks(loss_params, n_stages)
+    loss_spec = jax.tree.map(
+        lambda _: P(axis) if sharded_loss else P(), loss_params
+    )
     mb_per_dev = M // n_stages
     D = 2 * n_stages  # saved-input ring depth: covers the 2(P-1)+1 window
     xm = x.reshape((M, B // M) + x.shape[1:])
@@ -275,17 +364,53 @@ def pipeline_value_and_grad(
                 return v
             return jax.lax.pcast(v, (axis,), to="varying")
 
-        # CRITICAL: lp arrives pp-INVARIANT (replicated in_spec), and
-        # jax.vjp inside a manual region inserts an automatic psum on the
-        # cotangent of an invariant primal — which would sum every
-        # stage's dlp (including the P-1 stages' garbage contributions)
-        # BEFORE the at_last mask can drop them. pcast to varying so the
-        # loss vjp stays stage-local; the masked accumulate + final psum
-        # then count exactly the last stage's real contributions.
-        lp = jax.tree.map(_varying, lp)
+        if sharded_loss:
+            # Stage-chunked loss params: drop the leading slice axis like
+            # stage params. Already pp-varying (sharded in_spec).
+            lp = jax.tree.map(lambda l: l[0], lp)
+        else:
+            # CRITICAL: lp arrives pp-INVARIANT (replicated in_spec), and
+            # jax.vjp inside a manual region inserts an automatic psum on
+            # the cotangent of an invariant primal — which would sum every
+            # stage's dlp (including the P-1 stages' garbage contributions)
+            # BEFORE the at_last mask can drop them. pcast to varying so
+            # the loss vjp stays stage-local; the masked accumulate +
+            # final psum then count exactly the last stage's real
+            # contributions.
+            lp = jax.tree.map(_varying, lp)
+
+        if backward == "stored":
+            # Trace two throwaway vjps (different microbatch widths) to
+            # learn the residual pytree's treedef and which leaves are
+            # input-shape-dependent (must ride the ring) versus
+            # input-independent (weights/tables — taken fresh each tick).
+            # Their outputs feed nothing but zeros_like, so XLA DCEs the
+            # phantom forwards.
+            _, _vjp0 = jax.vjp(fn, params_local, _varying(zero_mb))
+            _, _vjp2 = jax.vjp(
+                fn,
+                params_local,
+                _varying(
+                    jnp.zeros(
+                        (2 * zero_mb.shape[0],) + zero_mb.shape[1:],
+                        zero_mb.dtype,
+                    )
+                ),
+            )
+            res_leaves0 = jax.tree.leaves(_vjp0)
+            res_leaves2 = jax.tree.leaves(_vjp2)
+            if len(res_leaves0) != len(res_leaves2):
+                raise ValueError(
+                    "backward='stored': fn's vjp residual structure "
+                    "depends on the microbatch size — use 'recompute'"
+                )
+            ring_stored = tuple(
+                a.shape != b.shape
+                for a, b in zip(res_leaves0, res_leaves2)
+            )
 
         def tick(carry, t):
-            act_in, cot_in, inbuf, dp_acc, dlp_acc, loss_acc, dx_local = carry
+            act_in, cot_in, bufs, dp_acc, dlp_acc, loss_acc, dx_local = carry
 
             # ---- forward half (the GPipe wavefront) ----
             t_in = jnp.clip(t, 0, M - 1)
@@ -300,15 +425,57 @@ def pipeline_value_and_grad(
             inp = jnp.where(s == 0, mb, act_in)
             jf = t - s  # the microbatch this stage forwards this tick
             f_valid = (jf >= 0) & (jf < M)
-            # Save the stage INPUT for the backward recompute — the ONLY
-            # per-microbatch state 1F1B keeps (ring slot jf mod D; the
-            # slot is free again after 2P ticks > the in-flight window).
+            # Ring slot jf mod D; the slot is free again after 2P ticks >
+            # the in-flight window.
             slot_f = jnp.clip(jf, 0, M - 1) % D
-            cur = jax.lax.dynamic_index_in_dim(inbuf, slot_f, 0, keepdims=False)
-            inbuf = jax.lax.dynamic_update_index_in_dim(
-                inbuf, jnp.where(f_valid, inp, cur), slot_f, 0
-            )
-            y = fn(params_local, inp)
+            if backward == "stored":
+                # ONE forward produces the wavefront output AND the
+                # backward residuals (jax.vjp's function IS a pytree);
+                # shape-varying residual leaves ride the ring.
+                y, f_vjp = jax.vjp(fn, params_local, inp)
+                # The treedef embeds backward jaxprs (identity-compared),
+                # so canary-vs-live treedefs never compare equal; leaf
+                # ORDER is what must line up, and tracing the same fn at
+                # the same avals is deterministic. Guard on the leaf
+                # shapes; unflatten with THIS tick's treedef.
+                cur_leaves, vjp_treedef = jax.tree.flatten(f_vjp)
+                if [l.shape for l in cur_leaves] != [
+                    l.shape for l in res_leaves0
+                ]:
+                    raise ValueError(
+                        "backward='stored': vjp residual shapes changed "
+                        "between traces — use 'recompute'"
+                    )
+                # bufs holds only the stored leaves, in leaf order.
+                new_bufs = []
+                bi = 0
+                for leaf, st in zip(cur_leaves, ring_stored):
+                    if not st:
+                        continue
+                    buf = bufs[bi]
+                    bi += 1
+                    prev = jax.lax.dynamic_index_in_dim(
+                        buf, slot_f, 0, keepdims=False
+                    )
+                    new_bufs.append(
+                        jax.lax.dynamic_update_index_in_dim(
+                            buf, jnp.where(f_valid, leaf, prev), slot_f, 0
+                        )
+                    )
+                bufs = tuple(new_bufs)
+            else:
+                # Save the stage INPUT for the backward recompute — the
+                # only per-microbatch state full-remat 1F1B keeps.
+                (inbuf,) = bufs
+                prev = jax.lax.dynamic_index_in_dim(
+                    inbuf, slot_f, 0, keepdims=False
+                )
+                bufs = (
+                    jax.lax.dynamic_update_index_in_dim(
+                        inbuf, jnp.where(f_valid, inp, prev), slot_f, 0
+                    ),
+                )
+                y = fn(params_local, inp)
             act_next = jax.lax.ppermute(
                 y, axis, [(i, (i + 1) % n_stages) for i in range(n_stages)]
             )
@@ -323,24 +490,70 @@ def pipeline_value_and_grad(
                 jnp.zeros_like(tm_local[0]),
             )
             tgt = jax.lax.psum(tfeed, axis)
-            lval, loss_vjp = jax.vjp(lambda l, yy: loss_fn(l, yy, tgt), lp, y)
-            dlp, dy = loss_vjp(jnp.ones_like(lval))
-            at_last = (s == last) & (t - last >= 0) & (t - last < M)
-            loss_acc = loss_acc + jnp.where(at_last, lval, 0.0)
-            dlp_acc = jax.tree.map(
-                lambda a, g: a + jnp.where(at_last, g, jnp.zeros_like(g)),
-                dlp_acc,
-                dlp,
-            )
+            if sharded_loss:
+                # Vocab-parallel-style tail: broadcast the last stage's
+                # finished microbatch to every stage (one masked O(mb)
+                # psum) and run the CHUNKED loss on all stages — loss_fn
+                # combines partials over ``axis`` internally. The mask is
+                # tick-validity only (uniform across stages): every
+                # stage's dlp chunk is real work, accumulated locally.
+                def _lw(l, yy):
+                    y_b = jax.lax.psum(
+                        jnp.where(s == last, yy, jnp.zeros_like(yy)), axis
+                    )
+                    return loss_fn(l, y_b, tgt)
+
+                lval, loss_vjp = jax.vjp(_lw, lp, y)
+                dlp, dy = loss_vjp(jnp.ones_like(lval))
+                tick_valid = (t - last >= 0) & (t - last < M)
+                loss_acc = loss_acc + jnp.where(tick_valid, lval, 0.0)
+                dlp_acc = jax.tree.map(
+                    lambda a, g: a
+                    + jnp.where(tick_valid, g, jnp.zeros_like(g)),
+                    dlp_acc,
+                    dlp,
+                )
+            else:
+                lval, loss_vjp = jax.vjp(
+                    lambda l, yy: loss_fn(l, yy, tgt), lp, y
+                )
+                dlp, dy = loss_vjp(jnp.ones_like(lval))
+                at_last = (s == last) & (t - last >= 0) & (t - last < M)
+                loss_acc = loss_acc + jnp.where(at_last, lval, 0.0)
+                dlp_acc = jax.tree.map(
+                    lambda a, g: a + jnp.where(at_last, g, jnp.zeros_like(g)),
+                    dlp_acc,
+                    dlp,
+                )
 
             # ---- backward half (1F1B: starts while forwards still run) ----
             jb = t - 2 * last + s  # the microbatch this stage backwards
             b_valid = (jb >= 0) & (jb < M)
             cot = jnp.where(s == last, dy, cot_in)
             slot_b = jnp.clip(jb, 0, M - 1) % D
-            saved = jax.lax.dynamic_index_in_dim(inbuf, slot_b, 0, keepdims=False)
-            _, stage_vjp = jax.vjp(fn, params_local, saved)
-            dparams, dx = stage_vjp(cot)
+            if backward == "stored":
+                # Rebuild mb jb's vjp from its ringed residuals; input-
+                # independent leaves come from this tick's forward.
+                merged = []
+                bi = 0
+                for leaf, st in zip(cur_leaves, ring_stored):
+                    if st:
+                        merged.append(
+                            jax.lax.dynamic_index_in_dim(
+                                bufs[bi], slot_b, 0, keepdims=False
+                            )
+                        )
+                        bi += 1
+                    else:
+                        merged.append(leaf)
+                stage_vjp = jax.tree.unflatten(vjp_treedef, merged)
+                dparams, dx = stage_vjp(cot)
+            else:
+                saved = jax.lax.dynamic_index_in_dim(
+                    bufs[0], slot_b, 0, keepdims=False
+                )
+                _, stage_vjp = jax.vjp(fn, params_local, saved)
+                dparams, dx = stage_vjp(cot)
             dp_acc = jax.tree.map(
                 lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)),
                 dp_acc,
@@ -369,34 +582,57 @@ def pipeline_value_and_grad(
                 dx, axis, [(i, (i - 1) % n_stages) for i in range(n_stages)]
             )
             return (
-                act_next, cot_next, inbuf, dp_acc, dlp_acc, loss_acc, dx_local
+                act_next, cot_next, bufs, dp_acc, dlp_acc, loss_acc, dx_local
             ), None
 
         # Freshly-constructed zeros start axis-invariant, but every carry
         # leaf becomes pp-varying inside the tick (stage-index masks) —
         # pcast the whole init so the scan carry types are stable. Leaves
         # already varying (derived from sharded params/inputs) must pass
-        # through untouched — pcast rejects varying->varying.
-        init = jax.tree.map(
+        # through untouched — pcast rejects varying->varying. Exception:
+        # under sharded_loss the loss accumulator stays pp-INVARIANT
+        # (loss_fn returns the collective-combined scalar and the
+        # validity mask is uniform), so it must not be pcast.
+        loss0 = jnp.zeros((), jnp.float32)
+        if not sharded_loss:
+            loss0 = _varying(loss0)
+        if backward == "stored":
+            rings0 = tuple(
+                jnp.zeros((D,) + leaf.shape, leaf.dtype)
+                for leaf, st in zip(res_leaves0, ring_stored)
+                if st
+            )
+        else:
+            rings0 = (jnp.zeros((D,) + zero_mb.shape, zero_mb.dtype),)
+        act0, cot0, buf0, dp0, dlp0, dx0 = jax.tree.map(
             _varying,
             (
                 zero_mb,
                 zero_mb,
-                jnp.zeros((D,) + zero_mb.shape, zero_mb.dtype),
+                rings0,
                 jax.tree.map(jnp.zeros_like, params_local),
                 jax.tree.map(jnp.zeros_like, lp),
-                jnp.zeros((), jnp.float32),
                 jnp.zeros_like(xm_local),
             ),
         )
+        init = (act0, cot0, buf0, dp0, dlp0, loss0, dx0)
         (_, _, _, dp_acc, dlp_acc, loss_acc, dx_local), _ = jax.lax.scan(
             tick, init, jnp.arange(M + 2 * last)
         )
-        # Mean over microbatches; loss/dlp live only on the last stage,
-        # psum replicates them (making the replicated out_specs valid).
-        loss_out = jax.lax.psum(loss_acc, axis) / M
-        dlp_out = jax.tree.map(lambda a: jax.lax.psum(a, axis) / M, dlp_acc)
         dp_out = jax.tree.map(lambda a: a[None] / M, dp_acc)
+        if sharded_loss:
+            # Loss is already combined + invariant; dlp chunks stay
+            # stage-local with the leading slice axis restored.
+            loss_out = loss_acc / M
+            dlp_out = jax.tree.map(lambda a: a[None] / M, dlp_acc)
+        else:
+            # Mean over microbatches; loss/dlp live only on the last
+            # stage, psum replicates them (making the replicated
+            # out_specs valid).
+            loss_out = jax.lax.psum(loss_acc, axis) / M
+            dlp_out = jax.tree.map(
+                lambda a: jax.lax.psum(a, axis) / M, dlp_acc
+            )
         return loss_out, dp_out, dlp_out, dx_local / M
 
     loss, d_stage, d_loss, dxm = shard_map(

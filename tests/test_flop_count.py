@@ -1,0 +1,164 @@
+"""ops.flop_count: the jaxpr-walking semantic FLOP counter.
+
+Exists because XLA cost_analysis and jax.experimental.roofline count a
+scan body ONCE (verified on this install), so neither can compare
+pipelined programs whose compute lives inside the schedule scan.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import tests.jaxenv  # noqa: F401
+from pytorch_operator_tpu.ops.flop_count import count_flops
+
+
+class TestFlopCount:
+    def test_dot_general(self):
+        import jax.numpy as jnp
+
+        fc = count_flops(lambda a, b: a @ b, jnp.zeros((8, 16)), jnp.zeros((16, 4)))
+        assert fc.by_primitive["dot_general"] == 2 * 8 * 4 * 16
+
+    def test_scan_multiplies_by_length(self):
+        import jax
+        import jax.numpy as jnp
+
+        w = jnp.zeros((16, 16))
+
+        def f(x):
+            def body(c, _):
+                return jnp.tanh(c @ w), None
+
+            y, _ = jax.lax.scan(body, x, None, length=10)
+            return y
+
+        fc = count_flops(f, jnp.zeros((4, 16)))
+        assert fc.by_primitive["dot_general"] == 10 * 2 * 4 * 16 * 16
+
+    def test_shard_map_multiplies_by_manual_devices(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from pytorch_operator_tpu.parallel import make_mesh
+
+        mesh = make_mesh("pp=4", devices=jax.devices()[:4])
+
+        def f(w, x):
+            def body(wl, xl):
+                return jax.lax.psum(xl @ wl[0], "pp")
+
+            return shard_map(
+                body, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+                axis_names={"pp"},
+            )(w, x)
+
+        fc = count_flops(f, jnp.zeros((4, 16, 8)), jnp.zeros((2, 16)))
+        # Each of the 4 manual devices runs one [2,16]@[16,8] matmul.
+        assert fc.by_primitive["dot_general"] == 4 * 2 * 2 * 8 * 16
+        # Collectives are communication, not FLOPs.
+        assert "psum" not in fc.by_primitive
+
+    def test_cond_takes_max_branch(self):
+        import jax
+        import jax.numpy as jnp
+
+        w = jnp.zeros((16, 16))
+
+        def f(x):
+            return jax.lax.cond(
+                x.sum() > 0, lambda a: (a @ w).sum(), lambda a: a.sum(), x
+            )
+
+        fc = count_flops(f, jnp.ones((4, 16)))
+        assert fc.by_primitive["dot_general"] == 2 * 4 * 16 * 16
+
+    def test_remat_backward_counts_recompute(self):
+        """grad of a checkpointed fn recomputes the forward: the counted
+        dot FLOPs must be fwd + recompute + 2x bwd = 4 matmul units (vs 3
+        without remat)."""
+        import jax
+        import jax.numpy as jnp
+
+        unit = 2 * 4 * 16 * 16
+
+        def mk(remat):
+            def f(w, x):
+                g = lambda a: jnp.tanh(a @ w).sum()  # noqa: E731
+                if remat:
+                    g = jax.checkpoint(g)
+                return g(x)
+
+            return jax.grad(f, argnums=(0, 1))
+
+        args = (jnp.zeros((16, 16)), jnp.zeros((4, 16)))
+        no_remat = count_flops(mk(False), *args).by_primitive["dot_general"]
+        with_remat = count_flops(mk(True), *args).by_primitive["dot_general"]
+        assert no_remat == 3 * unit
+        assert with_remat == 4 * unit
+
+
+class TestPipelineFlopParity:
+    """THE round-4 guard (VERDICT Missing #2 / Next #1): the 1F1B llama
+    step's TOTAL semantic FLOPs must sit within ~1.1x of both the GPipe
+    step and the unpipelined reference on the same fat-head config.
+    Before the vocab-parallel loss tail + stored-residual backward, this
+    ratio was ~2.4x at 0.3b head fractions (the loss tail ran P-fold and
+    the backward re-ran every stage forward)."""
+
+    @pytest.mark.slow
+    def test_1f1b_total_flops_within_1p15_of_gpipe(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pytorch_operator_tpu.models.llama import (
+            Llama, forward_pp, llama_tiny, train_value_and_grad_pp,
+        )
+        from pytorch_operator_tpu.parallel import make_mesh
+
+        # Fat head on purpose: vocab-dominant dims make loss-tail
+        # duplication show up at full strength (head ~= half the FLOPs).
+        cfg = llama_tiny(vocab_size=4096, d_model=64, n_layers=4, remat=True)
+        model = Llama(cfg)
+        B, S, M, PP = 64, 32, 64, 4
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S)),
+            jnp.int32,
+        )
+        params = model.init(jax.random.key(0), tokens[:1])["params"]
+        mesh = make_mesh(f"pp={PP}", devices=jax.devices()[:PP])
+
+        def seq_loss(p, toks):
+            logits = model.apply({"params": p}, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], toks[:, 1:]
+            ).mean()
+
+        def gpipe_loss(p, toks):
+            logits = forward_pp(model, p, toks, mesh=mesh, microbatches=M)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], toks[:, 1:]
+            ).mean()
+
+        f_seq = count_flops(jax.value_and_grad(seq_loss), params, tokens).total
+        f_gp = count_flops(jax.value_and_grad(gpipe_loss), params, tokens).total
+        f_1f1b = count_flops(
+            lambda p, t: train_value_and_grad_pp(
+                model, p, t, mesh=mesh, microbatches=M
+            ),
+            params,
+            tokens,
+        ).total
+
+        # Analytic floor: the static schedule runs (M+2P-2)/M ticks per
+        # useful microbatch = 1.094 here; measured 1.087/1.059 at last
+        # tuning. Thresholds leave noise headroom without admitting any
+        # P-fold regression (which lands at 2.4x+).
+        assert f_1f1b <= 1.15 * f_gp, (f_1f1b / 1e9, f_gp / 1e9)
+        assert f_1f1b <= 1.20 * f_seq, (f_1f1b / 1e9, f_seq / 1e9)
+        # And GPipe itself must stay near the sequential reference.
+        assert f_gp <= 1.10 * f_seq, (f_gp / 1e9, f_seq / 1e9)

@@ -93,6 +93,53 @@ class TestLlamaPipelineParallel:
         np.testing.assert_allclose(f1_losses, seq_losses, rtol=2e-5)
         assert f1_losses[-1] < f1_losses[0]
 
+    def test_1f1b_pp1_degenerate_keeps_chunked_tail(self):
+        """pp=1 via the direct hook (the trainer refuses extent-1 pp and
+        runs the sequential step instead): no loss duplication exists, so
+        the vocab-parallel chunk (which would be the FULL vocab) must not
+        replace the chunked xent tail — and numerics must still match
+        plain autodiff."""
+        import jax
+        import optax
+
+        cfg = llama_lib.llama_tiny(
+            n_layers=4, attn_impl="dense", xent_impl="chunked"
+        )
+        tokens = _tokens()
+        mesh = make_mesh("dp=8,pp=1")
+        model = llama_lib.Llama(cfg, mesh=mesh)
+        params = model.init(jax.random.key(0), tokens[:1])["params"]
+
+        loss, grads = jax.jit(
+            lambda p, t: llama_lib.train_value_and_grad_pp(
+                model, p, t, mesh=mesh, microbatches=4
+            )
+        )(params, tokens)
+
+        def seq_loss(p, toks):
+            logits = model.apply({"params": p}, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], toks[:, 1:]
+            ).mean()
+
+        ref_loss, ref_grads = jax.value_and_grad(seq_loss)(params, tokens)
+        assert float(loss) == pytest.approx(float(ref_loss), rel=2e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            ),
+            grads,
+            ref_grads,
+        )
+
+    def test_vocab_not_divisible_by_pp_rejected(self):
+        cfg = llama_lib.llama_tiny(
+            vocab_size=254, n_layers=4, attn_impl="dense"
+        )
+        tokens = _tokens()
+        with pytest.raises(ValueError, match="vocab_size"):
+            _train(cfg, "dp=2,pp=4", tokens, steps=1, pp_schedule="1f1b")
+
     def test_bad_pp_schedule_rejected(self):
         cfg = llama_lib.llama_tiny(n_layers=4, attn_impl="dense")
         tokens = _tokens()
