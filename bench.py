@@ -264,9 +264,17 @@ def run(argv=None) -> dict:
                 bert_base=True, batch_size=64, seq_len=bert_seq_len,
                 steps=30, warmup=3, log=lambda m: log(f"[bench] {m}"),
             )
-            # 6N weight FLOPs per trained token (encoder: no causal term).
+            # 6N weight FLOPs per trained token + the encoder attention
+            # score/value term 12*L*S*d (bidirectional: NO causal halving
+            # — the llama path's lm_train_flops_per_token halves it), so
+            # the two MFU figures in this artifact use consistent
+            # accounting. At S=128 the term is ~1% of 6N.
+            bert_flops_per_token = (
+                6.0 * br["params_m"] * 1e6
+                + 12.0 * br["n_layers"] * bert_seq_len * br["d_model"]
+            )
             bert_block = metric_block(
-                br, br["value"] * bert_seq_len * 6.0 * br["params_m"] * 1e6
+                br, br["value"] * bert_seq_len * bert_flops_per_token
             )
         except Exception as e:
             log(f"[bench] bert bench failed: {e!r}")

@@ -311,7 +311,12 @@ def _get_watch(args) -> int:
         current: dict = {}
         for p in jobs_dir.glob("*.json"):
             try:
-                current[p.name] = p.stat().st_mtime
+                st = p.stat()
+                # (mtime_ns, size): on filesystems with coarse mtime
+                # granularity two writes can land in one tick, and a
+                # final transition written in the same tick as the
+                # previous write would otherwise stay invisible forever.
+                current[p.name] = (st.st_mtime_ns, st.st_size)
             except OSError:
                 pass  # deleted mid-scan
         if current == mtimes:

@@ -305,6 +305,15 @@ class StandbyPool:
             for sid, proc in list(self._procs.items()):
                 if proc.poll() is None and (self.dir / f"{sid}.ready").exists():
                     self._procs.pop(sid)
+                    # Reaching READY proves the spawn path works — reset
+                    # the crash-loop backoff here too, not only when a
+                    # replenish pass happens to observe the ready marker
+                    # (a standby claimed between passes, or a pool that
+                    # drains to empty, would otherwise leave a stale
+                    # streak that jumps one later pre-READY death
+                    # straight to the capped backoff).
+                    self._fail_streak = 0
+                    self._not_before = 0.0
                     return sid, proc
         return None
 

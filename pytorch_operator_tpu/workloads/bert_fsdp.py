@@ -146,6 +146,8 @@ def run(
         "unit": "sequences/sec/chip",
         "model": "bert-base" if bert_base else "bert-tiny",
         "params_m": round(n_params / 1e6, 1),
+        "n_layers": cfg.n_layers,
+        "d_model": cfg.d_model,
         "final_loss": round(float(final_loss), 4),
         "final_accuracy": round(float(final_acc), 4),
         "devices": n_dev,

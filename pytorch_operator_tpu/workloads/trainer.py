@@ -390,12 +390,21 @@ def make_lm_train_step(
 
             import jax.numpy as jnp
 
+            # Accumulation is DELIBERATELY f32 (summing N bf16 microbatch
+            # grads in bf16 loses low bits every step); the memory cost is
+            # one f32-params-sized buffer regardless of param dtype. The
+            # mean is cast back to the param dtype so the optimizer update
+            # (and the params it produces) keep their configured dtype.
             zeros = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
             )
             (loss_sum, grad_sum), _ = jax.lax.scan(body, (0.0, zeros), mbs)
             loss = loss_sum / grad_accum
-            grads = jax.tree.map(lambda g: g / grad_accum, grad_sum)
+            grads = jax.tree.map(
+                lambda g, p: (g / grad_accum).astype(p.dtype),
+                grad_sum,
+                state["params"],
+            )
         updates, opt_state = tx.update(grads, state["opt_state"], state["params"])
         params = optax.apply_updates(state["params"], updates)
         return {"params": params, "opt_state": opt_state}, loss

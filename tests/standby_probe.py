@@ -8,6 +8,12 @@ import time
 
 def main() -> int:
     print("probe-env", os.environ.get("PROBE_VAL", ""), flush=True)
+    if os.environ.get("PROBE_DUMP_ENV"):
+        # Full-environment fingerprint for the cold-vs-warm parity test
+        # (one line per var; the json module keeps newlines escaped).
+        import json
+
+        print("probe-environ", json.dumps(dict(os.environ)), flush=True)
     if os.environ.get("PROBE_SPAWN_CHILD"):
         # A same-process-group descendant that outlives the main process
         # (data-loader-worker stand-in for the wrapperless-death test).
@@ -16,6 +22,14 @@ def main() -> int:
         subprocess.Popen(["sleep", os.environ["PROBE_SPAWN_CHILD"]])
     if os.environ.get("PROBE_SLEEP"):
         time.sleep(float(os.environ["PROBE_SLEEP"]))
+    if os.environ.get("PROBE_WAIT_FOR_GLOB"):
+        # Deterministic capacity-release hook: occupy our slots until some
+        # path matching the glob exists (e.g. another job's first
+        # checkpoint), then exit 0.
+        import glob
+
+        while not glob.glob(os.environ["PROBE_WAIT_FOR_GLOB"]):
+            time.sleep(0.1)
     return int(os.environ.get("PROBE_EXIT", "0"))
 
 
