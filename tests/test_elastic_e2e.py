@@ -140,6 +140,122 @@ def test_shrink_resume_reshards_checkpoint_across_world_sizes(tmp_path):
         sup2.shutdown()
 
 
+def test_grow_back_resumes_when_capacity_frees(tmp_path):
+    """The other half of capacity-adaptivity (VERDICT r3 Missing #4 /
+    Next #4), end-to-end with real subprocesses: a job whose target world
+    does not fit LAUNCHES SHRUNK, and when the occupying job finishes the
+    reconciler grows the world back to target via _maybe_grow_elastic —
+    training resuming from checkpoint across BOTH transitions.
+
+    One supervisor, 4 slots. A squatter job holds 2 slots and exits only
+    once the elastic job's first checkpoint lands (deterministic capacity
+    release — no sleep tuning). The elastic job targets master+3 workers
+    (4 slots): admission shrinks it to master+1 (fsdp=2,
+    ElasticScaledDown); the squatter's exit frees 2 slots; grow-back
+    tears the world down (ElasticScaledUp, one restart spent) and the
+    fsdp=4 world resumes from the fsdp=2 checkpoint and finishes.
+    """
+    state = tmp_path / "state"
+    args = _llama_args(16)
+    sup = Supervisor(state_dir=state, poll_interval=0.05, max_slots=4)
+    try:
+        ckpt_glob = str(
+            state / "checkpoints" / "default_grow-e2e" / "*" / "_CHECKPOINT_METADATA"
+        )
+        # Master-only, holding BOTH slots in one process: the capacity
+        # frees atomically, so grow-back happens in ONE membership change
+        # (two 1-slot replicas exiting across sync passes would grow the
+        # world twice, spending two restarts — legal, but nondeterministic).
+        squatter = new_job(name="squatter", workers=0)
+        squatter.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            module="tests.standby_probe",
+            env={"PROBE_WAIT_FOR_GLOB": ckpt_glob},
+            resources=Resources(cpu_devices=2),
+        )
+        squat_key = sup.submit(squatter)
+        # wait() reconciles only the named job, so the squatter needs its
+        # own reconcile pump (the daemon-loop analog) for the duration.
+        import threading
+        import time as _time
+
+        stop_pump = threading.Event()
+
+        def pump():
+            while not stop_pump.is_set():
+                try:
+                    sup.reconciler.sync(squat_key)
+                except Exception:
+                    return
+                _time.sleep(0.05)
+
+        pump_t = threading.Thread(target=pump, daemon=True)
+        pump_t.start()
+        # The squatter must actually HOLD its 2 slots before the elastic
+        # job is admitted, or both fit and no shrink happens.
+        deadline = _time.time() + 60
+        while (
+            sum(
+                e.reason == "SuccessfulCreateReplica"
+                for e in sup.events.for_job(squat_key)
+            )
+            < 1
+        ):
+            assert _time.time() < deadline, "squatter never launched"
+            _time.sleep(0.05)
+
+        job = new_job(
+            name="grow-e2e",
+            workers=3,
+            restart_policy=RestartPolicy.EXIT_CODE,
+            backoff_limit=4,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=3, max_restarts=4),
+        )
+        job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+            module="pytorch_operator_tpu.workloads.llama_train",
+            args=list(args),
+            resources=Resources(cpu_devices=1),
+        )
+        job.spec.replica_specs[ReplicaType.WORKER] = ReplicaSpec(
+            replicas=3,
+            restart_policy=RestartPolicy.EXIT_CODE,
+            template=ProcessTemplate(
+                module="pytorch_operator_tpu.workloads.llama_train",
+                args=list(args),
+                resources=Resources(cpu_devices=1),
+            ),
+        )
+        key = sup.submit(job)
+        done = sup.wait(key, timeout=420)
+        assert done.is_succeeded(), [c.to_dict() for c in done.status.conditions]
+        squat_done = sup.wait(squat_key, timeout=60)
+        assert squat_done.is_succeeded()
+        stop_pump.set()
+        pump_t.join(timeout=10)
+
+        reasons = [e.reason for e in sup.events.for_job(key)]
+        assert "ElasticScaledDown" in reasons, reasons
+        assert "ElasticScaledUp" in reasons, reasons
+        # The grow-back is a membership change: exactly one restart spent.
+        assert done.status.restart_count == 1
+
+        text = "\n".join(
+            p.read_text()
+            for p in sorted((state / "logs").glob("*grow-e2e-master*"))
+        )
+        # Life 1 really ran shrunk, life 2 at the full target world.
+        assert "'fsdp': 2" in text, text[-2000:]
+        assert "'fsdp': 4" in text, text[-2000:]
+        # And life 2 resumed from life 1's checkpoint, not step 0 —
+        # step/loss continuity across the grow transition.
+        resumed = [
+            ln for ln in text.splitlines() if "resumed from checkpoint" in ln
+        ]
+        assert resumed, text[-2000:]
+        assert all(int(ln.rsplit("step", 1)[1]) >= 3 for ln in resumed), resumed
+    finally:
+        sup.shutdown()
+
+
 def test_preemption_gang_restart_resumes_from_checkpoint(tmp_path):
     sup = Supervisor(state_dir=tmp_path / "state", poll_interval=0.05)
     job = new_job(
