@@ -62,6 +62,11 @@ class FlopCount:
         self.total += flops * scale
         self.by_primitive[name] = self.by_primitive.get(name, 0.0) + flops * scale
 
+    def _merge(self, other: "FlopCount") -> None:
+        self.total += other.total
+        for k, v in other.by_primitive.items():
+            self.by_primitive[k] = self.by_primitive.get(k, 0.0) + v
+
 
 def _size(aval) -> int:
     return math.prod(aval.shape) if aval.shape else 1
@@ -92,48 +97,139 @@ def _sub_jaxpr(v):
     return v.jaxpr if hasattr(v, "jaxpr") else v
 
 
-def _walk(jaxpr, scale: float, out: FlopCount) -> None:
+def _traverse(jaxpr, scale: float, acc, visit, shard_map_mult, score) -> None:
+    """One traversal skeleton for every counter in this module: scan
+    bodies x trip count, pallas bodies x grid, cond -> max-scoring branch
+    (one executes), while -> one iteration (documented caveat). ``visit``
+    handles leaf equations; ``shard_map_mult`` decides the per-manual-
+    device multiplier (mesh-total for FLOPs, per-device for comm);
+    ``score`` ranks cond branches. ``acc`` needs ``_merge``."""
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         if name == "scan":
-            _walk(
+            _traverse(
                 _sub_jaxpr(eqn.params["jaxpr"]),
                 scale * eqn.params["length"],
-                out,
+                acc, visit, shard_map_mult, score,
             )
         elif name == "while":
-            # Trip count is data-dependent; count one iteration of body
-            # + cond (documented caveat).
-            _walk(_sub_jaxpr(eqn.params["body_jaxpr"]), scale, out)
-            _walk(_sub_jaxpr(eqn.params["cond_jaxpr"]), scale, out)
+            _traverse(
+                _sub_jaxpr(eqn.params["body_jaxpr"]), scale,
+                acc, visit, shard_map_mult, score,
+            )
+            _traverse(
+                _sub_jaxpr(eqn.params["cond_jaxpr"]), scale,
+                acc, visit, shard_map_mult, score,
+            )
         elif name == "cond":
-            branch_counts = []
+            branch_accs = []
             for b in eqn.params["branches"]:
-                sub = FlopCount()
-                _walk(_sub_jaxpr(b), scale, sub)
-                branch_counts.append(sub)
-            if branch_counts:
-                biggest = max(branch_counts, key=lambda c: c.total)
-                out.total += biggest.total
-                for k, v in biggest.by_primitive.items():
-                    out.by_primitive[k] = out.by_primitive.get(k, 0.0) + v
+                sub = type(acc)()
+                _traverse(_sub_jaxpr(b), scale, sub, visit, shard_map_mult, score)
+                branch_accs.append(sub)
+            if branch_accs:
+                acc._merge(max(branch_accs, key=score))
         elif name == "shard_map":
             mesh = eqn.params["mesh"]
             manual = eqn.params.get("manual_axes") or ()
             n_dev = math.prod(mesh.shape[a] for a in manual) or 1
-            _walk(_sub_jaxpr(eqn.params["jaxpr"]), scale * n_dev, out)
+            _traverse(
+                _sub_jaxpr(eqn.params["jaxpr"]),
+                scale * shard_map_mult(n_dev),
+                acc, visit, shard_map_mult, score,
+            )
         elif name == "pallas_call":
             # The kernel body runs once per grid cell.
             grid = getattr(eqn.params["grid_mapping"], "grid", ())
             n_cells = math.prod(g for g in grid if isinstance(g, int)) or 1
-            _walk(_sub_jaxpr(eqn.params["jaxpr"]), scale * n_cells, out)
+            _traverse(
+                _sub_jaxpr(eqn.params["jaxpr"]), scale * n_cells,
+                acc, visit, shard_map_mult, score,
+            )
         elif "jaxpr" in eqn.params:
             # pjit / remat2 / closed_call / custom_* wrappers.
-            _walk(_sub_jaxpr(eqn.params["jaxpr"]), scale, out)
+            _traverse(
+                _sub_jaxpr(eqn.params["jaxpr"]), scale,
+                acc, visit, shard_map_mult, score,
+            )
         elif "call_jaxpr" in eqn.params:
-            _walk(_sub_jaxpr(eqn.params["call_jaxpr"]), scale, out)
+            _traverse(
+                _sub_jaxpr(eqn.params["call_jaxpr"]), scale,
+                acc, visit, shard_map_mult, score,
+            )
         else:
-            out._add(name, _eqn_flops(eqn), scale)
+            visit(acc, eqn, scale)
+
+
+_COMM = frozenset(
+    {
+        "psum", "pmax", "pmin", "ppermute", "all_gather", "all_to_all",
+        "reduce_scatter", "psum_scatter", "pbroadcast",
+    }
+)
+
+
+@dataclass
+class CollectiveCount:
+    """Result of :func:`count_collectives`: per-primitive call counts and
+    payload bytes (operand bytes per device per call — "bytes sent", not
+    link-level wire cost, which depends on the algorithm/topology)."""
+
+    calls: dict = field(default_factory=dict)
+    bytes: dict = field(default_factory=dict)
+
+    @property
+    def total_calls(self) -> float:
+        return sum(self.calls.values())
+
+    @property
+    def total_bytes(self) -> float:
+        return sum(self.bytes.values())
+
+    def _add(self, name: str, n_bytes: float, scale: float) -> None:
+        self.calls[name] = self.calls.get(name, 0.0) + scale
+        self.bytes[name] = self.bytes.get(name, 0.0) + n_bytes * scale
+
+    def _merge(self, other: "CollectiveCount") -> None:
+        for k, v in other.calls.items():
+            self.calls[k] = self.calls.get(k, 0.0) + v
+        for k, v in other.bytes.items():
+            self.bytes[k] = self.bytes.get(k, 0.0) + v
+
+
+def _comm_bytes(eqn) -> float:
+    total = 0.0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            total += _size(aval) * aval.dtype.itemsize
+    return total
+
+
+def _visit_comm(acc: CollectiveCount, eqn, scale: float) -> None:
+    if eqn.primitive.name in _COMM:
+        acc._add(eqn.primitive.name, _comm_bytes(eqn), scale)
+
+
+def count_collectives(fn, *args, **kwargs) -> CollectiveCount:
+    """Per-device collective-communication profile of ``fn(*args)``:
+    how many times each collective primitive executes (scan-aware) and
+    the payload bytes it moves. Traces abstractly — nothing executes, so
+    counting a 32k-sequence program is free. The companion to
+    :func:`count_flops` for comparing communication regimes (e.g. ring
+    vs Ulysses sequence parallelism)."""
+    import jax
+
+    closed = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    out = CollectiveCount()
+    _traverse(
+        closed.jaxpr, 1.0, out, _visit_comm,
+        # Per-DEVICE accounting (unlike count_flops' mesh total): "bytes
+        # this chip puts on the ICI" is the comparable metric.
+        shard_map_mult=lambda n_dev: 1,
+        score=lambda c: c.total_bytes,
+    )
+    return out
 
 
 def count_flops(fn, *args, **kwargs) -> FlopCount:
@@ -148,5 +244,12 @@ def count_flops(fn, *args, **kwargs) -> FlopCount:
 
     closed = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
     out = FlopCount()
-    _walk(closed.jaxpr, 1.0, out)
+    _traverse(
+        closed.jaxpr, 1.0, out,
+        lambda acc, eqn, scale: acc._add(
+            eqn.primitive.name, _eqn_flops(eqn), scale
+        ),
+        shard_map_mult=lambda n_dev: n_dev,
+        score=lambda c: c.total,
+    )
     return out
