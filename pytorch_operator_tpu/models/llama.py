@@ -719,25 +719,32 @@ def train_value_and_grad_pp(
     numerically equal to ``jax.value_and_grad`` over the GPipe forward,
     but with per-stage activation residency bounded by the schedule
     depth O(P·mb) instead of O(M·mb)
-    (parallel/pipeline.pipeline_value_and_grad).
+    (parallel/pipeline.pipeline_value_and_grad; backward='stored'
+    residual stashing keeps compute at GPipe parity).
 
     The embed lookup runs outside the pipeline (its input-cotangent
     stream dx comes back from the pipeline's backward); the final norm +
     LM head + next-token loss run INSIDE as a VOCAB-PARALLEL loss tail
-    (``sharded_loss=True``): the head kernel is chunked ``[P, d, V/P]``
-    over the pp axis, every stage computes its vocab chunk of the last
-    stage's broadcast microbatch, and the per-token log-sum-exp + target
-    logit combine with two psums + one pmax (round-4 fix for the P-fold
-    loss-tail duplication — the tail costs ~1/P per stage instead of 1×
-    per stage). The local chunk's logits are materialized densely
-    ([mb·(S-1), V/P] f32): at pp>=2 that is at most half the full-vocab
-    buffer ``cfg.xent_impl='chunked'`` exists to avoid, so the chunked
-    impl is not consulted on this path. MoE aux losses are not supported
-    on pp meshes (same restriction as the GPipe path — flax sow
-    collections don't thread the pipeline).
+    (``sharded_loss=True``) whenever pp > 1 and the vocab divides: the
+    head kernel is chunked ``[P, d, V/P]`` over the pp axis, every stage
+    computes online-softmax partial stats for its columns
+    (ops.chunked_xent.chunked_vocab_stats — ``cfg.xent_impl='chunked'``
+    streams [N, 8192] sub-chunks, 'dense' takes the local V/P in one
+    pass), and the per-token log-sum-exp + target logit combine with one
+    pmax + two psums. This is the round-4 fix for the P-fold loss-tail
+    duplication: the tail costs ~1/P per stage instead of 1× per stage.
+
+    Degenerate/fallback cases keep the REPLICATED tail (the pre-round-4
+    behavior, correct at any vocab): pp=1 (nothing to shard), or a vocab
+    that does not divide the pp extent (warns — the tail then duplicates
+    P-fold, so prefer a divisible vocab/pp pairing).
+
+    MoE aux losses are not supported on pp meshes (same restriction as
+    the GPipe path — flax sow collections don't thread the pipeline).
     """
     import jax
 
+    from ..ops.chunked_xent import chunked_vocab_stats
     from ..parallel.pipeline import pipeline_value_and_grad
 
     cfg = model.cfg
@@ -749,109 +756,101 @@ def train_value_and_grad_pp(
     p, stage_params, stage = _pp_parts(model, params, mesh)
     n_stages = mesh.shape["pp"]
     V = cfg.vocab_size
-    if V % n_stages:
-        raise ValueError(
-            f"vocab_size={V} not divisible by pp={n_stages} "
-            "(the pipeline loss tail is vocab-parallel over pp)"
+    sharded = n_stages > 1 and V % n_stages == 0
+    if n_stages > 1 and not sharded:
+        import warnings
+
+        warnings.warn(
+            f"vocab_size={V} does not divide pp={n_stages}: the pipeline "
+            "loss tail cannot be vocab-parallel and will run replicated "
+            f"on every stage ({n_stages}x duplicated head FLOPs). Prefer "
+            "a vocab/pp pairing that divides.",
+            stacklevel=2,
         )
-    Vp = V // n_stages
 
     x, embed_vjp = jax.vjp(
         lambda table: table.astype(cfg.dtype)[tokens], p["embed"]["embedding"]
     )
+    w_full = p["lm_head"]["kernel"]  # [d, V]
 
-    if n_stages == 1:
-        # Degenerate pipeline: no duplication to shard away, and the
-        # "local chunk" would be the FULL vocab — keep the replicated
-        # tail so cfg.xent_impl='chunked' still avoids the [N, V] f32
-        # logits buffer.
+    def norm_hidden(scale_params, y_mb):
+        h = RMSNorm(cfg.rms_eps).apply({"params": scale_params}, y_mb)
+        return h[:, :-1].reshape(-1, h.shape[-1])
+
+    if sharded:
+        Vp = V // n_stages
+        lp = {
+            # Stage s owns vocab columns [s*Vp, (s+1)*Vp).
+            "w": jnp.moveaxis(
+                w_full.reshape(w_full.shape[0], n_stages, Vp), 1, 0
+            ),
+            # The norm scale is tiny: stack P copies; total grad = sum of
+            # the per-stage partials (each stage's chunk loss consumed
+            # its copy).
+            "final_norm": jax.tree.map(
+                lambda l: jnp.broadcast_to(l, (n_stages,) + l.shape),
+                p["final_norm"],
+            ),
+        }
+
+        def loss_fn(lp_, y_mb, tok_mb):
+            # Vocab-parallel next-token xent: per-stage online-softmax
+            # partials + collective log-sum-exp. Equals optax integer-
+            # label xent on the assembled logits. (m carries no tangent,
+            # so pmax — which has no differentiation rule — is skipped
+            # by AD.)
+            hh = norm_hidden(lp_["final_norm"], y_mb)
+            labels = tok_mb[:, 1:].reshape(-1)
+            off = jax.lax.axis_index("pp") * Vp
+            chunk = 8192 if cfg.xent_impl == "chunked" else Vp
+            m, s, lab = chunked_vocab_stats(
+                hh, lp_["w"], labels, chunk=chunk, col_offset=off
+            )
+            m_g = jax.lax.pmax(m, "pp")
+            se = jax.lax.psum(s * jnp.exp(m - m_g), "pp")
+            tgt = jax.lax.psum(lab, "pp")
+            return (m_g + jnp.log(se) - tgt).mean()
+
+        def reassemble(d_lp):
+            return {
+                "final_norm": jax.tree.map(
+                    lambda g: g.sum(0), d_lp["final_norm"]
+                ),
+                "lm_head": {
+                    "kernel": jnp.moveaxis(d_lp["w"], 0, 1).reshape(
+                        w_full.shape
+                    )
+                },
+            }
+
+    else:
         import optax
 
-        lp1 = {"final_norm": p["final_norm"], "lm_head": p["lm_head"]}
+        lp = {"final_norm": p["final_norm"], "lm_head": p["lm_head"]}
 
-        def loss_fn1(lp_, y_mb, tok_mb):
-            h = RMSNorm(cfg.rms_eps).apply({"params": lp_["final_norm"]}, y_mb)
+        def loss_fn(lp_, y_mb, tok_mb):
+            hh = norm_hidden(lp_["final_norm"], y_mb)
             w = lp_["lm_head"]["kernel"]
+            labels = tok_mb[:, 1:].reshape(-1)
             if cfg.xent_impl == "chunked":
                 from ..ops.chunked_xent import chunked_softmax_xent
 
-                hh = h[:, :-1].reshape(-1, h.shape[-1])
-                return chunked_softmax_xent(
-                    hh, w, tok_mb[:, 1:].reshape(-1)
-                ).mean()
-            logits = h.astype(jnp.float32) @ w.astype(jnp.float32)
+                return chunked_softmax_xent(hh, w, labels).mean()
+            logits = hh.astype(jnp.float32) @ w.astype(jnp.float32)
             return optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], tok_mb[:, 1:]
+                logits, labels
             ).mean()
 
-        loss, (d_stage, d_lp, dx) = pipeline_value_and_grad(
-            stage, loss_fn1, stage_params, lp1, x, tokens,
-            mesh=mesh, microbatches=microbatches, schedule="1f1b",
-            backward="stored",
-        )
-        (d_embed,) = embed_vjp(dx)
-        grads_unboxed = {
-            "embed": {"embedding": d_embed},
-            "layers": jax.tree.map(
-                lambda g, ref: g.reshape(ref.shape), d_stage, p["layers"]
-            ),
-            "final_norm": d_lp["final_norm"],
-            "lm_head": d_lp["lm_head"],
-        }
-        return loss, jax.tree.map(
-            lambda box, g: (
-                box.replace_boxed(g)
-                if isinstance(box, nn.meta.Partitioned)
-                else g
-            ),
-            params,
-            grads_unboxed,
-            is_leaf=lambda v: isinstance(v, nn.meta.Partitioned),
-        )
-    w_full = p["lm_head"]["kernel"]  # [d, V]
-    lp = {
-        # Stage s owns vocab columns [s*Vp, (s+1)*Vp).
-        "w": jnp.moveaxis(w_full.reshape(w_full.shape[0], n_stages, Vp), 1, 0),
-        # The norm scale is tiny: stack P copies; total grad = sum of the
-        # per-stage partials (each stage's chunk loss consumed its copy).
-        "final_norm": jax.tree.map(
-            lambda l: jnp.broadcast_to(l, (n_stages,) + l.shape),
-            p["final_norm"],
-        ),
-    }
-
-    def loss_fn(lp_, y_mb, tok_mb):
-        # Vocab-parallel next-token xent: local chunk logits + collective
-        # log-sum-exp (max-shifted; the shift is stop_gradient'd so pmax
-        # needs no vjp). Equals optax.softmax_cross_entropy_with_integer_
-        # labels on the assembled logits.
-        h = RMSNorm(cfg.rms_eps).apply({"params": lp_["final_norm"]}, y_mb)
-        hh = h[:, :-1].reshape(-1, h.shape[-1]).astype(jnp.float32)
-        logits = hh @ lp_["w"].astype(jnp.float32)  # [N, V/P]
-        labels = tok_mb[:, 1:].reshape(-1)
-        off = jax.lax.axis_index("pp") * Vp
-        # stop_gradient BEFORE the pmax: the shift is numerics-only, and
-        # pmax has no differentiation rule — a zero tangent skips it.
-        m = jax.lax.pmax(
-            jax.lax.stop_gradient(jnp.max(logits, axis=-1)), "pp"
-        )
-        se = jax.lax.psum(jnp.exp(logits - m[:, None]).sum(-1), "pp")
-        in_chunk = (labels >= off) & (labels < off + Vp)
-        local_label = jnp.clip(labels - off, 0, Vp - 1)
-        tgt_logit = jax.lax.psum(
-            jnp.where(
-                in_chunk,
-                jnp.take_along_axis(logits, local_label[:, None], axis=1)[:, 0],
-                0.0,
-            ),
-            "pp",
-        )
-        return (m + jnp.log(se) - tgt_logit).mean()
+        def reassemble(d_lp):
+            return {
+                "final_norm": d_lp["final_norm"],
+                "lm_head": d_lp["lm_head"],
+            }
 
     loss, (d_stage, d_lp, dx) = pipeline_value_and_grad(
         stage, loss_fn, stage_params, lp, x, tokens,
         mesh=mesh, microbatches=microbatches, schedule="1f1b",
-        sharded_loss=True,
+        sharded_loss=sharded,
         # Megatron-style residual stashing: backward reuses the forward's
         # policy-saved residuals (compute parity with GPipe) instead of
         # re-running each stage from its saved input. The transformer
@@ -866,10 +865,7 @@ def train_value_and_grad_pp(
         "layers": jax.tree.map(
             lambda g, ref: g.reshape(ref.shape), d_stage, p["layers"]
         ),
-        "final_norm": jax.tree.map(lambda g: g.sum(0), d_lp["final_norm"]),
-        "lm_head": {
-            "kernel": jnp.moveaxis(d_lp["w"], 0, 1).reshape(w_full.shape)
-        },
+        **reassemble(d_lp),
     }
     # Re-box to the params tree's flax metadata so the optimizer sees the
     # exact params structure (Partitioned leaves and all).

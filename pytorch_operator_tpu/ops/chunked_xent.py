@@ -53,6 +53,71 @@ def chunked_softmax_xent(hidden, w, labels, *, chunk: int = 8192):
     return _xent(hidden, w, labels, n_chunks, c)
 
 
+def chunked_vocab_stats(hidden, w, labels, *, chunk: int = 8192, col_offset=0):
+    """Online softmax partial stats of ``hidden @ w`` for a (possibly
+    vocab-sharded) head chunk — the combinable form of
+    :func:`chunked_softmax_xent` for the pipeline's vocab-parallel loss
+    tail (models/llama.py train_value_and_grad_pp). Returns f32 ``[N]``
+    triples:
+
+    - ``m``: max logit over THIS weight's columns (stop-gradient — the
+      shift is numerics-only);
+    - ``s``: sum of ``exp(logit - m)``;
+    - ``lab_logit``: the label's logit where the GLOBAL label id falls in
+      ``[col_offset, col_offset + w.shape[1])``, else 0.
+
+    Owners combine across shards with one pmax + two psums:
+    ``M = pmax(m); lse = M + log(psum(s * exp(m - M))); loss = lse -
+    psum(lab_logit)``. Plain autodiff (no custom VJP): each sub-chunk
+    body is ``jax.checkpoint``'d, so backward recomputes its ``[N,
+    chunk]`` logits instead of saving one residual buffer per chunk —
+    same peak-memory contract as chunked_softmax_xent. Pass
+    ``chunk >= w.shape[1]`` for a single dense pass over the local
+    columns.
+    """
+    N, D = hidden.shape
+    D2, Vl = w.shape
+    assert D == D2, f"hidden D={D} vs w D={D2}"
+    c = min(chunk, Vl)
+    n_chunks = -(-Vl // c)
+    hidden32 = hidden.astype(jnp.float32)
+    labels = labels.astype(jnp.int32) - col_offset  # local column ids
+
+    def body(carry, c_idx):
+        m, s, lab_logit = carry
+        w_c, start = _chunk_slice(w, c_idx, c)
+        logits = hidden32 @ w_c.astype(jnp.float32)  # [N, c] f32
+        logits = jnp.where(
+            _fresh_mask(start, c_idx, c)[None, :], logits, -jnp.inf
+        )
+        m_new = jnp.maximum(
+            m, jax.lax.stop_gradient(logits.max(axis=-1))
+        )
+        s = s * jnp.exp(m - m_new) + jnp.exp(logits - m_new[:, None]).sum(
+            axis=-1
+        )
+        local = labels - start
+        in_chunk = (labels >= c_idx * c) & (local < c) & (local >= 0)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, c - 1)[:, None], axis=-1
+        )[:, 0]
+        lab_logit = jnp.where(in_chunk, picked, lab_logit)
+        return (m_new, s, lab_logit), None
+
+    if n_chunks > 1:
+        body = jax.checkpoint(body)
+    init = _match_vma(
+        (
+            jnp.full((N,), -jnp.inf, jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+        ),
+        hidden,
+    )
+    (m, s, lab_logit), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    return m, s, lab_logit
+
+
 def _match_vma(tree, ref):
     """pcast every leaf of ``tree`` to carry ``ref``'s varying manual
     axes (shard_map vma) — makes freshly-built scan carries type-stable

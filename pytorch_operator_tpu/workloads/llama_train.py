@@ -67,6 +67,7 @@ def run(
     max_steps: int | None = None,
     remat: bool | None = None,
     remat_policy: str | None = None,
+    param_dtype: str | None = None,
     donate: bool | None = None,
     attn_impl: str | None = None,
     xent_impl: str | None = None,
@@ -114,6 +115,20 @@ def run(
         over["moe_capacity_factor"] = moe_capacity_factor
     if moe_aux_weight is not None:
         over["moe_aux_weight"] = moe_aux_weight
+    if param_dtype is not None:
+        # bf16 params halve the checkpoint/state footprint — the lever
+        # that fits the full 8B config's train state in host RAM for the
+        # CPU-mesh end-to-end run (tests/test_llama8b_e2e.py) and on
+        # smaller HBM parts. Grad accumulation still sums in f32
+        # (trainer.py), and adafactor keeps its factored stats in f32.
+        import jax.numpy as jnp
+
+        allowed = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+        if param_dtype not in allowed:
+            raise ValueError(
+                f"param_dtype={param_dtype!r} not in {sorted(allowed)}"
+            )
+        over["param_dtype"] = allowed[param_dtype]
     cfg = getattr(llama_lib, CONFIGS[config])(**over)
     if remat_policy not in (None, "full") and not cfg.remat:
         # Silently measuring the no-remat path while the user believes
@@ -601,6 +616,12 @@ def main(argv=None) -> int:
         "default 0 = off); spreads the router across experts",
     )
     p.add_argument(
+        "--param-dtype", choices=("float32", "bfloat16"), default=None,
+        dest="param_dtype",
+        help="parameter storage dtype (default float32); bfloat16 halves "
+        "param/grad/checkpoint bytes — the memory lever for 8B+ configs",
+    )
+    p.add_argument(
         "--pp-microbatches", type=int, default=None,
         help="GPipe microbatch count when the mesh has a pp axis "
         "(default 2 x pp extent; must be a multiple of it)",
@@ -646,6 +667,7 @@ def main(argv=None) -> int:
         max_steps=args.max_steps,
         remat=True if args.remat else None,
         remat_policy=args.remat_policy,
+        param_dtype=args.param_dtype,
         donate=args.donate,
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,

@@ -123,6 +123,71 @@ class TestLlamaIntegration:
         assert losses["chunked"] == pytest.approx(losses["dense"], rel=1e-4)
 
 
+class TestVocabStats:
+    """chunked_vocab_stats: the combinable partial-stat form behind the
+    pipeline's vocab-parallel loss tail."""
+
+    @pytest.mark.parametrize("chunk", [16, 23, 64])
+    def test_sharded_stats_combine_to_dense_loss_and_grads(self, chunk):
+        """Split the head into 4 column shards, compute per-shard stats
+        (multi-sub-chunk streaming when chunk < V/4), combine with the
+        documented max/sumexp/target reduction: loss AND grads must
+        equal the dense reference."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_operator_tpu.ops.chunked_xent import chunked_vocab_stats
+
+        n, d, v, shards = 12, 8, 64, 4
+        hidden, w, labels = _rand(n, d, v, seed=3)
+        vl = v // shards
+
+        def sharded_loss(hidden, w):
+            ms, ss, ls = [], [], []
+            for i in range(shards):
+                m, s, lab = chunked_vocab_stats(
+                    jnp.asarray(hidden),
+                    jnp.asarray(w[:, i * vl : (i + 1) * vl]),
+                    jnp.asarray(labels),
+                    chunk=chunk,
+                    col_offset=i * vl,
+                )
+                ms.append(m), ss.append(s), ls.append(lab)
+            m_g = jnp.max(jnp.stack(ms), 0)
+            se = sum(s * jnp.exp(m - m_g) for m, s in zip(ms, ss))
+            tgt = sum(ls)
+            return (m_g + jnp.log(se) - tgt).mean()
+
+        def dense_loss(hidden, w):
+            return _dense_ref(
+                jnp.asarray(hidden), jnp.asarray(w), jnp.asarray(labels)
+            ).mean()
+
+        got, (dh, dw) = jax.value_and_grad(sharded_loss, argnums=(0, 1))(
+            hidden, w
+        )
+        ref, (rdh, rdw) = jax.value_and_grad(dense_loss, argnums=(0, 1))(
+            hidden, w
+        )
+        assert float(got) == pytest.approx(float(ref), rel=1e-5)
+        np.testing.assert_allclose(np.asarray(dh), np.asarray(rdh), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(rdw), rtol=1e-4, atol=1e-6)
+
+    def test_out_of_shard_labels_contribute_zero(self):
+        import jax.numpy as jnp
+
+        from pytorch_operator_tpu.ops.chunked_xent import chunked_vocab_stats
+
+        hidden, w, _ = _rand(6, 8, 32, seed=4)
+        # All labels live OUTSIDE this shard's [64, 96) column range.
+        labels = np.arange(6, dtype=np.int32)
+        _, _, lab = chunked_vocab_stats(
+            jnp.asarray(hidden), jnp.asarray(w), jnp.asarray(labels),
+            chunk=16, col_offset=64,
+        )
+        np.testing.assert_array_equal(np.asarray(lab), np.zeros(6, np.float32))
+
+
 class TestGrads:
     @pytest.mark.parametrize("v,chunk", [(80, 32), (97, 64)])
     def test_grads_match_dense(self, v, chunk):

@@ -1,0 +1,90 @@
+"""The REAL Llama-3-8B config, executed end-to-end (VERDICT r3 Missing
+#1 / Next #2): full dims — d_model 4096, 32 scanned layers, 128k vocab,
+chunked xent — trained for real steps on an fsdp=8 virtual-CPU mesh with
+bf16 params + adafactor, then checkpoint-resumed through the production
+resume path. Until this run, "sharding config validated" rested on
+eval_shape arithmetic (tests/test_llama8b_plan.py — which stays as the
+fast guard).
+
+Scaled in DEPTH not dims: batch 8 x seq 32 = 256 tokens/step keeps the
+CPU matmul time (~6N FLOPs/token on one host core) and the activation
+footprint small enough that remat is deliberately OFF — at 256 tokens
+activations are ~1 GiB while params+grads are ~32 GiB, so recompute
+would double step time to save nothing that matters here.
+
+Opt-in (TPUJOB_RUN_8B=1): one run takes tens of minutes and ~40+ GiB
+RSS — it must not ride the regular suite. BASELINE.md records the
+measured wall/RSS from the round-4 session.
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import time
+
+import numpy as np
+import pytest
+
+import tests.jaxenv  # noqa: F401
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("TPUJOB_RUN_8B"),
+    reason="8B end-to-end is opt-in (TPUJOB_RUN_8B=1): ~1h, ~40+ GiB RSS",
+)
+
+
+def test_8b_full_config_trains_and_resumes(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUJOB_CHECKPOINT_DIR", str(tmp_path / "ckpt"))
+    from pytorch_operator_tpu.workloads import llama_train
+
+    common = dict(
+        config="8b",
+        mesh_spec="fsdp=8",
+        batch_size=8,
+        seq_len=32,
+        warmup=1,
+        optimizer="adafactor",
+        param_dtype="bfloat16",
+        remat=False,
+        checkpoint_every=1,
+    )
+
+    def stamp(tag, t0):
+        wall = time.time() - t0
+        rss_gib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2**20
+        print(
+            f"[8b-e2e] {tag}: wall {wall:.0f}s, peak RSS {rss_gib:.1f} GiB",
+            flush=True,
+        )
+
+    # ---- life 1: two real train steps of the production graph ----
+    logs1 = []
+    t0 = time.time()
+    r1 = llama_train.run(
+        steps=2, max_steps=2,
+        log=lambda m: (logs1.append(str(m)), print(m, flush=True)),
+        **common,
+    )
+    stamp("life 1 (init + compile + 2 steps + 2 checkpoints)", t0)
+    assert np.isfinite(r1["final_loss"]), r1
+    # Fresh init on a 128k vocab: xent starts near ln(V) ~ 11.8.
+    assert 5.0 < r1["final_loss"] < 15.0, r1
+    assert r1["params_m"] == pytest.approx(8030, rel=0.05), r1  # ~8.03B
+    ckpts = tmp_path / "ckpt"
+    saved_steps = sorted(int(p.name) for p in ckpts.iterdir() if p.name.isdigit())
+    assert saved_steps and saved_steps[-1] == 2, saved_steps
+
+    # ---- life 2: the production resume path restores step 2's 16 GiB
+    # sharded state onto a fresh fsdp=8 world and trains one more step.
+    logs2 = []
+    t0 = time.time()
+    r2 = llama_train.run(
+        steps=3, max_steps=3,
+        log=lambda m: (logs2.append(str(m)), print(m, flush=True)),
+        **common,
+    )
+    stamp("life 2 (restore + 1 step)", t0)
+    assert np.isfinite(r2["final_loss"]), r2
+    resumed = [ln for ln in logs2 if "resumed from checkpoint" in ln]
+    assert resumed and "step 2" in resumed[0], logs2[:10]
