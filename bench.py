@@ -162,6 +162,44 @@ def measure_latency(log) -> dict:
                 continue
             out[phase] = round(lat, 3)
             log(f"[latency] schedule-to-first-step ({phase}): {lat:.2f}s")
+            # Phase breakdown: supervisor-side spans from status
+            # timestamps + probe-reported splits (latency_probe's
+            # latency_phases status record). Best-effort — the headline
+            # number never depends on it.
+            try:
+                import json as _json
+
+                from pytorch_operator_tpu.controller.progress import (
+                    job_status_dir,
+                )
+                from pytorch_operator_tpu.controller.store import job_key
+
+                status_f = (
+                    job_status_dir(home / "status", job_key(job))
+                    / "master-0.jsonl"
+                )
+                rec = None
+                for line in status_f.read_text().splitlines():
+                    r = _json.loads(line)
+                    if r.get("event") == "latency_phases":
+                        rec = r
+                if rec is not None:
+                    out[f"{phase}_phases"] = {
+                        "submit_to_launch_s": round(
+                            job.status.start_time - job.status.submit_time, 3
+                        ),
+                        "launch_to_main_s": round(
+                            rec["main_entry"] - job.status.start_time, 3
+                        ),
+                        "rendezvous_s": rec["rendezvous_s"],
+                        "import_jax_s": rec["import_jax_s"],
+                        "client_init_s": rec["client_init_s"],
+                        "compile_s": rec["compile_s"],
+                        "first_exec_s": rec["first_exec_s"],
+                    }
+                    log(f"[latency] {phase} phases: {out[f'{phase}_phases']}")
+            except Exception as e:
+                log(f"[latency] {phase} phase breakdown unavailable: {e!r}")
     finally:
         sup.shutdown()
         shutil.rmtree(home, ignore_errors=True)
@@ -251,6 +289,104 @@ def run(argv=None) -> dict:
     except Exception as e:  # the headline resnet bench must still run
         log(f"[bench] llama bench failed: {e!r}")
 
+    # ---- real-data LM: byte-level training on the repo's own text with
+    # a held-out split (VERDICT r3 Weak #3 / Next #6) — the artifact's
+    # non-trivial learning evidence. Chance on bytes is ln(256) = 5.545;
+    # the leg reports held-out loss against that floor.
+    llama_data_block = None
+    if not args.smoke:
+        try:
+            import glob as _glob
+            import tempfile
+            from pathlib import Path
+
+            import numpy as np
+
+            from pytorch_operator_tpu.data import pack_arrays
+
+            root = Path(__file__).resolve().parent
+            paths = sorted(
+                _glob.glob(str(root / "pytorch_operator_tpu/**/*.py"),
+                           recursive=True)
+            ) + sorted(_glob.glob(str(root / "*.md")))
+            data = b"".join(Path(p).read_bytes() for p in paths)
+            S = 1024
+            n = len(data) // S
+            arr = (
+                np.frombuffer(data[: n * S], np.uint8)
+                .astype(np.int32)
+                .reshape(n, S)
+            )
+            rng = np.random.default_rng(0)
+            arr = arr[rng.permutation(n)]  # de-correlate the 90/10 split
+            split = max(16, int(n * 0.9))
+            with tempfile.TemporaryDirectory() as td:
+                train_f, eval_f = Path(td) / "train.bin", Path(td) / "eval.bin"
+                pack_arrays(train_f, {"tokens": arr[:split]})
+                pack_arrays(eval_f, {"tokens": arr[split:]})
+                dr = llama_train.run(
+                    config="0.3b", batch_size=16, seq_len=S, steps=80,
+                    warmup=2, data_file=str(train_f), eval_file=str(eval_f),
+                    eval_batches=4, lr=3e-4, lr_schedule="cosine",
+                    lr_warmup_steps=8, grad_clip=1.0,
+                    remat=True, remat_policy="dots", donate=True,
+                    log=lambda m: log(f"[bench] {m}"),
+                )
+            chance = 5.545  # ln 256
+            llama_data_block = {
+                "metric": "llama_train_real_data_tokens_per_sec_per_chip",
+                "value": dr["value"],
+                "unit": dr["unit"],
+                "data": "repo source+docs, byte-level, 90/10 held-out split",
+                "final_loss": dr["final_loss"],
+                "eval_loss": dr.get("eval_loss"),
+                "chance_loss": chance,
+                # The learning evidence: held-out bytes predicted well
+                # below chance after 80 steps.
+                "learned": bool(
+                    dr.get("eval_loss") is not None
+                    and dr["eval_loss"] < chance - 1.0
+                ),
+            }
+            if not llama_data_block["learned"]:
+                log(
+                    "[bench] WARNING: real-data leg did not beat chance "
+                    f"by 1 nat on held-out bytes: {llama_data_block}"
+                )
+        except Exception as e:
+            log(f"[bench] real-data llama bench failed: {e!r}")
+
+    # ---- MoE: the winning sparse-dispatch config end-to-end on the chip
+    # (VERDICT r3 Missing #3 / Next #3); MFU uses FLOPs-ACTIVE params
+    # (top_k/E of expert weights), not total.
+    moe_block = None
+    if not args.smoke:
+        try:
+            mr = llama_train.run(
+                config="0.3b", batch_size=8, seq_len=2048, steps=12,
+                warmup=3, n_layers=8, param_dtype="bfloat16",
+                optimizer="adafactor", n_experts=8, moe_top_k=2,
+                moe_dispatch="sparse", moe_aux_weight=1e-2,
+                remat=True, remat_policy="dots",
+                log=lambda m: log(f"[bench] {m}"),
+            )
+            moe_flops = mr["value"] * lm_train_flops_per_token(
+                mr["active_params_m"] * 1e6, mr["n_layers"],
+                mr["d_model"], 2048,
+            )
+            moe_block = metric_block(mr, moe_flops)
+            moe_block.update(
+                n_experts=mr["n_experts"],
+                moe_dispatch=mr["moe_dispatch"],
+                moe_top_k=2,
+                params_m=mr["params_m"],
+                active_params_m=mr["active_params_m"],
+                final_loss=mr["final_loss"],
+            )
+            moe_block["metric"] = "moe_" + moe_block["metric"]
+        except Exception as e:
+            log(f"[bench] moe bench failed: {e!r}")
+
     # ---- BERT + ViT: driver-captured like the LM (hand-recorded BASELINE
     # rows drift; artifact numbers cannot). Short runs — each block is
     # best-effort and must not sink the headline benches.
@@ -298,7 +434,7 @@ def run(argv=None) -> dict:
         log=log,
         **cfg,
     )
-    out = {
+    resnet_block = {
         "metric": result["metric"],
         "value": result["value"],
         "unit": result["unit"],
@@ -307,9 +443,20 @@ def run(argv=None) -> dict:
     if not args.smoke:
         # images/sec/chip x train FLOPs/img; the smoke config (resnet18
         # @64px) has no established FLOPs constant worth maintaining.
-        out["mfu"] = mfu(result["value"] * RESNET50_TRAIN_FLOPS_PER_IMG)
+        resnet_block["mfu"] = mfu(result["value"] * RESNET50_TRAIN_FLOPS_PER_IMG)
+    # The artifact LEADS with the flagship LM (the MFU carrier — VERDICT
+    # r3 Weak #2); ResNet is the HBM-walled continuity metric and rides
+    # as a sub-block. Falls back to the old resnet-led shape only if the
+    # LM leg failed outright.
     if llama_block is not None:
-        out["llama"] = llama_block
+        out = dict(llama_block)
+        out["resnet"] = resnet_block
+    else:
+        out = resnet_block
+    if llama_data_block is not None:
+        out["llama_real_data"] = llama_data_block
+    if moe_block is not None:
+        out["moe"] = moe_block
     if bert_block is not None:
         out["bert"] = bert_block
     if vit_block is not None:

@@ -94,11 +94,26 @@ def build_cluster_env(
     # of schedule-to-first-step on TPU (BASELINE.md). Template env wins —
     # injected env overrides template env at spawn, so only set it when
     # the user didn't.
+    template_env = job.spec.replica_specs[rtype].template.env
     if (
         compile_cache_dir is not None
-        and "JAX_COMPILATION_CACHE_DIR"
-        not in job.spec.replica_specs[rtype].template.env
+        and "JAX_COMPILATION_CACHE_DIR" not in template_env
     ):
         env["JAX_COMPILATION_CACHE_DIR"] = compile_cache_dir
+    if (
+        # A cache is in effect — injected above OR user-supplied...
+        compile_cache_dir is not None
+        or "JAX_COMPILATION_CACHE_DIR" in template_env
+    ) and "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in template_env:
+        # ...so persist EVERY compiled program, not just those whose
+        # pure-XLA compile time clears jax's default 1s threshold: on a
+        # tunneled backend the remote-compile round trip costs ~1.5-2s
+        # regardless of program size (measured round 4: a 256x256
+        # matmul's "compile" is 1.94s remote vs 0.33s cache fetch), and
+        # that round trip is NOT counted as compile time by the
+        # threshold — the programs that benefit most from the cache are
+        # exactly the ones it would skip. A template that pins its own
+        # threshold wins, like the cache dir itself.
+        env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
 
     return env

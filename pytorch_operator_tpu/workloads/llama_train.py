@@ -68,6 +68,7 @@ def run(
     remat: bool | None = None,
     remat_policy: str | None = None,
     param_dtype: str | None = None,
+    n_layers: int | None = None,
     donate: bool | None = None,
     attn_impl: str | None = None,
     xent_impl: str | None = None,
@@ -115,6 +116,10 @@ def run(
         over["moe_capacity_factor"] = moe_capacity_factor
     if moe_aux_weight is not None:
         over["moe_aux_weight"] = moe_aux_weight
+    if n_layers is not None:
+        # Depth override for experiment sizing (e.g. the MoE A/B keeps
+        # 0.3b WIDTH but fewer layers so E=16 experts fit one chip).
+        over["n_layers"] = n_layers
     if param_dtype is not None:
         # bf16 params halve the checkpoint/state footprint — the lever
         # that fits the full 8B config's train state in host RAM for the
@@ -462,7 +467,35 @@ def run(
         "final_loss": round(final_loss, 4),
         "end_step": end_step,
         "devices": n_dev,
+        "n_layers": cfg.n_layers,
+        "d_model": cfg.d_model,
     }
+    if cfg.n_experts > 1:
+        # FLOPs-active parameter count for honest MoE MFU: sparse
+        # dispatch computes ~top_k/E of the expert weights per token
+        # (capacity padding excluded — it inflates buffers, not useful
+        # FLOPs); dense dispatch computes every expert.
+        from jax import tree_util
+
+        expert_params = sum(
+            leaf.size
+            for path, leaf in tree_util.tree_flatten_with_path(
+                state["params"]
+            )[0]
+            if any(
+                getattr(k, "key", None) in ("w_in", "w_out") for k in path
+            )
+        )
+        frac = (
+            cfg.moe_top_k / cfg.n_experts
+            if cfg.moe_dispatch == "sparse"
+            else 1.0
+        )
+        result["n_experts"] = cfg.n_experts
+        result["moe_dispatch"] = cfg.moe_dispatch
+        result["active_params_m"] = round(
+            (n_params - expert_params + expert_params * frac) / 1e6, 1
+        )
 
     if eval_file:
         # Held-out evaluation: same objective as training (shared
@@ -616,6 +649,10 @@ def main(argv=None) -> int:
         "default 0 = off); spreads the router across experts",
     )
     p.add_argument(
+        "--layers", type=int, default=None, dest="n_layers",
+        help="override the config's layer count (experiment sizing)",
+    )
+    p.add_argument(
         "--param-dtype", choices=("float32", "bfloat16"), default=None,
         dest="param_dtype",
         help="parameter storage dtype (default float32); bfloat16 halves "
@@ -668,6 +705,7 @@ def main(argv=None) -> int:
         remat=True if args.remat else None,
         remat_policy=args.remat_policy,
         param_dtype=args.param_dtype,
+        n_layers=args.n_layers,
         donate=args.donate,
         attn_impl=args.attn_impl,
         xent_impl=args.xent_impl,

@@ -134,6 +134,12 @@ class TestCreation:
         env = runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
         assert env["JAX_COMPILATION_CACHE_DIR"] == str(tmp_path / "xc")
         assert (tmp_path / "xc").is_dir()
+        # Persist-everything rides along (round 4): the tunnel's remote-
+        # compile round trip (~2s regardless of program size) is not
+        # counted by jax's default 1s persistence threshold, so the
+        # programs that gain most would never be cached — measured warm
+        # schedule-to-first-step 3.16s -> 1.35s with this injection.
+        assert env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "0"
 
         override = new_job(name="cachejob2", workers=0)
         override.spec.replica_specs[ReplicaType.MASTER].template.env[
@@ -144,6 +150,17 @@ class TestCreation:
         env2 = runner.envs[replica_name(key2, ReplicaType.MASTER, 0)]
         # Injection defers to the template; spawn-time merge applies /custom.
         assert "JAX_COMPILATION_CACHE_DIR" not in env2
+
+        # A template that pins its own persistence threshold wins too.
+        override3 = new_job(name="cachejob3", workers=0)
+        override3.spec.replica_specs[ReplicaType.MASTER].template.env[
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"
+        ] = "2.5"
+        key3 = store.add(override3)
+        rec.sync(key3)
+        env3 = runner.envs[replica_name(key3, ReplicaType.MASTER, 0)]
+        assert env3["JAX_COMPILATION_CACHE_DIR"] == str(tmp_path / "xc")
+        assert "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in env3
 
     def test_no_duplicate_creation_on_resync(self):
         store, runner, _, _, rec = make_harness()

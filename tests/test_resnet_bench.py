@@ -87,25 +87,29 @@ class TestBench:
         import bench
 
         result = bench.run(["--smoke", "--steps", "2", "--warmup", "1"])
+        # Round-4 shape: the artifact LEADS with the flagship LM (the
+        # MFU carrier); ResNet rides as the continuity sub-block.
         assert set(result) == {
             "metric",
             "value",
             "unit",
-            "vs_baseline",
-            "llama",
+            "mfu",
+            "config",
+            "seq_len",
+            "final_loss",
+            "resnet",
             "schedule_to_first_step_s",
         }
         assert result["value"] > 0
-        assert result["unit"] == "images/sec/chip"
-        # The flagship LM rides in the same artifact (VERDICT r2 #1:
-        # driver-captured numbers can't drift), with the MFU block.
-        lm = result["llama"]
-        assert lm["unit"] == "tokens/sec/chip" and lm["value"] > 0
-        assert set(lm["mfu"]) == {
+        assert result["unit"] == "tokens/sec/chip"
+        assert set(result["mfu"]) == {
             "model_tflops_per_sec",
             "vs_peak_pct",
             "vs_sustained_matmul_pct",
         }
+        rn = result["resnet"]
+        assert rn["unit"] == "images/sec/chip" and rn["value"] > 0
+        assert rn["vs_baseline"] > 0
         # The latency probe runs REAL supervisor jobs even in smoke mode
         # (with a pre-warmed standby, the production daemon config);
         # both phases must come back measured, not None.
@@ -118,7 +122,10 @@ class TestBench:
         result = bench.run(
             ["--smoke", "--steps", "2", "--warmup", "1", "--no-latency"]
         )
-        assert set(result) == {"metric", "value", "unit", "vs_baseline", "llama"}
+        assert set(result) == {
+            "metric", "value", "unit", "mfu", "config", "seq_len",
+            "final_loss", "resnet",
+        }
 
     def test_mfu_math(self):
         import bench
@@ -272,7 +279,8 @@ class TestGraftEntry:
 
         fn, args = g.entry()
         out = jax.eval_shape(fn, *args)
-        assert out.shape == (8, 1000)
+        # Flagship LM (llama 0.3b): logits [batch, seq, vocab].
+        assert out.shape == (4, 1024, 32000)
 
     def test_dryrun_multichip_8(self, capsys):
         import __graft_entry__ as g
