@@ -26,7 +26,21 @@ import time
 import numpy as np
 import pytest
 
-import tests.jaxenv  # noqa: F401
+# 8 virtual devices time-slice ONE physical core here, so the slowest
+# collective participant reaches its rendezvous ~7x later than the
+# fastest; at 8B scale that spread exceeds XLA:CPU's default 40s
+# termination timeout and the run is killed mid-AllGather (observed
+# first-hand). Raise the stuck/terminate budgets — must land in
+# XLA_FLAGS before the CPU client is created.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags
+        + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
+        + " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
+    ).strip()
+
+import tests.jaxenv  # noqa: F401,E402
 
 pytestmark = pytest.mark.skipif(
     not os.environ.get("TPUJOB_RUN_8B"),
