@@ -356,6 +356,32 @@ def run(argv=None) -> dict:
         except Exception as e:
             log(f"[bench] real-data llama bench failed: {e!r}")
 
+    # ---- MFU at scale: the 1.1B config (largest that fits the chip —
+    # bf16 params + adafactor + 'dots' remat at batch 2). The 0.3b
+    # headline's 63% MFU is bounded by per-step floors that amortize
+    # with width; this block shows the ceiling tracks the hardware
+    # (BASELINE.md round-4 "MFU vs scale": 76% of sustained).
+    llama_1b_block = None
+    if not args.smoke:
+        try:
+            cfg_1b = llama_lib.llama_1b()
+            r1b = llama_train.run(
+                config="1b", batch_size=2, seq_len=4096, steps=12,
+                warmup=2, optimizer="adafactor", param_dtype="bfloat16",
+                remat=True, remat_policy="dots", donate=True,
+                log=lambda m: log(f"[bench] {m}"),
+            )
+            f1b = r1b["value"] * lm_train_flops_per_token(
+                r1b["params_m"] * 1e6, cfg_1b.n_layers, cfg_1b.d_model, 4096
+            )
+            llama_1b_block = metric_block(r1b, f1b)
+            llama_1b_block.update(
+                config="1b", params_m=r1b["params_m"], seq_len=4096
+            )
+            llama_1b_block["metric"] = "scale_" + llama_1b_block["metric"]
+        except Exception as e:
+            log(f"[bench] 1b scale bench failed: {e!r}")
+
     # ---- MoE: the winning sparse-dispatch config end-to-end on the chip
     # (VERDICT r3 Missing #3 / Next #3); MFU uses FLOPs-ACTIVE params
     # (top_k/E of expert weights), not total.
@@ -455,6 +481,8 @@ def run(argv=None) -> dict:
         out = resnet_block
     if llama_data_block is not None:
         out["llama_real_data"] = llama_data_block
+    if llama_1b_block is not None:
+        out["llama_1b_scale"] = llama_1b_block
     if moe_block is not None:
         out["moe"] = moe_block
     if bert_block is not None:

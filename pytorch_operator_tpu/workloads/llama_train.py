@@ -40,6 +40,7 @@ def synthetic_bigram_batch(batch: int, seq_len: int, vocab: int, step: int):
 
 CONFIGS = {
     "8b": "llama3_8b",
+    "1b": "llama_1b",
     "0.3b": "llama_0_3b",
     "tiny": "llama_tiny",
 }

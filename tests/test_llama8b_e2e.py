@@ -33,12 +33,13 @@ import pytest
 # first-hand). Raise the stuck/terminate budgets — must land in
 # XLA_FLAGS before the CPU client is created.
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_cpu_collective_call_terminate_timeout_seconds" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags
-        + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
-        + " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
-    ).strip()
+for _flag in (
+    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=3600",
+    "--xla_cpu_collective_call_terminate_timeout_seconds=7200",
+):
+    if _flag.split("=")[0] not in _flags:
+        _flags = f"{_flags} {_flag}".strip()
+os.environ["XLA_FLAGS"] = _flags
 
 import tests.jaxenv  # noqa: F401,E402
 

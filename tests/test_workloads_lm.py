@@ -345,3 +345,41 @@ def test_llama_max_steps_caps_work(tmp_path, monkeypatch):
         log=lambda *_: None,
     )
     assert r2["end_step"] == 8
+
+
+def test_llama_1b_plan_fits_one_v5e_chip():
+    """The MFU-vs-scale config (BASELINE.md round-4): ~1.14B params, and
+    its measured on-chip recipe — bf16 params + adafactor + batch 2 —
+    must fit v5e HBM with the 'dots'-remat residuals. Abstract
+    (eval_shape): no compile, no arrays."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pytorch_operator_tpu.models import llama as llama_lib
+
+    cfg = llama_lib.llama_1b(param_dtype=jnp.bfloat16)
+    model = llama_lib.Llama(cfg)
+    tx = optax.adafactor(1e-3)
+
+    def abstract_state(key):
+        params = model.init(key, np.zeros((1, 32), np.int32))["params"]
+        return {"params": params, "opt_state": tx.init(params)}
+
+    abstract = jax.eval_shape(abstract_state, jax.random.key(0))
+    n_params = sum(
+        math.prod(x.shape) for x in jax.tree.leaves(abstract["params"])
+    )
+    assert 1.0e9 < n_params < 1.3e9, f"param count {n_params/1e9:.2f}B"
+
+    state_bytes = sum(
+        math.prod(x.shape) * jnp.dtype(x.dtype).itemsize
+        for x in jax.tree.leaves(abstract)
+    )
+    # bf16 params + factored adafactor stats ~= 2.5 GiB; grads (bf16,
+    # transient) + batch-2 'dots' residuals (~7 GiB measured headroom)
+    # keep the whole step under v5e's 16 GiB — the measured recipe.
+    assert state_bytes < 4 * 2**30, f"state {state_bytes/2**30:.1f} GiB"
