@@ -128,9 +128,9 @@ def llama3_8b(**over) -> LlamaConfig:
 
     Defaults to the pallas flash kernel: at this scale the S×S score
     materialization dominates attention HBM traffic (3.5 ms vs 75 ms dense
-    fwd at S=8192 — BASELINE.md). flash_attention falls back to dense
-    automatically when the tiling doesn't fit (S that doesn't divide into
-    lane/sublane-aligned blocks, or D not lane-aligned). Also defaults to
+    fwd at S=8192 — BASELINE.md). flash_attention zero-pads unaligned
+    shapes to the kernel tiling and masks the padding (round 4; no dense
+    fallback cliff). Also defaults to
     the chunked-vocab loss: [B,S,128256] f32 logits would otherwise be the
     single largest activation in the step.
     """

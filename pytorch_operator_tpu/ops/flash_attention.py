@@ -24,6 +24,11 @@ Design notes:
   outside the kernel (keeps every grid cell's output block private).
 - Matmuls run in the input dtype (bf16 in production) with
   ``preferred_element_type=float32``; softmax math is float32.
+- Unaligned shapes (S not divisible by the blocks; D not lane-aligned)
+  are zero-padded to the tiling and masked via a static ``kv_len``
+  (padded key columns score -inf; padded query rows are sliced off), so
+  e.g. ViT's S=197/D=64 runs the O(S·D) kernel instead of falling back
+  to a dense O(S^2) path (round 4).
 - Multi-device: pass ``mesh`` — the call is wrapped in a partial-manual
   ``shard_map`` over the dp/fsdp (batch) and tp (heads) axes, composing
   with the pjit-sharded training step the same way parallel/ring.py does
@@ -50,19 +55,58 @@ class _FlashCfg(NamedTuple):
     block_k: int
     groups: int  # query heads per kv head (GQA)
     interpret: bool
+    # Softmax scale — 1/sqrt(d) of the TRUE head dim: when the wrapper
+    # zero-pads D to lane alignment, sqrt(padded D) would be wrong.
+    scale: float
+    # Keys/values at positions >= kv_len are masked out (score = -inf).
+    # None = no length mask (every position is real). Static: this is the
+    # one TRUE sequence length of a padded-to-alignment batch, not a
+    # per-example length.
+    kv_len: Optional[int] = None
+
+
+def _mask_scores(cfg: _FlashCfg, s, i, j, bq: int, bk: int):
+    """Element-level score masking shared by all three kernels (forward
+    and backward MUST mask identically): causal upper triangle and/or
+    key columns >= kv_len score _NEG."""
+    import jax
+    import jax.numpy as jnp
+
+    if not cfg.causal and cfg.kv_len is None:
+        return s
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+    keep = cols <= rows if cfg.causal else True
+    if cfg.kv_len is not None:
+        keep = keep & (cols < cfg.kv_len)
+    return jnp.where(keep, s, _NEG)
+
+
+def _live_block(cfg: _FlashCfg, i, j, bq: int, bk: int):
+    """Predicate for K blocks with at least one unmasked column under the
+    causal and/or kv_len masks (None = every block live). ``i``/``j`` are
+    the q/k block program ids of the calling grid."""
+    live = None
+    if cfg.causal:
+        live = j * bk <= i * bq + bq - 1
+    if cfg.kv_len is not None:
+        past = j * bk < cfg.kv_len
+        live = past if live is None else live & past
+    return live
 
 
 # ---------------------------------------------------------------- kernels
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, cfg: _FlashCfg, scale: float):
+                *, cfg: _FlashCfg):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     i, j = pl.program_id(1), pl.program_id(2)
     bq, bk = cfg.block_q, cfg.block_k
+    scale = cfg.scale
 
     @pl.when(j == 0)
     def _init():
@@ -76,10 +120,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale                          # [bq, bk] f32
-        if cfg.causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
-            s = jnp.where(cols <= rows, s, _NEG)
+        s = _mask_scores(cfg, s, i, j, bq, bk)
         m_prev = m_ref[:, :1]              # [bq, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)    # [bq, 1]
@@ -95,12 +136,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         acc_ref[:] = acc_ref[:] * alpha + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
 
-    if cfg.causal:
-        # Skip K blocks entirely above the diagonal: their first column
-        # starts after this Q block's last row.
-        pl.when(j * bk <= i * bq + bq - 1)(compute)
-    else:
+    live = _live_block(cfg, i, j, bq, bk)
+    if live is None:
         compute()
+    else:
+        # Skip K blocks with no unmasked column: above the causal
+        # diagonal, or entirely past kv_len.
+        pl.when(live)(compute)
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _finish():
@@ -112,13 +154,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, cfg: _FlashCfg, scale: float):
+               dq_acc, *, cfg: _FlashCfg):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     i, j = pl.program_id(1), pl.program_id(2)
     bq, bk = cfg.block_q, cfg.block_k
+    scale = cfg.scale
 
     @pl.when(j == 0)
     def _init():
@@ -129,10 +172,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if cfg.causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
-            s = jnp.where(cols <= rows, s, _NEG)
+        s = _mask_scores(cfg, s, i, j, bq, bk)
         p = jnp.exp(s - lse_ref[0, :, :1])          # [bq, bk] f32
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -143,10 +183,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         )
 
-    if cfg.causal:
-        pl.when(j * bk <= i * bq + bq - 1)(compute)
-    else:
+    live = _live_block(cfg, i, j, bq, bk)
+    if live is None:
         compute()
+    else:
+        pl.when(live)(compute)
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _finish():
@@ -154,13 +195,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, cfg: _FlashCfg, scale: float):
+                dk_ref, dv_ref, dk_acc, dv_acc, *, cfg: _FlashCfg):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
     j, i = pl.program_id(1), pl.program_id(2)  # K block outer, Q block inner
     bq, bk = cfg.block_q, cfg.block_k
+    scale = cfg.scale
 
     @pl.when(i == 0)
     def _init():
@@ -172,10 +214,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if cfg.causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
-            s = jnp.where(cols <= rows, s, _NEG)
+        s = _mask_scores(cfg, s, i, j, bq, bk)
         p = jnp.exp(s - lse_ref[0, :, :1])          # [bq, bk] f32
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -190,11 +229,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )                                           # ds^T @ q → [bk, D]
 
-    if cfg.causal:
-        # This K block only sees Q blocks at or below the diagonal.
-        pl.when(i * bq + bq - 1 >= j * bk)(compute)
-    else:
+    live = _live_block(cfg, i, j, bq, bk)
+    if live is None:
         compute()
+    else:
+        # Causal: this K block only sees Q blocks at or below the
+        # diagonal. kv_len: K blocks past the true length are all-masked.
+        pl.when(live)(compute)
 
     @pl.when(i == pl.num_programs(2) - 1)
     def _finish():
@@ -237,12 +278,11 @@ def _flash_fwd_call(q, k, v, cfg: _FlashCfg):
     from jax.experimental.pallas import tpu as pltpu
 
     BH, S, D = q.shape
-    scale = 1.0 / math.sqrt(D)
     grid = (BH, S // cfg.block_q, S // cfg.block_k)
     q_spec, kv_spec, row_spec = _specs(cfg, D, kv_from_j=True)
 
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, cfg=cfg, scale=scale),
+        functools.partial(_fwd_kernel, cfg=cfg),
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[
@@ -269,7 +309,6 @@ def _flash_bwd_call(q, k, v, o, lse, do, cfg: _FlashCfg):
     from jax.experimental.pallas import tpu as pltpu
 
     BH, S, D = q.shape
-    scale = 1.0 / math.sqrt(D)
     # delta_i = rowsum(dO_i · O_i) — cheap, XLA fuses it. Broadcast over the
     # 128-lane dim to match the lse tiling layout.
     delta = jnp.broadcast_to(
@@ -279,7 +318,7 @@ def _flash_bwd_call(q, k, v, o, lse, do, cfg: _FlashCfg):
 
     q_spec, kv_spec, row_spec = _specs(cfg, D, kv_from_j=True)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, cfg=cfg, scale=scale),
+        functools.partial(_dq_kernel, cfg=cfg),
         grid=(BH, S // cfg.block_q, S // cfg.block_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=pl.BlockSpec((1, cfg.block_q, D), lambda b, i, j: (b, i, 0)),
@@ -290,7 +329,7 @@ def _flash_bwd_call(q, k, v, o, lse, do, cfg: _FlashCfg):
 
     q_spec, kv_spec, row_spec = _specs(cfg, D, kv_from_j=False)
     dkx, dvx = pl.pallas_call(
-        functools.partial(_dkv_kernel, cfg=cfg, scale=scale),
+        functools.partial(_dkv_kernel, cfg=cfg),
         grid=(BH, S // cfg.block_k, S // cfg.block_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=[
@@ -359,6 +398,31 @@ def _flash(q, k, v, cfg: _FlashCfg):
 # ------------------------------------------------------------- public API
 
 
+def _plan_tiling(S: int, D: int, block_q: int, block_k: int, interpret: bool):
+    """Resolve block sizes and padded dims for a (possibly unaligned)
+    shape: returns ``(block_q, block_k, S_pad, D_pad)`` with
+    ``S_pad % block_q == S_pad % block_k == 0`` and, on real TPU
+    (``interpret=False``), Mosaic's tiling minima honored: q-blocks
+    sublane-aligned (%8), k-blocks and D lane-aligned (%128). Pure
+    arithmetic — unit-testable for the TPU branch on any backend."""
+    min_bq, min_bk = (8, 128) if not interpret else (1, 1)
+    D_pad = -(-D // 128) * 128 if not interpret else D
+    align = max(min_bq, min_bk)
+    S_min = -(-S // align) * align  # smallest aligned padded length
+    block_q = -(-min(block_q, S_min) // min_bq) * min_bq
+    block_k = -(-min(block_k, S_min) // min_bk) * min_bk
+    lcm = block_q * block_k // math.gcd(block_q, block_k)
+    if lcm > max(block_q, block_k):
+        # Unequal blocks where neither divides the other would pad S up
+        # to their lcm — potentially several silent extra blocks of
+        # work. Collapse both to the smaller size (lane-aligned, which
+        # also satisfies the sublane minimum): at most one padded block.
+        lcm = block_q = block_k = max(
+            min(block_q, block_k) // min_bk * min_bk, min_bk
+        )
+    return block_q, block_k, -(-S // lcm) * lcm, D_pad
+
+
 def flash_attention(
     q,
     k,
@@ -367,6 +431,7 @@ def flash_attention(
     causal: bool = True,
     block_q: int = 1024,
     block_k: int = 1024,
+    kv_len: Optional[int] = None,
     mesh=None,
     interpret: Optional[bool] = None,
 ):
@@ -374,9 +439,23 @@ def flash_attention(
     with ``H % KH == 0`` (GQA). Returns ``[B,S,H,D]`` in q's dtype.
 
     Assumes rotary/positional encoding is already applied and token order
-    is the standard causal layout (positions = arange). Falls back to the
-    dense XLA implementation when shapes don't fit the kernel's tiling
-    (S not divisible by the block sizes; D not lane-aligned on real TPU).
+    is the standard causal layout (positions = arange).
+
+    Shapes that don't fit the kernel's tiling (S not divisible by the
+    block sizes; on real TPU also D % 128 != 0) are zero-PADDED to
+    alignment and masked: padded key columns score -inf via the kernel's
+    ``kv_len`` mask, padded query rows are sliced off the output, and the
+    softmax scale stays 1/sqrt(true D) — numerics equal the dense oracle
+    (round 4; previously these shapes fell back to the dense O(S^2)
+    path, e.g. ViT's S=197/D=64, which materialized 12 layers x [B,H,
+    197,197] f32 scores per step). The O(pad) extra FLOPs are bounded by
+    one block row/column; HBM stays O(S·D).
+
+    ``kv_len``: static TRUE sequence length when the caller's batch is
+    already padded to S — keys/values at positions >= kv_len are masked
+    out. One length for the whole batch (per-example lengths would need
+    an array operand; compose ragged batches with segment packing
+    instead).
 
     Default block sizes were swept on a TPU v5 lite chip. Round 2's
     kernel-level sweep picked 512/1024 (matches or beats the in-tree
@@ -397,39 +476,31 @@ def flash_attention(
     assert H % KH == 0, f"H={H} not a multiple of KH={KH}"
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
+    if kv_len is not None and not 0 < kv_len <= S:
+        raise ValueError(f"kv_len={kv_len} outside (0, S={S}]")
 
-    block_q, block_k = min(block_q, S), min(block_k, S)
-    if (
-        S % block_q
-        or S % block_k
-        # Real-TPU tiling: lane-aligned D and k-blocks, sublane-aligned
-        # q-blocks. Clamped blocks from short sequences must still align,
-        # else Mosaic rejects the tile (e.g. S=100 → block_q=100).
-        or (not interpret and (D % 128 or block_q % 8 or block_k % 128))
-    ):
-        # Loud fallback: the dense path materializes [B,KH,G,S,S] f32
-        # scores — at long S that is an OOM/perf cliff a user who asked
-        # for flash should hear about, not discover in a memory dump.
-        import warnings
-
-        warnings.warn(
-            f"flash_attention falling back to the DENSE O(S^2) path: "
-            f"shape (S={S}, D={D}) does not fit the kernel tiling "
-            f"(need S divisible by block sizes; on TPU also D%128==0). "
-            f"Expect O(S^2) HBM for the score tensor.",
-            stacklevel=2,
-        )
-        return _dense_reference(q, k, v, causal=causal)
-    cfg = _FlashCfg(causal, block_q, block_k, H // KH, interpret)
+    block_q, block_k, S_pad, D_pad = _plan_tiling(
+        S, D, block_q, block_k, interpret
+    )
+    if S_pad != S and kv_len is None:
+        kv_len = S  # padded key columns must not attend
+    cfg = _FlashCfg(
+        causal, block_q, block_k, H // KH, interpret,
+        1.0 / math.sqrt(D), kv_len,
+    )
 
     def core(q, k, v):
         b, s, h, d = q.shape
         kh = k.shape[2]
-        q3 = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-        k3 = k.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
-        v3 = v.transpose(0, 2, 1, 3).reshape(b * kh, s, d)
+        pad = [(0, 0), (0, S_pad - s), (0, 0), (0, D_pad - d)]
+        if S_pad != s or D_pad != d:
+            q, k, v = (jax.numpy.pad(x, pad) for x in (q, k, v))
+        q3 = q.transpose(0, 2, 1, 3).reshape(b * h, S_pad, D_pad)
+        k3 = k.transpose(0, 2, 1, 3).reshape(b * kh, S_pad, D_pad)
+        v3 = v.transpose(0, 2, 1, 3).reshape(b * kh, S_pad, D_pad)
         o3 = _flash(q3, k3, v3, cfg)
-        return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        o = o3.reshape(b, h, S_pad, D_pad).transpose(0, 2, 1, 3)
+        return o[:, :s, :, :d]
 
     def live(axes):
         return [a for a in axes if mesh is not None and a in mesh.axis_names and mesh.shape[a] > 1]

@@ -38,15 +38,60 @@ def test_forward_matches_dense(causal, H, KH):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_unaligned_short_seq_falls_back_to_dense():
-    """With interpret=False, a short sequence whose clamped blocks are not
-    sublane/lane-aligned (S=100 → block_q=100) must take the dense path
-    BEFORE any pallas call — so this runs fine on the CPU backend."""
+@pytest.mark.parametrize("causal", [True, False])
+def test_unaligned_seq_pads_and_masks(causal):
+    """S not divisible by the blocks is zero-padded to alignment with the
+    kernel's kv_len mask hiding the padded key columns (round 4 —
+    previously these shapes fell back to the dense O(S^2) path). The
+    ViT-shaped case: S=100 padded to 128."""
     import jax
 
-    q, k, v = _rand_qkv(jax.random.key(3), 1, 100, 2, 2, 128, np.float32)
-    out = flash_attention(q, k, v, interpret=False)
-    ref = _dense_reference(q, k, v, causal=True)
+    q, k, v = _rand_qkv(jax.random.key(3), 1, 100, 2, 2, 16, np.float32)
+    out = flash_attention(
+        q, k, v, causal=causal, block_q=64, block_k=64, interpret=True
+    )
+    ref = _dense_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_tiling_plan_tpu_alignment():
+    """The real-TPU tiling plan (pure arithmetic, checkable on CPU):
+    aligned shapes pass through untouched; unaligned ones pad to the
+    Mosaic minima (q-blocks %8, k-blocks and D %128)."""
+    from pytorch_operator_tpu.ops.flash_attention import _plan_tiling
+
+    # The production LM shape: untouched (fast path preserved).
+    assert _plan_tiling(4096, 128, 1024, 1024, False) == (1024, 1024, 4096, 128)
+    # ViT-B @224: S=197 -> one 256 block; D=64 -> 128 lanes.
+    assert _plan_tiling(197, 64, 1024, 1024, False) == (256, 256, 256, 128)
+    # Long unaligned S keeps the swept 1024 blocks, pads S up to them.
+    assert _plan_tiling(5000, 128, 1024, 1024, False) == (1024, 1024, 5120, 128)
+    # User blocks below the minima are bumped, not rejected.
+    assert _plan_tiling(64, 8, 4, 32, False) == (8, 128, 128, 128)
+    # Unequal blocks where neither divides the other collapse to the
+    # smaller size instead of padding S to their lcm (6144 here).
+    assert _plan_tiling(4096, 128, 1024, 1536, False) == (1024, 1024, 4096, 128)
+    # Interpret mode: no alignment minima, only S % block == 0.
+    assert _plan_tiling(48, 8, 32, 32, True) == (32, 32, 64, 8)
+    assert _plan_tiling(17, 8, 1024, 1024, True) == (17, 17, 17, 8)
+
+
+def test_kv_len_masks_tail_keys():
+    """Explicit kv_len: keys/values at positions >= kv_len must not
+    contribute — equals the dense oracle run on the truncated K/V."""
+    import jax
+    import jax.numpy as jnp
+
+    B, S, H, KH, D, L = 1, 64, 2, 2, 16, 37
+    q, k, v = _rand_qkv(jax.random.key(6), B, S, H, KH, D, np.float32)
+    out = flash_attention(
+        q, k, v, causal=False, kv_len=L, block_q=16, block_k=16,
+        interpret=True,
+    )
+    # Oracle: dense attention over the first L keys only.
+    s = jnp.einsum("bshd,bthd->bhst", q, k[:, :L]) / np.sqrt(D)
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("bhst,bthd->bshd", p, v[:, :L])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
@@ -86,14 +131,32 @@ def test_grads_match_dense(H, KH):
         )
 
 
-def test_fallback_on_odd_shapes():
-    """S not divisible by blocks → dense fallback, still correct."""
+def test_padded_path_grads_match_dense():
+    """Gradients THROUGH the padded path (S=48 padded to 64): the pad /
+    slice pair must be transparent to autodiff and the kv_len mask must
+    zero padded-key contributions in dq/dk/dv."""
     import jax
+    import jax.numpy as jnp
 
     q, k, v = _rand_qkv(jax.random.key(3), 1, 48, 2, 2, 8, np.float32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
+        return jnp.sum(o * jnp.cos(jnp.arange(o.size).reshape(o.shape)))
+
+    def loss_dense(q, k, v):
+        o = _dense_reference(q, k, v, causal=True)
+        return jnp.sum(o * jnp.cos(jnp.arange(o.size).reshape(o.shape)))
+
     out = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
     ref = _dense_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), atol=5e-4, err_msg=f"d{name}"
+        )
 
 
 def test_sharded_under_mesh():
