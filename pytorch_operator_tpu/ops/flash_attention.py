@@ -447,9 +447,13 @@ def flash_attention(
     ``kv_len`` mask, padded query rows are sliced off the output, and the
     softmax scale stays 1/sqrt(true D) — numerics equal the dense oracle
     (round 4; previously these shapes fell back to the dense O(S^2)
-    path, e.g. ViT's S=197/D=64, which materialized 12 layers x [B,H,
-    197,197] f32 scores per step). The O(pad) extra FLOPs are bounded by
-    one block row/column; HBM stays O(S·D).
+    path, which materializes [B,H,S,S] f32 scores). Cost honesty:
+    S-padding is bounded by one extra block row/column, but D-padding
+    MULTIPLIES the attention FLOPs and q/k/v/o bytes by D_pad/D (2x for
+    D=64) — a win at long S where the kernel's O(S·D) HBM beats the
+    dense path's O(S^2) (measured 2.9x at S=5000, BASELINE.md), NOT for
+    short-S/thin-D models: ViT-B (S=197, D=64) measured 41% SLOWER
+    under the padded kernel than dense XLA and keeps its dense default.
 
     ``kv_len``: static TRUE sequence length when the caller's batch is
     already padded to S — keys/values at positions >= kv_len are masked

@@ -180,6 +180,28 @@ def test_sharded_under_mesh():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_sharded_unaligned_pads_under_mesh():
+    """Padding composes with the shard_map wrapper: the pad/slice happen
+    per-shard inside the manual region (S and D are unsharded axes)."""
+    import jax
+
+    from pytorch_operator_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    B, S, H, KH, D = 4, 27, 4, 2, 8  # S pads to 32 under 16-blocks
+    q, k, v = _rand_qkv(jax.random.key(7), B, S, H, KH, D, np.float32)
+
+    @jax.jit
+    def run(q, k, v):
+        return flash_attention(
+            q, k, v, block_q=16, block_k=16, mesh=mesh, interpret=True
+        )
+
+    out = run(q, k, v)
+    ref = _dense_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_bf16_forward_close():
     import jax
     import jax.numpy as jnp
