@@ -413,6 +413,38 @@ def run(argv=None) -> dict:
         except Exception as e:
             log(f"[bench] moe bench failed: {e!r}")
 
+    # ---- int8 weight-only decode: the serving-side lever (round 4).
+    # Decode is weight-streaming bound from ~1B scale; per-channel int8
+    # halves the streamed bytes vs the bf16-cast control (measured
+    # +51%/+30%/+17% at batch 1/8/32 on the 1b config) and is what fits
+    # Llama-3-8B decode on ONE 16 GB chip (BASELINE.md round-4). The
+    # same-session A/B is captured inside the block.
+    decode_block = None
+    if not args.smoke:
+        try:
+            from pytorch_operator_tpu.workloads import generate as gen_mod
+
+            gr = gen_mod.run(
+                config="1b", batch_size=8, prompt_len=128,
+                max_new_tokens=128, quantize="int8",
+                compare_unquantized=True,
+                log=lambda m: log(f"[bench] {m}"),
+            )
+            decode_block = {
+                "metric": "int8_" + gr["metric"],
+                "value": gr["value"],
+                "unit": gr["unit"],
+                "config": gr["config"],
+                "batch": gr["batch"],
+                "weight_mb": gr["weight_mb"],
+                "unquantized_tokens_per_sec_per_chip": gr[
+                    "tokens_per_sec_per_chip_unquantized"
+                ],
+                "int8_speedup": gr["int8_speedup"],
+            }
+        except Exception as e:
+            log(f"[bench] int8 decode bench failed: {e!r}")
+
     # ---- BERT + ViT: driver-captured like the LM (hand-recorded BASELINE
     # rows drift; artifact numbers cannot). Short runs — each block is
     # best-effort and must not sink the headline benches.
@@ -485,6 +517,8 @@ def run(argv=None) -> dict:
         out["llama_1b_scale"] = llama_1b_block
     if moe_block is not None:
         out["moe"] = moe_block
+    if decode_block is not None:
+        out["decode_int8"] = decode_block
     if bert_block is not None:
         out["bert"] = bert_block
     if vit_block is not None:

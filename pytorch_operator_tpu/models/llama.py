@@ -96,6 +96,20 @@ class LlamaConfig:
     # program regardless of how much of the cache is filled.
     decode: bool = False
     max_decode_len: int = 2048
+    # Weight-only quantization mode (inference): "int8" makes apply()
+    # expect a params tree produced by ``ops.quantize.quantize_tree``
+    # (QuantizedTensor leaves — int8 payload + per-channel scales).
+    # Dequantization happens INSIDE each consuming module via
+    # nn.map_variables — critically, inside the layer-scan body, so the
+    # per-layer weights are dequantized AFTER the scan slices them and
+    # the convert+scale fuses into each matmul's operand read. A
+    # top-level tree dequant instead turns the stacked [L, ...] weights
+    # into materialized full-precision scan inputs (measured 2.1x
+    # SLOWER than the f32 control at 1b on the chip — the failure mode
+    # this field exists to avoid). Plain-array trees still work in this
+    # mode (dequant is identity), which is what the same-program
+    # quantized-vs-full A/B in workloads/generate.py rides on.
+    quantize: Optional[str] = None
 
     def __post_init__(self):
         if (
@@ -525,8 +539,14 @@ class Llama(nn.Module):
     def head_kernel(params):
         """The LM-head weight [D, V] out of a params tree (unboxed) — the
         model-owned accessor the chunked-loss trainer path uses, so head
-        naming stays out of shared infrastructure."""
+        naming stays out of shared infrastructure. Dequantizes an int8
+        leaf (the consumer's matmul fuses the convert — plain dots do;
+        see LlamaConfig.quantize)."""
+        from ..ops.quantize import QuantizedTensor
+
         w = params["lm_head"]["kernel"]
+        if isinstance(w, QuantizedTensor):
+            return w.dequantize()
         return w.unbox() if hasattr(w, "unbox") else w
 
     @nn.compact
@@ -560,7 +580,20 @@ class Llama(nn.Module):
 
             jax.debug.callback(_assert_uniform, positions)
 
-        embed = nn.Embed(
+        dequant = None
+        if cfg.quantize:
+            if self.is_initializing():
+                raise ValueError(
+                    "a quantize-mode model cannot init: init the "
+                    "full-precision model and quantize its params with "
+                    "ops.quantize.quantize_tree"
+                )
+            from ..ops.quantize import dequantize_tree as dequant
+
+        embed_cls = (
+            nn.map_variables(nn.Embed, "params", dequant) if dequant else nn.Embed
+        )
+        embed = embed_cls(
             cfg.vocab_size,
             cfg.d_model,
             dtype=cfg.dtype,
@@ -577,6 +610,11 @@ class Llama(nn.Module):
             block = nn.remat(
                 Block, prevent_cse=False, policy=remat_policy(cfg)
             )
+        if dequant:
+            # INSIDE the scan wrapper: the scan slices the stacked int8
+            # leaves first, this dequantizes the slice in the body (see
+            # LlamaConfig.quantize).
+            block = nn.map_variables(block, "params", dequant)
         ScanBlocks = nn.scan(
             block,
             # Per-layer stacking for params, the decode KV cache, and
@@ -592,7 +630,12 @@ class Llama(nn.Module):
         (x, _), _ = ScanBlocks(cfg, self.mesh, name="layers")((x, positions), None)
 
         x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
-        lm_head = nn.DenseGeneral(
+        head_cls = (
+            nn.map_variables(nn.DenseGeneral, "params", dequant)
+            if dequant
+            else nn.DenseGeneral
+        )
+        lm_head = head_cls(
             cfg.vocab_size, use_bias=False,
             dtype=jnp.float32, param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(
@@ -687,6 +730,10 @@ def _pp_parts(model: "Llama", params, mesh):
 
     cfg = model.cfg
     n_stages = mesh.shape["pp"]
+    if cfg.quantize:
+        raise ValueError(
+            "quantize-mode params (inference) cannot run the pp pipeline"
+        )
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={n_stages}"

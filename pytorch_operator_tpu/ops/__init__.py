@@ -8,3 +8,8 @@ O(S^2) score materialization.
 """
 
 from .flash_attention import flash_attention  # noqa: F401
+from .quantize import (  # noqa: F401
+    QuantizedTensor,
+    dequantize_tree,
+    quantize_tree,
+)

@@ -31,6 +31,14 @@ def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
     (tokens [B, max_new_tokens], cache)``. ``model`` must be built with
     ``cfg.decode=True``; greedy when ``temperature == 0``.
 
+    ``params`` may contain :class:`ops.quantize.QuantizedTensor` leaves
+    (weight-only int8) when ``model.cfg.quantize`` is set: the MODEL
+    dequantizes per consuming module — inside the layer-scan body, after
+    the scan slices the stacked leaves — so the weights stay int8 in HBM
+    and the convert+scale fuses into each matmul's operand read (see
+    LlamaConfig.quantize for why a top-level tree dequant is the wrong
+    place: it materializes full-precision scan inputs every step).
+
     CONTRACT (inherited from ``Llama._decode_attend``): every prompt row
     must occupy the same positions — i.e. an unpadded, equal-length
     prompt batch. Left-padded/ragged prompts would attend wrongly (the
@@ -106,11 +114,21 @@ def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
 
 
 def init_cache(model, batch: int, prompt_len: int):
-    """Zero KV cache for ``model`` (cfg.decode=True), shaped by init."""
+    """Zero KV cache for ``model`` (cfg.decode=True), shaped by init.
+
+    Cache shapes don't depend on how the weights are stored, so a
+    quantize-mode model (which refuses to init) is shaped via its
+    full-precision twin."""
+    import dataclasses as _dc
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from ..models.llama import Llama
+
+    if getattr(model.cfg, "quantize", None):
+        model = Llama(_dc.replace(model.cfg, quantize=None), model.mesh)
     shapes = jax.eval_shape(
         lambda k: model.init(k, np.zeros((batch, prompt_len), np.int32)),
         jax.random.key(0),
@@ -125,6 +143,9 @@ def run(
     prompt_len: int = 64,
     max_new_tokens: int = 64,
     temperature: float = 0.0,
+    quantize: str | None = None,
+    init_host: bool = False,
+    compare_unquantized: bool = False,
     seed: int = 0,
     log=print,
 ) -> dict:
@@ -135,10 +156,25 @@ def run(
     from ..models import llama as llama_lib
     from .llama_train import CONFIGS
 
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize={quantize!r} not in (None, 'int8')")
+    if init_host and not quantize:
+        # Host init exists exactly for models whose full-precision tree
+        # does not fit device HBM (8B f32 = 32 GB > 16 GB); without
+        # quantization the transferred tree wouldn't fit either.
+        raise ValueError("init_host requires quantize='int8'")
+    if compare_unquantized and (not quantize or init_host):
+        # The same-session A/B needs both trees resident — exactly what
+        # init_host models cannot do.
+        raise ValueError(
+            "compare_unquantized requires quantize and not init_host"
+        )
+
     cfg = getattr(llama_lib, CONFIGS[config])(
         decode=True,
         max_decode_len=prompt_len + max_new_tokens,
         attn_impl="dense",  # decode attends against the cache directly
+        quantize=quantize,
     )
     model = llama_lib.Llama(cfg)
     log(
@@ -148,18 +184,53 @@ def run(
         f"({jax.devices()[0].platform})"
     )
 
-    @jax.jit
     def make_params(key):
-        train_cfg = dataclasses.replace(cfg, decode=False)
+        train_cfg = dataclasses.replace(cfg, decode=False, quantize=None)
         return llama_lib.Llama(train_cfg).init(
             key, jnp.zeros((1, prompt_len), jnp.int32)
         )["params"]
 
     import flax.linen as nn
 
-    params = nn.meta.unbox(make_params(jax.random.key(seed)))
+    import contextlib
+
+    # init_host: full-precision init + quantization on the HOST CPU
+    # backend (the 8B tree is 32 GB f32 — twice this chip's HBM), then
+    # only the int8 tree crosses to the device. This is the path that
+    # puts Llama-3-8B decode on ONE 16 GB v5e chip (BASELINE.md).
+    init_ctx = (
+        jax.default_device(jax.local_devices(backend="cpu")[0])
+        if init_host
+        else contextlib.nullcontext()
+    )
+    with init_ctx:
+        params = nn.meta.unbox(jax.jit(make_params)(jax.random.key(seed)))
     n_params = sum(p.size for p in jax.tree.leaves(params))
     log(f"[generate] {n_params / 1e6:.1f}M params (random init — no tokenizer here)")
+
+    weight_bytes = None
+    params_fp = None
+    if quantize:
+        from ..ops import quantize as quant_lib
+
+        t0 = time.time()
+        if init_host:
+            with jax.default_device(jax.local_devices(backend="cpu")[0]):
+                qparams = quant_lib.quantize_tree(params)
+            del params
+            qparams = jax.device_put(qparams, jax.devices()[0])
+        else:
+            if compare_unquantized:
+                params_fp = params
+            qparams = jax.jit(quant_lib.quantize_tree)(params)
+        qparams = jax.block_until_ready(qparams)
+        params = qparams
+        weight_bytes = quant_lib.tree_bytes(params)
+        log(
+            f"[generate] int8 weight-only quantization: {weight_bytes / 1e9:.2f} "
+            f"GB on device (f32 would be {4 * n_params / 1e9:.2f} GB) "
+            f"+{time.time() - t0:.1f}s"
+        )
 
     prompt = jnp.asarray(
         np.random.default_rng(seed).integers(
@@ -169,21 +240,30 @@ def run(
     )
     gen = make_generate(model, max_new_tokens=max_new_tokens, temperature=temperature)
 
-    cache = init_cache(model, batch_size, prompt_len)
-    t0 = time.time()
-    toks, cache = gen(params, cache, prompt, jax.random.key(seed))
-    jax.block_until_ready(toks)
-    log(f"[generate] compile + first generation +{time.time() - t0:.1f}s")
-
-    # Timed: fresh cache per rep, real fence, best of 3 (tunneled
-    # backends throw occasional multi-second dispatch outliers).
-    dt = float("inf")
-    for rep in range(3):
+    def timed(run_params, label):
+        """Compile, then best-of-3 with a fresh cache per rep and a real
+        device_get fence (tunneled backends throw occasional
+        multi-second dispatch outliers)."""
         cache = init_cache(model, batch_size, prompt_len)
         t0 = time.time()
-        toks, cache = gen(params, cache, prompt, jax.random.key(seed + 1 + rep))
-        int(jax.device_get(toks[0, -1]))
-        dt = min(dt, time.time() - t0)
+        toks, _ = gen(run_params, cache, prompt, jax.random.key(seed))
+        jax.block_until_ready(toks)
+        log(f"[generate] {label}: compile + first generation +{time.time() - t0:.1f}s")
+        best = float("inf")
+        for rep in range(3):
+            cache = init_cache(model, batch_size, prompt_len)
+            t0 = time.time()
+            toks, _ = gen(run_params, cache, prompt, jax.random.key(seed + 1 + rep))
+            int(jax.device_get(toks[0, -1]))
+            best = min(best, time.time() - t0)
+        return best
+
+    dt = timed(params, quantize or "full-precision")
+    dt_fp = None
+    if params_fp is not None:
+        # Same-session A/B: the unquantized control through the same
+        # jitted program (a distinct compile — the param pytree differs).
+        dt_fp = timed(params_fp, "full-precision control")
     new_tokens = batch_size * max_new_tokens
     tps = new_tokens / dt
     n_dev = jax.device_count()
@@ -197,7 +277,7 @@ def run(
         f"{tps:,.0f} tokens/sec decode ({1000 * dt / max_new_tokens:.1f} "
         f"ms/step at batch {batch_size})"
     )
-    return {
+    result = {
         "metric": "llama_decode_tokens_per_sec_per_chip",
         "value": round(tps / n_dev, 1),
         "unit": "tokens/sec/chip",
@@ -208,6 +288,15 @@ def run(
         "max_new_tokens": max_new_tokens,
         "devices": n_dev,
     }
+    if quantize:
+        result["quantize"] = quantize
+        result["weight_mb"] = round(weight_bytes / 1e6, 2)
+    if dt_fp is not None:
+        result["tokens_per_sec_per_chip_unquantized"] = round(
+            new_tokens / dt_fp / n_dev, 1
+        )
+        result["int8_speedup"] = round(dt_fp / dt, 3)
+    return result
 
 
 def main(argv=None) -> int:
@@ -219,6 +308,23 @@ def main(argv=None) -> int:
     p.add_argument("--prompt-len", type=int, default=64)
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument(
+        "--quantize", choices=["int8"], default=None,
+        help="weight-only quantization: matmul weights stored int8 in "
+        "HBM with per-channel scales, dequant fused into each matmul "
+        "(ops/quantize.py) — 4x less weight traffic than f32",
+    )
+    p.add_argument(
+        "--init-host", action="store_true",
+        help="initialize + quantize params on the host CPU and transfer "
+        "only the int8 tree (for models whose full-precision tree "
+        "exceeds HBM, e.g. --config 8b); requires --quantize",
+    )
+    p.add_argument(
+        "--compare-unquantized", action="store_true",
+        help="also time the full-precision params in the same session "
+        "(A/B evidence for the int8 win); requires --quantize",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
@@ -230,6 +336,9 @@ def main(argv=None) -> int:
         prompt_len=args.prompt_len,
         max_new_tokens=args.max_new_tokens,
         temperature=args.temperature,
+        quantize=args.quantize,
+        init_host=args.init_host,
+        compare_unquantized=args.compare_unquantized,
         seed=args.seed,
         log=lambda msg: print(msg, flush=True),
     )
