@@ -112,6 +112,13 @@ class LlamaConfig:
     quantize: Optional[str] = None
 
     def __post_init__(self):
+        if self.quantize not in (None, "int8"):
+            # Fail at construction, matching the workload entry point —
+            # any truthy value would otherwise silently run the int8
+            # dequant hook.
+            raise ValueError(
+                f"quantize={self.quantize!r} not in (None, 'int8')"
+            )
         if (
             self.n_experts > 0
             and self.moe_dispatch == "sparse"

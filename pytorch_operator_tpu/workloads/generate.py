@@ -125,10 +125,8 @@ def init_cache(model, batch: int, prompt_len: int):
     import jax.numpy as jnp
     import numpy as np
 
-    from ..models.llama import Llama
-
     if getattr(model.cfg, "quantize", None):
-        model = Llama(_dc.replace(model.cfg, quantize=None), model.mesh)
+        model = model.clone(cfg=_dc.replace(model.cfg, quantize=None))
     shapes = jax.eval_shape(
         lambda k: model.init(k, np.zeros((batch, prompt_len), np.int32)),
         jax.random.key(0),

@@ -146,6 +146,49 @@ class TestLlamaImport:
         )
         assert toks.shape == (1, 8)
 
+    def test_imported_weights_quantize_and_decode_int8(self):
+        """The serving path end to end: a real (HF-layout) checkpoint
+        imports, quantizes to int8 (the importer's tree uses the same
+        param vocabulary the contraction-axis rule keys on), and
+        decodes through the quantize-mode model bit-identically to the
+        eagerly-dequantized control."""
+        import dataclasses
+
+        import jax
+
+        from pytorch_operator_tpu.ops.quantize import (
+            QuantizedTensor,
+            dequantize_tree,
+            quantize_tree,
+        )
+        from pytorch_operator_tpu.workloads.generate import (
+            init_cache,
+            make_generate,
+        )
+
+        cfg = _cfg()
+        params = import_hf_llama_state_dict(_random_state_dict(cfg), cfg)
+        qparams = quantize_tree(params)
+        assert isinstance(
+            qparams["layers"]["attn"]["q_proj"]["kernel"], QuantizedTensor
+        )
+        dcfg = dataclasses.replace(
+            cfg, decode=True, max_decode_len=24, quantize="int8"
+        )
+        model = llama_lib.Llama(dcfg)
+        prompt = np.random.default_rng(3).integers(0, 64, (1, 8)).astype(np.int32)
+        gen = make_generate(model, max_new_tokens=8)
+        t_q, _ = gen(
+            qparams, init_cache(model, 1, 8), prompt, jax.random.key(0)
+        )
+        t_e, _ = gen(
+            dequantize_tree(qparams),
+            init_cache(model, 1, 8),
+            prompt,
+            jax.random.key(0),
+        )
+        np.testing.assert_array_equal(np.asarray(t_q), np.asarray(t_e))
+
     def test_bf16_tensors_and_tied_embeddings(self):
         """Real checkpoints ship bf16 and may tie lm_head to the
         embedding table — both must import."""

@@ -121,6 +121,12 @@ class TestQuantize:
         # The router's argmax is precision-sensitive — never quantized.
         assert not isinstance(moe["gate"], QuantizedTensor)
 
+    def test_unknown_quantize_mode_rejected_at_config(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="quantize"):
+            llama_lib.llama_tiny(quantize="int4")
+
     def test_rule_skips_low_rank_leaves(self):
         import jax.numpy as jnp
 
