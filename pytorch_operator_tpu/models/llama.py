@@ -96,6 +96,15 @@ class LlamaConfig:
     # program regardless of how much of the cache is filled.
     decode: bool = False
     max_decode_len: int = 2048
+    # KV-cache quantization (decode only): "int8" stores cached_key/
+    # cached_value as int8 with per-(token, kv-head) f32 scales
+    # (amax/127 over head_dim), quantized at write time, dequantized
+    # inside the attention einsums (the convert+scale fuses into the
+    # dot's operand read — the cache is a scan CARRY, not a scan input,
+    # so no materialization issue arises). Halves cache HBM: the lever
+    # that fits long-context 8B serving on one chip next to the int8
+    # weights (BASELINE.md round-4). Independent of ``quantize``.
+    kv_quantize: Optional[str] = None
     # Weight-only quantization mode (inference): "int8" makes apply()
     # expect a params tree produced by ``ops.quantize.quantize_tree``
     # (QuantizedTensor leaves — int8 payload + per-channel scales).
@@ -118,6 +127,10 @@ class LlamaConfig:
             # dequant hook.
             raise ValueError(
                 f"quantize={self.quantize!r} not in (None, 'int8')"
+            )
+        if self.kv_quantize not in (None, "int8"):
+            raise ValueError(
+                f"kv_quantize={self.kv_quantize!r} not in (None, 'int8')"
             )
         if (
             self.n_experts > 0
@@ -348,9 +361,9 @@ class Attention(nn.Module):
     def _decode_attend(self, q, k, v, positions):
         """KV-cache attention (prefill AND single-token decode steps).
 
-        Cache: ``cached_key``/``cached_value`` [B, max_decode_len, K, D]
-        in the flax "cache" collection, written in place at the current
-        positions; scores run q against the FULL cache with a
+        Cache: ``cached_key``/``cached_value`` [B, K, max_decode_len, D]
+        (heads-major) in the flax "cache" collection, written in place
+        at the current positions; scores run q against the FULL cache with a
         position-validity mask (col_pos <= row_pos), so the program shape
         is static no matter how much of the cache is filled.
 
@@ -365,27 +378,76 @@ class Attention(nn.Module):
         cfg = self.cfg
         B, S, K, G, D = q.shape
         L = cfg.max_decode_len
+        kv8 = cfg.kv_quantize == "int8"
+        cache_dtype = jnp.int8 if kv8 else cfg.dtype
+        # Heads-major [B, K, L, D] layout: each (b, k) head's [L, D]
+        # panel is contiguous for the attention dots. (Measured neutral
+        # vs seq-major on its own — XLA picks physical layouts — but it
+        # is the natural shape for the per-layer slabs decode_forward
+        # threads, and the einsums below read it without relayout.)
         ck = self.variable(
-            "cache", "cached_key", jnp.zeros, (B, L, K, D), cfg.dtype
+            "cache", "cached_key", jnp.zeros, (B, K, L, D), cache_dtype
         )
         cv = self.variable(
-            "cache", "cached_value", jnp.zeros, (B, L, K, D), cfg.dtype
+            "cache", "cached_value", jnp.zeros, (B, K, L, D), cache_dtype
         )
+        if kv8:
+            # Per-(token, kv-head) scales: amax/127 over head_dim — one
+            # f32 per D int8 payload bytes (3% overhead at D=128).
+            ks = self.variable(
+                "cache", "key_scale", jnp.zeros, (B, K, L, 1), jnp.float32
+            )
+            vs = self.variable(
+                "cache", "value_scale", jnp.zeros, (B, K, L, 1), jnp.float32
+            )
         if not self.is_initializing():
             # The incoming S tokens sit at contiguous positions starting
             # at positions[:, 0] (prefill: the whole prompt from 0;
             # decode: one token at the current index).
             start = positions[0, 0]
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, start, 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, start, 0, 0)
-            )
-        kc, vc = ck.value, cv.value
+            k_in = k.swapaxes(1, 2)  # [B, K, S, D]
+            v_in = v.swapaxes(1, 2)
+            if kv8:
+                from ..ops.quantize import quantize
+
+                kq, vq = quantize(k_in, axis=-1), quantize(v_in, axis=-1)
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, kq.q, (0, 0, start, 0)
+                )
+                ks.value = jax.lax.dynamic_update_slice(
+                    ks.value, kq.scale, (0, 0, start, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, vq.q, (0, 0, start, 0)
+                )
+                vs.value = jax.lax.dynamic_update_slice(
+                    vs.value, vq.scale, (0, 0, start, 0)
+                )
+            else:
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k_in.astype(cfg.dtype), (0, 0, start, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v_in.astype(cfg.dtype), (0, 0, start, 0)
+                )
+        if kv8:
+            # Convert-ONLY on the big slabs (int8 -> 256 levels is exact
+            # in a bf16 mantissa); the per-token scales fold into the
+            # TINY score/prob tensors after the dots. A fused
+            # convert+scale on the slab defeats operand fusion and
+            # materializes a full-precision copy per layer per step —
+            # measured -9% vs the fp cache at 1b/b8/L=4096, where this
+            # formulation measures +43% (BASELINE.md round-4).
+            kc, vc = ck.value.astype(cfg.dtype), cv.value.astype(cfg.dtype)
+        else:
+            kc, vc = ck.value, cv.value
         scores = jnp.einsum(
-            "bskgd,btkd->bkgst", q, kc, preferred_element_type=jnp.float32
+            "bskgd,bktd->bkgst", q, kc, preferred_element_type=jnp.float32
         ) / jnp.sqrt(D).astype(jnp.float32)
+        if kv8:
+            # scores[b,k,g,s,t] · key_scale[b,k,t]: the K dequant, moved
+            # past the dot (linear in K).
+            scores = scores * ks.value.squeeze(-1)[:, :, None, None, :]
         col = jnp.arange(L)[None, :]            # cache position
         row = positions[0][:, None]             # query position
         scores = jnp.where(
@@ -394,7 +456,12 @@ class Attention(nn.Module):
             jnp.finfo(jnp.float32).min,
         )
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bkgst,btkd->bskgd", probs, vc)
+        if kv8:
+            # The V dequant, folded into probs (linear in V).
+            probs = (
+                probs * vs.value.squeeze(-1)[:, :, None, None, :]
+            ).astype(cfg.dtype)
+        out = jnp.einsum("bkgst,bktd->bskgd", probs, vc)
         out = out.reshape(B, S, K * G * D)
         out = nn.with_logical_constraint(out, ("batch", "seq", None))
         return self._o_proj(out)
@@ -678,6 +745,106 @@ class Llama(nn.Module):
         return train_value_and_grad_pp(
             self, params, tokens, mesh=mesh, microbatches=microbatches
         )
+
+
+def init_decode_cache(cfg: LlamaConfig, batch: int):
+    """Zero KV cache for :func:`decode_forward`: a flat per-layer dict
+    (``layer_0`` .. ``layer_{n-1}``), each holding the slab the block's
+    attention declares — NOT the flax-scan stacked form. The flat form
+    is the point: per-layer slabs flow as plain scan-carry leaves, so a
+    decode step's only cache writes are one token-slice
+    dynamic_update_slice per layer, updated in place."""
+    B, L, K, D = batch, cfg.max_decode_len, cfg.n_kv_heads, cfg.head_dim
+    kv8 = cfg.kv_quantize == "int8"
+
+    def slab():
+        # Fresh arrays per layer: shared buffers would alias when the
+        # caller donates the cache into the jitted generate.
+        s = {
+            "cached_key": jnp.zeros(
+                (B, K, L, D), jnp.int8 if kv8 else cfg.dtype
+            ),
+            "cached_value": jnp.zeros(
+                (B, K, L, D), jnp.int8 if kv8 else cfg.dtype
+            ),
+        }
+        if kv8:
+            s["key_scale"] = jnp.zeros((B, K, L, 1), jnp.float32)
+            s["value_scale"] = jnp.zeros((B, K, L, 1), jnp.float32)
+        return s
+
+    return {f"layer_{i}": {"attn": slab()} for i in range(cfg.n_layers)}
+
+
+def decode_forward(
+    model: "Llama",
+    params,
+    cache,
+    tokens,
+    positions=None,
+    *,
+    return_hidden: bool = True,
+):
+    """The SERVING forward: numerically identical to
+    ``Llama(decode=True).apply`` (pinned by test), but with the layer
+    loop UNROLLED and the KV cache as an explicit argument/return
+    (:func:`init_decode_cache` layout) instead of a flax-scan-lifted
+    collection.
+
+    Why this exists: under ``nn.scan(variable_axes={"cache": 0})`` every
+    decode step dynamic-slices each layer's whole slab out of the
+    stacked cache, rewrites it wholesale, and copies the stack — an
+    xplane profile at 1b/b8/L=4096 showed 16 of 22.3 ms/step going to
+    exactly that (copy 30% + DS/DUS fusions 43%; BASELINE.md round-4).
+    Here each layer's slab is a plain carry leaf: the step reads it once
+    (fused into the attention einsums) and writes ONE token slice in
+    place. Quantized (``cfg.quantize``) trees are dequantized per layer
+    at the use site — python-unrolled, so there is no scan-input
+    materialization hazard and no map_variables hook is needed.
+
+    Returns ``(hidden_or_logits, new_cache)``.
+    """
+    from ..ops.quantize import QuantizedTensor, dequantize_tree
+
+    cfg = model.cfg
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[-1], dtype=jnp.int32), tokens.shape
+        )
+    p = nn.meta.unbox(params)
+
+    table = p["embed"]["embedding"]
+    if isinstance(table, QuantizedTensor):
+        # Gather rows first, dequantize the gathered rows (per-row
+        # scales) — never the whole table.
+        x = (
+            table.q[tokens].astype(jnp.float32) * table.scale[tokens]
+        ).astype(cfg.dtype)
+    else:
+        x = table.astype(cfg.dtype)[tokens]
+
+    block = Block(cfg, model.mesh)
+    new_cache = {}
+    for i in range(cfg.n_layers):
+        # Static per-layer slice; QuantizedTensor is a pytree node, so
+        # its q/scale fields are sliced like any other stacked leaf.
+        lp = dequantize_tree(jax.tree.map(lambda a: a[i], p["layers"]))
+        with nn.logical_axis_rules(()):
+            ((x, _pos), _), upd = block.apply(
+                {"params": lp, "cache": cache[f"layer_{i}"]},
+                (x, positions),
+                None,
+                mutable=["cache"],
+            )
+        new_cache[f"layer_{i}"] = upd["cache"]
+
+    x = RMSNorm(cfg.rms_eps).apply(
+        {"params": dequantize_tree(p["final_norm"])}, x
+    )
+    if return_hidden:
+        return x, new_cache
+    w = Llama.head_kernel(p)
+    return x.astype(jnp.float32) @ w.astype(jnp.float32), new_cache
 
 
 def forward_pp(

@@ -31,13 +31,14 @@ def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
     (tokens [B, max_new_tokens], cache)``. ``model`` must be built with
     ``cfg.decode=True``; greedy when ``temperature == 0``.
 
-    ``params`` may contain :class:`ops.quantize.QuantizedTensor` leaves
-    (weight-only int8) when ``model.cfg.quantize`` is set: the MODEL
-    dequantizes per consuming module — inside the layer-scan body, after
-    the scan slices the stacked leaves — so the weights stay int8 in HBM
-    and the convert+scale fuses into each matmul's operand read (see
-    LlamaConfig.quantize for why a top-level tree dequant is the wrong
-    place: it materializes full-precision scan inputs every step).
+    Rides :func:`models.llama.decode_forward` — the unrolled serving
+    path whose only per-step cache writes are one token-slice per layer
+    (the flax scan-lifted path rewrites every slab every step; see that
+    docstring). ``params`` may contain
+    :class:`ops.quantize.QuantizedTensor` leaves (weight-only int8):
+    each layer's slice is dequantized at its use site, so the weights
+    stay int8 in HBM and the convert+scale fuses into each matmul's
+    operand read.
 
     CONTRACT (inherited from ``Llama._decode_attend``): every prompt row
     must occupy the same positions — i.e. an unpadded, equal-length
@@ -50,6 +51,8 @@ def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
 
     import jax
     import jax.numpy as jnp
+
+    from ..models.llama import decode_forward
 
     def sample(logits, rng):
         if temperature == 0.0:
@@ -77,29 +80,19 @@ def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
                 f"prompt_len {Sp} + max_new_tokens {max_new_tokens} "
                 f"exceeds cfg.max_decode_len {L}"
             )
-        hidden, upd = model.apply(
-            {"params": params, "cache": cache},
-            prompt,
-            return_hidden=True,
-            mutable=["cache"],
-        )
-        cache = upd["cache"]
+        hidden, cache = decode_forward(model, params, cache, prompt)
         rng, k = jax.random.split(rng)
         tok = sample(last_logits(params, hidden), k)
 
         def step(carry, _):
             cache, tok, pos, rng = carry
             positions = jnp.broadcast_to(pos, (B, 1))
-            h, upd = model.apply(
-                {"params": params, "cache": cache},
-                tok[:, None],
-                positions,
-                return_hidden=True,
-                mutable=["cache"],
+            h, cache = decode_forward(
+                model, params, cache, tok[:, None], positions
             )
             rng, k = jax.random.split(rng)
             nxt = sample(last_logits(params, h), k)
-            return (upd["cache"], nxt, pos + 1, rng), tok
+            return (cache, nxt, pos + 1, rng), tok
 
         (cache, last, _, _), toks = jax.lax.scan(
             step,
@@ -113,25 +106,14 @@ def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
     return generate
 
 
-def init_cache(model, batch: int, prompt_len: int):
-    """Zero KV cache for ``model`` (cfg.decode=True), shaped by init.
+def init_cache(model, batch: int, prompt_len: int = 0):
+    """Zero KV cache for ``model`` (cfg.decode=True) in the
+    :func:`models.llama.decode_forward` flat per-layer layout.
+    ``prompt_len`` is accepted for signature compatibility; the cache
+    is statically sized by ``cfg.max_decode_len`` alone."""
+    from ..models.llama import init_decode_cache
 
-    Cache shapes don't depend on how the weights are stored, so a
-    quantize-mode model (which refuses to init) is shaped via its
-    full-precision twin."""
-    import dataclasses as _dc
-
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    if getattr(model.cfg, "quantize", None):
-        model = model.clone(cfg=_dc.replace(model.cfg, quantize=None))
-    shapes = jax.eval_shape(
-        lambda k: model.init(k, np.zeros((batch, prompt_len), np.int32)),
-        jax.random.key(0),
-    )["cache"]
-    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    return init_decode_cache(model.cfg, batch)
 
 
 def run(
@@ -140,8 +122,10 @@ def run(
     batch_size: int = 8,
     prompt_len: int = 64,
     max_new_tokens: int = 64,
+    max_decode_len: int | None = None,
     temperature: float = 0.0,
     quantize: str | None = None,
+    kv_quantize: str | None = None,
     init_host: bool = False,
     compare_unquantized: bool = False,
     seed: int = 0,
@@ -170,9 +154,14 @@ def run(
 
     cfg = getattr(llama_lib, CONFIGS[config])(
         decode=True,
-        max_decode_len=prompt_len + max_new_tokens,
+        # The cache is statically sized by max_decode_len; overriding it
+        # beyond prompt+new measures serving at a context budget without
+        # generating the whole window (the step cost is L-dependent
+        # regardless of fill — static shapes).
+        max_decode_len=max_decode_len or (prompt_len + max_new_tokens),
         attn_impl="dense",  # decode attends against the cache directly
         quantize=quantize,
+        kv_quantize=kv_quantize,
     )
     model = llama_lib.Llama(cfg)
     log(
@@ -239,19 +228,23 @@ def run(
     gen = make_generate(model, max_new_tokens=max_new_tokens, temperature=temperature)
 
     def timed(run_params, label):
-        """Compile, then best-of-3 with a fresh cache per rep and a real
-        device_get fence (tunneled backends throw occasional
-        multi-second dispatch outliers)."""
+        """Compile, then best-of-3 with a real device_get fence
+        (tunneled backends throw occasional multi-second dispatch
+        outliers). Reps REUSE the returned (donated-in-place) cache:
+        every readable slot is rewritten before use (the
+        garbage-cannot-leak test pins that reuse and fresh zeros decode
+        identically), and a fresh cache per rep would double-allocate
+        next to the in-flight donated one — measured RESOURCE_EXHAUSTED
+        at the 8B/b8/L=8192 point where cache+weights fill the chip."""
         cache = init_cache(model, batch_size, prompt_len)
         t0 = time.time()
-        toks, _ = gen(run_params, cache, prompt, jax.random.key(seed))
+        toks, cache = gen(run_params, cache, prompt, jax.random.key(seed))
         jax.block_until_ready(toks)
         log(f"[generate] {label}: compile + first generation +{time.time() - t0:.1f}s")
         best = float("inf")
         for rep in range(3):
-            cache = init_cache(model, batch_size, prompt_len)
             t0 = time.time()
-            toks, _ = gen(run_params, cache, prompt, jax.random.key(seed + 1 + rep))
+            toks, cache = gen(run_params, cache, prompt, jax.random.key(seed + 1 + rep))
             int(jax.device_get(toks[0, -1]))
             best = min(best, time.time() - t0)
         return best
@@ -284,11 +277,14 @@ def run(
         "batch": batch_size,
         "prompt_len": prompt_len,
         "max_new_tokens": max_new_tokens,
+        "max_decode_len": cfg.max_decode_len,
         "devices": n_dev,
     }
     if quantize:
         result["quantize"] = quantize
         result["weight_mb"] = round(weight_bytes / 1e6, 2)
+    if kv_quantize:
+        result["kv_quantize"] = kv_quantize
     if dt_fp is not None:
         result["tokens_per_sec_per_chip_unquantized"] = round(
             new_tokens / dt_fp / n_dev, 1
@@ -305,12 +301,23 @@ def main(argv=None) -> int:
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--prompt-len", type=int, default=64)
     p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument(
+        "--max-decode-len", type=int, default=None,
+        help="static cache length (default prompt+new); larger values "
+        "measure serving at a context budget",
+    )
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument(
         "--quantize", choices=["int8"], default=None,
         help="weight-only quantization: matmul weights stored int8 in "
         "HBM with per-channel scales, dequant fused into each matmul "
         "(ops/quantize.py) — 4x less weight traffic than f32",
+    )
+    p.add_argument(
+        "--kv-quantize", choices=["int8"], default=None,
+        help="store the KV cache int8 with per-(token, head) scales — "
+        "halves cache HBM and cache-read traffic; the long-context "
+        "serving lever next to --quantize",
     )
     p.add_argument(
         "--init-host", action="store_true",
@@ -333,8 +340,10 @@ def main(argv=None) -> int:
         batch_size=args.batch_size,
         prompt_len=args.prompt_len,
         max_new_tokens=args.max_new_tokens,
+        max_decode_len=args.max_decode_len,
         temperature=args.temperature,
         quantize=args.quantize,
+        kv_quantize=args.kv_quantize,
         init_host=args.init_host,
         compare_unquantized=args.compare_unquantized,
         seed=args.seed,

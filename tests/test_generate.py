@@ -104,13 +104,15 @@ class TestGenerate:
 
         monkeypatch.setenv("TPUJOB_DEBUG_CHECKS", "1")
         cfg, train_model, decode_model, params, prompt = _setup()
-        cache = init_cache(decode_model, prompt.shape[0], prompt.shape[1])
+        # No cache passed: the flax apply path zero-initializes its own
+        # scan-stacked cache under mutable (init_cache now produces the
+        # decode_forward flat layout, which this path would ignore).
         ragged = jnp.stack(
             [jnp.arange(prompt.shape[1]), jnp.arange(prompt.shape[1]) + 1]
         ).astype(jnp.int32)
         with pytest.raises(Exception, match="batch-uniform"):
             out, _ = decode_model.apply(
-                {"params": params, "cache": cache},
+                {"params": params},
                 prompt,
                 positions=ragged,
                 mutable=["cache"],
@@ -121,7 +123,7 @@ class TestGenerate:
             jnp.arange(prompt.shape[1], dtype=jnp.int32), prompt.shape
         )
         out, _ = decode_model.apply(
-            {"params": params, "cache": cache},
+            {"params": params},
             prompt,
             positions=uniform,
             mutable=["cache"],

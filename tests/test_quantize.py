@@ -266,6 +266,158 @@ class TestQuantizedGenerate:
         assert result["quantize"] == "int8"
 
 
+class TestKVQuantize:
+    def _decode_models(self):
+        cfg = llama_lib.llama_tiny(decode=True, max_decode_len=16)
+        q_cfg = dataclasses.replace(cfg, kv_quantize="int8")
+        return llama_lib.Llama(cfg), llama_lib.Llama(q_cfg)
+
+    def test_cache_layout_int8_with_scales(self):
+        _, qmodel = self._decode_models()
+        cache = init_cache(qmodel, 2, 8)
+        assert set(cache) == {
+            f"layer_{i}" for i in range(qmodel.cfg.n_layers)
+        }
+        layer = cache["layer_0"]["attn"]
+        assert layer["cached_key"].dtype == np.int8
+        assert layer["cached_value"].dtype == np.int8
+        # Heads-major slabs, per-(token, kv-head) f32 scales: one per
+        # head_dim payload row.
+        assert layer["key_scale"].shape == (
+            2, qmodel.cfg.n_kv_heads, 16, 1,
+        )
+        assert layer["key_scale"].dtype == np.float32
+
+    def test_decode_forward_matches_flax_apply(self):
+        """The unrolled serving path (decode_forward — flat per-layer
+        cache, token-slice writes) is numerically IDENTICAL to the flax
+        scan-lifted decode apply, with and without the int8 cache."""
+        import jax
+        import jax.numpy as jnp
+
+        from pytorch_operator_tpu.models.llama import (
+            decode_forward,
+            init_decode_cache,
+        )
+
+        _, _, params = _tiny_params()
+        toks = jnp.asarray(
+            np.random.default_rng(7).integers(0, 256, (2, 8)), jnp.int32
+        )
+        for kv in (None, "int8"):
+            cfg = llama_lib.llama_tiny(
+                decode=True, max_decode_len=16, kv_quantize=kv
+            )
+            model = llama_lib.Llama(cfg)
+            flax_cache = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(
+                    lambda k: model.init(k, np.zeros((2, 8), np.int32)),
+                    jax.random.key(0),
+                )["cache"],
+            )
+            nxt = jnp.full((2, 1), 3, jnp.int32)
+            pos = jnp.full((2, 1), 8, jnp.int32)
+            # Flax path: prefill then one decode step.
+            ref_h, upd = model.apply(
+                {"params": params, "cache": flax_cache},
+                toks,
+                return_hidden=True,
+                mutable=["cache"],
+            )
+            ref_h2, _ = model.apply(
+                {"params": params, "cache": upd["cache"]},
+                nxt,
+                pos,
+                return_hidden=True,
+                mutable=["cache"],
+            )
+            # Functional path, same inputs. Tolerance, not bit-identity:
+            # the flax path executes the layer stack as one compiled
+            # lax.scan while this path unrolls it, and XLA's fusion
+            # boundaries differ — last-ulp reassociation only (the
+            # greedy-rollout gold test pins token-level equality).
+            cache = init_decode_cache(cfg, 2)
+            got_h, cache = decode_forward(model, params, cache, toks)
+            got_h2, _ = decode_forward(model, params, cache, nxt, pos)
+            np.testing.assert_allclose(
+                np.asarray(got_h), np.asarray(ref_h), rtol=2e-5, atol=2e-6
+            )
+            np.testing.assert_allclose(
+                np.asarray(got_h2), np.asarray(ref_h2), rtol=2e-5, atol=2e-6
+            )
+
+    def test_prefill_outputs_close_to_fp_cache(self):
+        """The int8 cache changes K/V by at most scale/2 per element —
+        prefill hidden states must track the fp-cache path within the
+        quantization error, not diverge structurally."""
+        import jax
+
+        model, qmodel = self._decode_models()
+        _, _, params = _tiny_params()
+        toks = np.random.default_rng(5).integers(0, 256, (2, 8))
+        toks = toks.astype(np.int32)
+
+        def prefill(m):
+            # No cache passed: the flax path zero-initializes its own
+            # (scan-stacked) cache under mutable — init_cache's flat
+            # decode_forward layout would be silently ignored here.
+            out, _ = m.apply(
+                {"params": params},
+                toks,
+                return_hidden=True,
+                mutable=["cache"],
+            )
+            return np.asarray(jax.block_until_ready(out))
+
+        ref, got = prefill(model), prefill(qmodel)
+        rms = np.sqrt(((got - ref) ** 2).mean()) / np.sqrt((ref**2).mean())
+        assert rms < 0.02, rms
+
+    def test_generate_runs_and_tracks_fp_rollout(self):
+        """End to end through make_generate: the int8-cache rollout is
+        valid tokens; on this tiny model the greedy path stays within
+        the fp rollout for at least the first steps (argmax margins at
+        random init are far wider than the cache quantization error)."""
+        import jax
+        import jax.numpy as jnp
+
+        model, qmodel = self._decode_models()
+        _, _, params = _tiny_params()
+        prompt = jnp.asarray(
+            np.random.default_rng(6).integers(0, 256, (2, 8)), jnp.int32
+        )
+        new = 6
+        t_fp, _ = make_generate(model, max_new_tokens=new)(
+            params, init_cache(model, 2, 8), prompt, jax.random.key(0)
+        )
+        t_q, _ = make_generate(qmodel, max_new_tokens=new)(
+            params, init_cache(qmodel, 2, 8), prompt, jax.random.key(0)
+        )
+        assert t_q.shape == (2, new)
+        assert ((0 <= np.asarray(t_q)) & (np.asarray(t_q) < 256)).all()
+        np.testing.assert_array_equal(
+            np.asarray(t_q)[:, :2], np.asarray(t_fp)[:, :2]
+        )
+
+    def test_unknown_kv_mode_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="kv_quantize"):
+            llama_lib.llama_tiny(kv_quantize="fp8")
+
+    def test_run_kv_quantized_smoke(self):
+        from pytorch_operator_tpu.workloads import generate as gen_mod
+
+        result = gen_mod.run(
+            config="tiny", batch_size=2, prompt_len=8, max_new_tokens=4,
+            kv_quantize="int8", max_decode_len=32, log=lambda *a: None,
+        )
+        assert result["kv_quantize"] == "int8"
+        assert result["max_decode_len"] == 32
+        assert result["value"] > 0
+
+
 def jnp_dtype():
     import jax.numpy as jnp
 
