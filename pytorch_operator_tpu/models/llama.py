@@ -132,6 +132,14 @@ class LlamaConfig:
             raise ValueError(
                 f"kv_quantize={self.kv_quantize!r} not in (None, 'int8')"
             )
+        if self.decode and self.attn_impl in ("ring", "ulysses"):
+            # The decode prefill runs plain causal self-attention over
+            # the incoming tokens (flash/dense); sequence-parallel
+            # schemes don't compose with the KV-cache write layout.
+            raise ValueError(
+                f"attn_impl={self.attn_impl!r} is not supported with "
+                "decode=True (prefill uses flash/dense self-attention)"
+            )
         if (
             self.n_experts > 0
             and self.moe_dispatch == "sparse"
@@ -326,26 +334,37 @@ class Attention(nn.Module):
             from ..parallel.ulysses import ulysses_self_attention
 
             out = ulysses_self_attention(q, k, v, positions, self.mesh)
-        elif cfg.attn_impl == "flash":
-            # Blockwise pallas kernel; assumes the standard causal layout
-            # (positions = arange), which Llama.__call__ defaults to.
-            from ..ops.flash_attention import flash_attention
-
-            out = flash_attention(
-                q.reshape(B, S, H, D), k, v, causal=True, mesh=self.mesh
-            ).reshape(B, S, K, G, D)
         else:
-            scores = jnp.einsum(
-                "bskgd,btkd->bkgst", q, k, preferred_element_type=jnp.float32
-            ) / jnp.sqrt(D).astype(jnp.float32)
-            causal = jnp.tril(jnp.ones((S, S), dtype=bool))
-            scores = jnp.where(causal, scores, jnp.finfo(jnp.float32).min)
-            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-            out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+            out = self._self_attend(q, k, v)
         out = out.reshape(B, S, H * D)
         out = nn.with_logical_constraint(out, ("batch", "seq", None))
 
         return self._o_proj(out)
+
+    def _self_attend(self, q, k, v):
+        """Causal self-attention over the incoming tokens only (flash or
+        dense per ``cfg.attn_impl``): the non-sequence-parallel train
+        path, and the decode path's PREFILL (a fresh cache's prompt
+        occupies positions [0, S), so attention over the prompt alone is
+        the full causal attention — no [B,K,G,S,L] score tensor against
+        the whole cache budget, which at S=L=8k would be ~17 GB)."""
+        cfg = self.cfg
+        B, S, K, G, D = q.shape
+        if cfg.attn_impl == "flash":
+            # Blockwise pallas kernel; assumes the standard causal layout
+            # (positions = arange), which Llama.__call__ defaults to.
+            from ..ops.flash_attention import flash_attention
+
+            return flash_attention(
+                q.reshape(B, S, K * G, D), k, v, causal=True, mesh=self.mesh
+            ).reshape(B, S, K, G, D)
+        scores = jnp.einsum(
+            "bskgd,btkd->bkgst", q, k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(D).astype(jnp.float32)
+        causal = jnp.tril(jnp.ones((S, S), dtype=bool))
+        scores = jnp.where(causal, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        return jnp.einsum("bkgst,btkd->bskgd", probs, v)
 
     def _o_proj(self, out):
         cfg = self.cfg
@@ -391,6 +410,7 @@ class Attention(nn.Module):
         cv = self.variable(
             "cache", "cached_value", jnp.zeros, (B, K, L, D), cache_dtype
         )
+        ks = vs = None
         if kv8:
             # Per-(token, kv-head) scales: amax/127 over head_dim — one
             # f32 per D int8 payload bytes (3% overhead at D=128).
@@ -430,6 +450,32 @@ class Attention(nn.Module):
                 cv.value = jax.lax.dynamic_update_slice(
                     cv.value, v_in.astype(cfg.dtype), (0, 0, start, 0)
                 )
+        if S > 1:
+            # PREFILL: by the generate contract the prompt lands at
+            # positions [0, S) of a fresh cache, so causal attention
+            # over the incoming tokens alone IS the full attention —
+            # run the standard self-attention path (flash when
+            # configured: O(S·D) blockwise HBM) after the cache writes
+            # above, instead of materializing [B, K, G, S, L] f32
+            # scores against the whole cache budget (~17 GB at S=L=8k
+            # — the long-prompt OOM this branch removes). A nonzero
+            # prefill start would make this silently wrong, so the
+            # TPUJOB_DEBUG_CHECKS callback in ``Llama.__call__``
+            # asserts start == 0 for multi-token inputs.
+            out = self._self_attend(q, k, v)
+        else:
+            out = self._cache_attend(q, positions, ck, cv, ks, vs)
+        out = out.reshape(B, S, K * G * D)
+        out = nn.with_logical_constraint(out, ("batch", "seq", None))
+        return self._o_proj(out)
+
+    def _cache_attend(self, q, positions, ck, cv, ks, vs):
+        """Single-token decode: q against the FULL cache with a
+        position-validity mask (static shapes however much is filled)."""
+        cfg = self.cfg
+        B, S, K, G, D = q.shape
+        L = cfg.max_decode_len
+        kv8 = cfg.kv_quantize == "int8"
         if kv8:
             # Convert-ONLY on the big slabs (int8 -> 256 levels is exact
             # in a bf16 mantissa); the per-token scales fold into the
@@ -461,10 +507,7 @@ class Attention(nn.Module):
             probs = (
                 probs * vs.value.squeeze(-1)[:, :, None, None, :]
             ).astype(cfg.dtype)
-        out = jnp.einsum("bkgst,bktd->bskgd", probs, vc)
-        out = out.reshape(B, S, K * G * D)
-        out = nn.with_logical_constraint(out, ("batch", "seq", None))
-        return self._o_proj(out)
+        return jnp.einsum("bkgst,bktd->bskgd", probs, vc)
 
 
 class MLP(nn.Module):
@@ -632,27 +675,17 @@ class Llama(nn.Module):
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[-1], dtype=jnp.int32), tokens.shape
             )
-        elif (
-            cfg.decode
-            and os.environ.get("TPUJOB_DEBUG_CHECKS", "").lower()
-            not in ("", "0", "false", "no")
-            and not self.is_initializing()
-        ):
+        elif cfg.decode and not self.is_initializing():
             # The decode path's KV-cache write offset and validity mask
             # read positions row 0 (_decode_attend contract) — a ragged
-            # batch is silently wrong, not an error. Debug mode asserts
-            # batch-uniformity ONCE here at the model top (not per
-            # layer); costs one device->host sync per decode step.
-
-            def _assert_uniform(pos):
-                if not (pos == pos[0:1]).all():
-                    raise ValueError(
-                        "decode positions must be batch-uniform (unpadded "
-                        f"equal-length batch); got rows {pos}. Bucket "
-                        "ragged prompts to equal length first."
-                    )
-
-            jax.debug.callback(_assert_uniform, positions)
+            # batch is silently wrong, not an error — and prefill
+            # (S > 1) attends over the incoming tokens only, so a
+            # nonzero start silently drops context. Debug mode asserts
+            # both ONCE at the model top (not per layer); costs one
+            # device->host sync per decode step. decode_forward (the
+            # serving path, which bypasses this __call__) installs the
+            # same check.
+            _debug_check_decode_positions(positions)
 
         dequant = None
         if cfg.quantize:
@@ -747,6 +780,36 @@ class Llama(nn.Module):
         )
 
 
+def _debug_check_decode_positions(positions):
+    """Install the TPUJOB_DEBUG_CHECKS host assert on decode positions:
+    batch-uniform (cache offset/mask read row 0) and, for multi-token
+    inputs (prefill), start == 0 (prefill self-attends — a chunked
+    prefill would silently drop earlier context). No-op unless the env
+    var is set."""
+    import os
+
+    if os.environ.get("TPUJOB_DEBUG_CHECKS", "").lower() in (
+        "", "0", "false", "no",
+    ):
+        return
+
+    def _assert_valid(pos):
+        if not (pos == pos[0:1]).all():
+            raise ValueError(
+                "decode positions must be batch-uniform (unpadded "
+                f"equal-length batch); got rows {pos}. Bucket ragged "
+                "prompts to equal length first."
+            )
+        if pos.shape[-1] > 1 and pos[0, 0] != 0:
+            raise ValueError(
+                "multi-token decode input (prefill) must start at "
+                f"position 0, got {pos[0, 0]}: prefill attends over the "
+                "incoming tokens only (chunked prefill is not supported)."
+            )
+
+    jax.debug.callback(_assert_valid, positions)
+
+
 def init_decode_cache(cfg: LlamaConfig, batch: int):
     """Zero KV cache for :func:`decode_forward`: a flat per-layer dict
     (``layer_0`` .. ``layer_{n-1}``), each holding the slab the block's
@@ -811,6 +874,10 @@ def decode_forward(
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[-1], dtype=jnp.int32), tokens.shape
         )
+    else:
+        # Same TPUJOB_DEBUG_CHECKS contract assert as Llama.__call__
+        # (this path bypasses it): batch-uniform, prefill starts at 0.
+        _debug_check_decode_positions(positions)
     p = nn.meta.unbox(params)
 
     table = p["embed"]["embedding"]

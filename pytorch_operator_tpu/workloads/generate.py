@@ -159,7 +159,10 @@ def run(
         # generating the whole window (the step cost is L-dependent
         # regardless of fill — static shapes).
         max_decode_len=max_decode_len or (prompt_len + max_new_tokens),
-        attn_impl="dense",  # decode attends against the cache directly
+        # attn_impl stays the config's default (flash for the llama
+        # configs): prefill runs causal self-attention over the prompt
+        # (blockwise — long prompts don't materialize scores against
+        # the cache budget); decode steps attend against the cache.
         quantize=quantize,
         kv_quantize=kv_quantize,
     )

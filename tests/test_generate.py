@@ -130,6 +130,73 @@ class TestGenerate:
         )
         jax.block_until_ready(out)
 
+    def test_flash_prefill_matches_dense_prefill(self):
+        """Long-prompt serving: prefill runs causal self-attention over
+        the prompt (flash when configured) instead of materializing
+        scores against the whole cache budget. Flash and dense prefill
+        must agree (same math, blockwise vs materialized) AND produce
+        identical greedy rollouts on the tiny model."""
+        import dataclasses
+
+        import jax
+
+        new = 6
+        cfg, train_model, decode_model, params, prompt = _setup(new=new)
+        flash_model = llama_lib.Llama(
+            dataclasses.replace(decode_model.cfg, attn_impl="flash")
+        )
+        t_dense, _ = make_generate(decode_model, max_new_tokens=new)(
+            params,
+            init_cache(decode_model, prompt.shape[0], prompt.shape[1]),
+            prompt,
+            jax.random.key(0),
+        )
+        t_flash, _ = make_generate(flash_model, max_new_tokens=new)(
+            params,
+            init_cache(flash_model, prompt.shape[0], prompt.shape[1]),
+            prompt,
+            jax.random.key(0),
+        )
+        np.testing.assert_array_equal(np.asarray(t_flash), np.asarray(t_dense))
+
+    def test_debug_checks_reject_nonzero_prefill_start(self, monkeypatch):
+        """Prefill attends over the incoming tokens only — a chunked
+        prefill (multi-token input at a nonzero start) would silently
+        drop the earlier context, so debug mode rejects it."""
+        import jax
+        import jax.numpy as jnp
+        import pytest
+
+        monkeypatch.setenv("TPUJOB_DEBUG_CHECKS", "1")
+        cfg, train_model, decode_model, params, prompt = _setup()
+        shifted = jnp.broadcast_to(
+            jnp.arange(2, 2 + prompt.shape[1], dtype=jnp.int32), prompt.shape
+        )
+        with pytest.raises(Exception, match="position 0"):
+            out, _ = decode_model.apply(
+                {"params": params},
+                prompt,
+                positions=shifted,
+                mutable=["cache"],
+            )
+            jax.block_until_ready(out)
+        # The SERVING path (decode_forward bypasses Llama.__call__) must
+        # install the same guard.
+        from pytorch_operator_tpu.models.llama import (
+            decode_forward,
+            init_decode_cache,
+        )
+
+        with pytest.raises(Exception, match="position 0"):
+            out, _ = decode_forward(
+                decode_model,
+                params,
+                init_decode_cache(decode_model.cfg, prompt.shape[0]),
+                prompt,
+                shifted,
+            )
+            jax.block_until_ready(out)
+
     def test_garbage_cache_contents_cannot_leak(self):
         """Every cache slot the mask allows reading is written by the
         current run first — a cache pre-filled with garbage must produce
