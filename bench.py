@@ -416,9 +416,10 @@ def run(argv=None) -> dict:
     # ---- serving decode: the round-4 inference stack — unrolled
     # decode path (explicit per-layer cache, token-slice writes) +
     # int8 weights + int8 KV, A/B'd against the full-precision control
-    # at a long-context budget (BASELINE.md round-4 "Decode path v2":
-    # 1,714 vs 996 tok/s at this point, 4.8x the round-start path; the
-    # same stack fits Llama-3-8B decode on ONE 16 GB chip).
+    # at a long-context budget (BASELINE.md round-4 "Decode path v2" +
+    # flash prefill: 2,151 vs 970 tok/s at this point, 6.0x the
+    # round-start path; the same stack fits Llama-3-8B decode with an
+    # 8k context on ONE 16 GB chip).
     decode_block = None
     if not args.smoke:
         try:
