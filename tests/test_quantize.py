@@ -400,6 +400,65 @@ class TestKVQuantize:
             np.asarray(t_q)[:, :2], np.asarray(t_fp)[:, :2]
         )
 
+    def test_moe_decode_forward_matches_flax_apply(self):
+        """The unrolled serving path must also carry MoE blocks (router
+        + expert banks slice per layer like any stacked leaf)."""
+        import jax.numpy as jnp
+
+        from pytorch_operator_tpu.models.llama import (
+            decode_forward,
+            init_decode_cache,
+        )
+
+        _, _, params = _tiny_params(n_experts=4, moe_top_k=2)
+        cfg = llama_lib.llama_tiny(
+            decode=True, max_decode_len=16, n_experts=4, moe_top_k=2
+        )
+        model = llama_lib.Llama(cfg)
+        toks = jnp.asarray(
+            np.random.default_rng(8).integers(0, 256, (2, 8)), jnp.int32
+        )
+        ref, _ = model.apply(
+            {"params": params},
+            toks,
+            return_hidden=True,
+            mutable=["cache"],
+        )
+        got, _ = decode_forward(
+            model, params, init_decode_cache(cfg, 2), toks
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6
+        )
+
+    def test_quantized_moe_decode_runs(self):
+        """Quantized expert banks (w_in/w_out QuantizedTensors) slice
+        and dequantize per layer through the serving path."""
+        import jax.numpy as jnp
+
+        from pytorch_operator_tpu.models.llama import (
+            decode_forward,
+            init_decode_cache,
+        )
+
+        _, _, params = _tiny_params(n_experts=4, moe_top_k=2)
+        qparams = quantize_tree(params)
+        cfg = llama_lib.llama_tiny(
+            decode=True, max_decode_len=16, n_experts=4, moe_top_k=2,
+            quantize="int8",
+        )
+        model = llama_lib.Llama(cfg)
+        toks = jnp.asarray(
+            np.random.default_rng(9).integers(0, 256, (2, 8)), jnp.int32
+        )
+        got_q, _ = decode_forward(
+            model, qparams, init_decode_cache(cfg, 2), toks
+        )
+        ref, _ = decode_forward(
+            model, dequantize_tree(qparams), init_decode_cache(cfg, 2), toks
+        )
+        np.testing.assert_array_equal(np.asarray(got_q), np.asarray(ref))
+
     def test_unknown_kv_mode_rejected(self):
         import pytest
 
