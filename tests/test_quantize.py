@@ -459,6 +459,51 @@ class TestKVQuantize:
         )
         np.testing.assert_array_equal(np.asarray(got_q), np.asarray(ref))
 
+    def test_decode_forward_tp_sharded_matches_unsharded(self):
+        """Distributed serving: decode_forward under a dp×fsdp×tp mesh
+        with born-sharded params (logical rules: heads/mlp/vocab over
+        tp, embed over fsdp, batch over dp) produces the unsharded
+        path's hidden states — SPMD partitioning changes collectives,
+        not semantics."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from pytorch_operator_tpu.models.llama import (
+            decode_forward,
+            init_decode_cache,
+        )
+        from pytorch_operator_tpu.parallel import make_mesh
+        from pytorch_operator_tpu.parallel.logical import init_sharded
+
+        cfg = llama_lib.llama_tiny(decode=True, max_decode_len=16)
+        model = llama_lib.Llama(cfg)
+        train_model = llama_lib.Llama(
+            dataclasses.replace(cfg, decode=False)
+        )
+
+        def init_fn(key):
+            return train_model.init(key, np.zeros((1, 8), np.int32))[
+                "params"
+            ]
+
+        mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+        sh_params, _ = init_sharded(init_fn, mesh, jax.random.key(0))
+        _, _, ref_params = _tiny_params()  # same seed, unsharded
+
+        toks = jnp.asarray(
+            np.random.default_rng(11).integers(0, 256, (2, 8)), jnp.int32
+        )
+        ref_h, _ = decode_forward(
+            model, ref_params, init_decode_cache(cfg, 2), toks
+        )
+        got_h, _ = jax.jit(
+            lambda p, c, t: decode_forward(model, p, c, t)
+        )(sh_params, init_decode_cache(cfg, 2), toks)
+        np.testing.assert_allclose(
+            np.asarray(got_h), np.asarray(ref_h), rtol=2e-4, atol=2e-5
+        )
+
     def test_unknown_kv_mode_rejected(self):
         import pytest
 
