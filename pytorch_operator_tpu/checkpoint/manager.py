@@ -41,16 +41,24 @@ class CheckpointManager:
     workloads never import orbax directly and the backend can be swapped.
     """
 
-    def __init__(self, directory: Path | str, max_to_keep: int = 3):
+    def __init__(
+        self, directory: Path | str, max_to_keep: int = 3, create: bool = True
+    ):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = Path(directory).absolute()
-        # One creation mechanism only: parents=True is load-bearing (the
-        # supervisor nests checkpoint dirs several levels under the state
-        # dir), which orbax's CheckpointManagerOptions(create=True) does
-        # not guarantee — so the explicit mkdir owns creation.
-        self.directory.mkdir(parents=True, exist_ok=True)
+        if create:
+            # One creation mechanism only: parents=True is load-bearing
+            # (the supervisor nests checkpoint dirs several levels under
+            # the state dir), which orbax's
+            # CheckpointManagerOptions(create=True) does not guarantee —
+            # so the explicit mkdir owns creation.
+            self.directory.mkdir(parents=True, exist_ok=True)
+        elif not self.directory.is_dir():
+            # Read-only openers (generate --restore) must not leave a
+            # stray directory behind a typo'd path.
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
@@ -78,12 +86,26 @@ class CheckpointManager:
         world-size-change case preemption recovery exists for
         (tests/test_checkpoint.py::test_restore_reshards_across_mesh_shapes
         and the shrink e2e in test_elastic_e2e.py pin this)."""
+        return self._mgr.restore(
+            self._resolve_step(step),
+            args=self._ocp.args.StandardRestore(state_like),
+        )
+
+    def _resolve_step(self, step: Optional[int]) -> int:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        return self._mgr.restore(
-            step, args=self._ocp.args.StandardRestore(state_like)
-        )
+        return step
+
+    def restore_tree(self, step: Optional[int] = None) -> tuple[int, Any]:
+        """Restore the checkpoint AS SAVED — no target tree required
+        (host numpy arrays, saved structure). The serve-side loader:
+        ``tpujob``'s generate workload restores a TRAIN checkpoint this
+        way and picks out ``["params"]`` without needing to reconstruct
+        the training run's optimizer-state structure. Returns
+        ``(step, tree)``."""
+        step = self._resolve_step(step)
+        return step, self._mgr.restore(step)
 
     def restore_or_none(self, state_like: Any) -> Optional[tuple[int, Any]]:
         """(step, state) from the latest checkpoint, or None if there is none

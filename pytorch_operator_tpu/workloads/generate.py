@@ -128,6 +128,7 @@ def run(
     kv_quantize: str | None = None,
     init_host: bool = False,
     compare_unquantized: bool = False,
+    restore: str | None = None,
     seed: int = 0,
     log=print,
 ) -> dict:
@@ -184,19 +185,53 @@ def run(
 
     import contextlib
 
-    # init_host: full-precision init + quantization on the HOST CPU
-    # backend (the 8B tree is 32 GB f32 — twice this chip's HBM), then
-    # only the int8 tree crosses to the device. This is the path that
-    # puts Llama-3-8B decode on ONE 16 GB v5e chip (BASELINE.md).
-    init_ctx = (
-        jax.default_device(jax.local_devices(backend="cpu")[0])
-        if init_host
-        else contextlib.nullcontext()
-    )
-    with init_ctx:
-        params = nn.meta.unbox(jax.jit(make_params)(jax.random.key(seed)))
+    restored_step = None
+    if restore is not None:
+        # Serve a TRAINED checkpoint (the train -> checkpoint -> serve
+        # journey): restore the train state as saved — no optimizer
+        # reconstruction — and keep only its params. Wrong-config
+        # mismatches surface as a friendly shape check below.
+        from ..checkpoint.manager import CheckpointManager
+
+        with CheckpointManager(restore, create=False) as mgr_:
+            restored_step, tree = mgr_.restore_tree()
+        if "params" not in tree:
+            raise ValueError(
+                f"checkpoint under {restore} has no 'params' "
+                f"(top-level keys: {sorted(tree)})"
+            )
+        params = tree["params"]
+        want = (cfg.vocab_size, cfg.d_model)
+        got = params["embed"]["embedding"].shape
+        if tuple(got) != want:
+            raise ValueError(
+                f"checkpoint params don't match --config {config}: "
+                f"embedding {tuple(got)} != {want}"
+            )
+        log(
+            f"[generate] restored params from {restore} "
+            f"(step {restored_step})"
+        )
+    else:
+        # init_host: full-precision init + quantization on the HOST CPU
+        # backend (the 8B tree is 32 GB f32 — twice this chip's HBM),
+        # then only the int8 tree crosses to the device. This is the
+        # path that puts Llama-3-8B decode on ONE 16 GB v5e chip
+        # (BASELINE.md).
+        init_ctx = (
+            jax.default_device(jax.local_devices(backend="cpu")[0])
+            if init_host
+            else contextlib.nullcontext()
+        )
+        with init_ctx:
+            params = nn.meta.unbox(jax.jit(make_params)(jax.random.key(seed)))
     n_params = sum(p.size for p in jax.tree.leaves(params))
-    log(f"[generate] {n_params / 1e6:.1f}M params (random init — no tokenizer here)")
+    src = (
+        f"trained checkpoint, step {restored_step}"
+        if restored_step is not None
+        else "random init — no tokenizer here"
+    )
+    log(f"[generate] {n_params / 1e6:.1f}M params ({src})")
 
     weight_bytes = None
     params_fp = None
@@ -288,6 +323,8 @@ def run(
         result["weight_mb"] = round(weight_bytes / 1e6, 2)
     if kv_quantize:
         result["kv_quantize"] = kv_quantize
+    if restored_step is not None:
+        result["restored_step"] = restored_step
     if dt_fp is not None:
         result["tokens_per_sec_per_chip_unquantized"] = round(
             new_tokens / dt_fp / n_dev, 1
@@ -333,6 +370,12 @@ def main(argv=None) -> int:
         help="also time the full-precision params in the same session "
         "(A/B evidence for the int8 win); requires --quantize",
     )
+    p.add_argument(
+        "--restore", default=None, metavar="CKPT_DIR",
+        help="serve a trained checkpoint: restore params from this "
+        "checkpoint directory (a llama_train run's "
+        "TPUJOB_CHECKPOINT_DIR) instead of random init",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
@@ -349,6 +392,7 @@ def main(argv=None) -> int:
         kv_quantize=args.kv_quantize,
         init_host=args.init_host,
         compare_unquantized=args.compare_unquantized,
+        restore=args.restore,
         seed=args.seed,
         log=lambda msg: print(msg, flush=True),
     )

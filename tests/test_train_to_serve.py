@@ -1,0 +1,101 @@
+"""The train -> checkpoint -> serve journey (generate --restore).
+
+Reference analog: none — the reference orchestrates training pods; what
+its users do next (serve the trained weights) is exactly the journey a
+complete framework must close. Pins that a llama_train checkpoint
+restores into the generate workload WITHOUT reconstructing the training
+run's optimizer state, that the trained weights actually flow (tokens
+differ from random init and reflect the learned bigram structure), and
+that quantized serving composes on top.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import tests.jaxenv  # noqa: F401
+from pytorch_operator_tpu.workloads import generate as gen_mod
+from pytorch_operator_tpu.workloads import llama_train
+
+
+def _train_checkpoint(tmp_path, monkeypatch, steps=30):
+    ckpt = tmp_path / "ckpt"
+    monkeypatch.setenv("TPUJOB_CHECKPOINT_DIR", str(ckpt))
+    result = llama_train.run(
+        config="tiny", batch_size=8, seq_len=32, steps=steps, warmup=1,
+        lr=1e-3, checkpoint_every=steps, log=lambda *_: None,
+    )
+    monkeypatch.delenv("TPUJOB_CHECKPOINT_DIR")
+    return ckpt, result
+
+
+class TestTrainToServe:
+    def test_restore_serves_trained_weights(self, tmp_path, monkeypatch):
+        ckpt, train_result = _train_checkpoint(tmp_path, monkeypatch)
+        assert train_result["final_loss"] < 5.0  # learned past chance
+
+        served = gen_mod.run(
+            config="tiny", batch_size=2, prompt_len=8, max_new_tokens=8,
+            restore=str(ckpt), log=lambda *_: None,
+        )
+        assert served["restored_step"] == train_result["end_step"]
+
+        fresh = gen_mod.run(
+            config="tiny", batch_size=2, prompt_len=8, max_new_tokens=8,
+            log=lambda *_: None,
+        )
+        assert "restored_step" not in fresh
+
+        # The trained weights must actually drive generation: greedy
+        # rollouts from the learned bigram model continue the synthetic
+        # stream (next = 5*tok + 3 mod 256) far better than random init.
+        # Check directly via one forward pass of the served params.
+        from pytorch_operator_tpu.checkpoint.manager import CheckpointManager
+        from pytorch_operator_tpu.models import llama as llama_lib
+
+        with CheckpointManager(ckpt) as mgr:
+            _, tree = mgr.restore_tree()
+        model = llama_lib.Llama(llama_lib.llama_tiny())
+        toks = llama_train.synthetic_bigram_batch(2, 16, 256, step=123)
+        logits = np.asarray(model.apply({"params": tree["params"]}, toks))
+        pred = logits[:, :-1].argmax(-1)
+        want = toks[:, 1:]
+        acc = (pred == want).mean()
+        # Chance is 1/256; 30 tiny-config steps reach ~70%+. Random
+        # init would sit at ~0 — this pins that the TRAINED weights
+        # are what came back.
+        assert acc > 0.5, acc
+
+    def test_restore_composes_with_quantized_serving(
+        self, tmp_path, monkeypatch
+    ):
+        ckpt, _ = _train_checkpoint(tmp_path, monkeypatch, steps=4)
+        served = gen_mod.run(
+            config="tiny", batch_size=2, prompt_len=8, max_new_tokens=4,
+            restore=str(ckpt), quantize="int8", kv_quantize="int8",
+            log=lambda *_: None,
+        )
+        assert served["quantize"] == "int8"
+        assert served["restored_step"] == 5  # 4 steps + 1 warmup
+
+    def test_wrong_config_rejected_with_shape_message(
+        self, tmp_path, monkeypatch
+    ):
+        import pytest
+
+        ckpt, _ = _train_checkpoint(tmp_path, monkeypatch, steps=2)
+        with pytest.raises(ValueError, match="embedding"):
+            gen_mod.run(
+                config="0.3b", batch_size=1, prompt_len=8,
+                max_new_tokens=4, restore=str(ckpt), log=lambda *_: None,
+            )
+
+    def test_missing_checkpoint_is_a_clear_error(self, tmp_path):
+        import pytest
+
+        with pytest.raises(FileNotFoundError, match="no checkpoint"):
+            gen_mod.run(
+                config="tiny", batch_size=1, prompt_len=8,
+                max_new_tokens=4, restore=str(tmp_path / "nope"),
+                log=lambda *_: None,
+            )
