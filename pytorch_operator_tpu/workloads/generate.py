@@ -200,7 +200,12 @@ def run(
                 f"checkpoint under {restore} has no 'params' "
                 f"(top-level keys: {sorted(tree)})"
             )
-        params = tree["params"]
+        # Keep ONLY the params: the saved optimizer state is ~2x params
+        # bytes for adamw and must not stay resident on the host for
+        # the whole serve session (an 8B adamw checkpoint's full state
+        # is ~96 GB — the read happens once, the residency must not).
+        params = tree.pop("params")
+        del tree
         want = (cfg.vocab_size, cfg.d_model)
         got = params["embed"]["embedding"].shape
         if tuple(got) != want:
