@@ -26,7 +26,14 @@ import time
 from ..runtime import rendezvous
 
 
-def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
+def make_generate(
+    model,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+):
     """Build a jitted ``generate(params, cache, prompt, rng) ->
     (tokens [B, max_new_tokens], cache)``. ``model`` must be built with
     ``cfg.decode=True``; greedy when ``temperature == 0``.
@@ -54,12 +61,48 @@ def make_generate(model, *, max_new_tokens: int, temperature: float = 0.0):
 
     from ..models.llama import decode_forward
 
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} not in (0, 1]")
+    if top_k < 0:
+        raise ValueError(f"top_k={top_k} must be 0 (off) or >= 1")
+    if temperature == 0.0 and (top_k > 0 or top_p < 1.0):
+        # T=0 short-circuits to argmax; silently ignoring the knobs
+        # would hand every row the identical greedy rollout.
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (temperature=0 is greedy)"
+        )
+
     def sample(logits, rng):
+        """Greedy at T=0, else categorical over the temperature-scaled
+        logits with optional top-k and/or nucleus (top-p) truncation —
+        static-shape masks off ONE shared descending sort (the sort is
+        the dominant sampling cost on the decode hot path)."""
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(rng, logits / temperature, axis=-1).astype(
-            jnp.int32
-        )
+        logits = logits / temperature
+        neg = jnp.finfo(logits.dtype).min
+        V = logits.shape[-1]
+        if (0 < top_k < V) or top_p < 1.0:
+            sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+            if 0 < top_k < V:
+                # Keep the k highest logits: threshold at the k-th value
+                # (ties at the threshold survive).
+                kth = sorted_desc[..., top_k - 1 : top_k]
+                logits = jnp.where(logits < kth, neg, logits)
+                # Nucleus composes on the TRUNCATED distribution
+                # (HF-style sequential semantics): mask the sorted tail.
+                sorted_desc = jnp.where(
+                    jnp.arange(V) >= top_k, neg, sorted_desc
+                )
+            if top_p < 1.0:
+                # Smallest token set whose cumulative probability
+                # reaches top_p; the top token always survives.
+                probs = jax.nn.softmax(sorted_desc, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+                cutoff = jnp.take_along_axis(sorted_desc, keep, axis=-1)
+                logits = jnp.where(logits < cutoff, neg, logits)
+        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
     def last_logits(params, hidden):
         # Head matmul on the LAST position only: prefill would otherwise
@@ -124,6 +167,8 @@ def run(
     max_new_tokens: int = 64,
     max_decode_len: int | None = None,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     quantize: str | None = None,
     kv_quantize: str | None = None,
     init_host: bool = False,
@@ -268,7 +313,10 @@ def run(
         ),
         jnp.int32,
     )
-    gen = make_generate(model, max_new_tokens=max_new_tokens, temperature=temperature)
+    gen = make_generate(
+        model, max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, top_p=top_p,
+    )
 
     def timed(run_params, label):
         """Compile, then best-of-3 with a real device_get fence
@@ -353,6 +401,16 @@ def main(argv=None) -> int:
     )
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument(
+        "--top-k", type=int, default=0,
+        help="sample only from the k highest-probability tokens "
+        "(0 = off; needs --temperature > 0)",
+    )
+    p.add_argument(
+        "--top-p", type=float, default=1.0,
+        help="nucleus sampling: smallest token set reaching this "
+        "cumulative probability (1.0 = off; needs --temperature > 0)",
+    )
+    p.add_argument(
         "--quantize", choices=["int8"], default=None,
         help="weight-only quantization: matmul weights stored int8 in "
         "HBM with per-channel scales, dequant fused into each matmul "
@@ -393,6 +451,8 @@ def main(argv=None) -> int:
         max_new_tokens=args.max_new_tokens,
         max_decode_len=args.max_decode_len,
         temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
         quantize=args.quantize,
         kv_quantize=args.kv_quantize,
         init_host=args.init_host,

@@ -130,6 +130,67 @@ class TestGenerate:
         )
         jax.block_until_ready(out)
 
+    def test_top_k_one_equals_greedy(self):
+        """top_k=1 at any temperature collapses the distribution to the
+        argmax — must reproduce the greedy rollout exactly."""
+        import jax
+
+        new = 8
+        cfg, train_model, decode_model, params, prompt = _setup(new=new)
+        greedy = make_generate(decode_model, max_new_tokens=new)
+        k1 = make_generate(
+            decode_model, max_new_tokens=new, temperature=2.0, top_k=1
+        )
+        g, _ = greedy(
+            params,
+            init_cache(decode_model, prompt.shape[0], prompt.shape[1]),
+            prompt,
+            jax.random.key(0),
+        )
+        t, _ = k1(
+            params,
+            init_cache(decode_model, prompt.shape[0], prompt.shape[1]),
+            prompt,
+            jax.random.key(0),
+        )
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(t))
+
+    def test_top_k_and_top_p_restrict_samples(self):
+        """Sampled tokens must come from the allowed head of the
+        distribution: with a tiny top_p every draw is (near-)argmax;
+        invalid knob values are rejected up front."""
+        import jax
+        import pytest
+
+        new = 8
+        cfg, train_model, decode_model, params, prompt = _setup(new=new)
+        # top_p -> 0 keeps only the top token (the implementation always
+        # keeps at least one): equals greedy.
+        p0 = make_generate(
+            decode_model, max_new_tokens=new, temperature=3.0, top_p=1e-6
+        )
+        greedy = make_generate(decode_model, max_new_tokens=new)
+        a, _ = p0(
+            params,
+            init_cache(decode_model, prompt.shape[0], prompt.shape[1]),
+            prompt,
+            jax.random.key(1),
+        )
+        b, _ = greedy(
+            params,
+            init_cache(decode_model, prompt.shape[0], prompt.shape[1]),
+            prompt,
+            jax.random.key(1),
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        with pytest.raises(ValueError, match="top_p"):
+            make_generate(decode_model, max_new_tokens=new, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            make_generate(decode_model, max_new_tokens=new, top_k=-1)
+        # Truncation knobs with T=0 would be silently ignored — reject.
+        with pytest.raises(ValueError, match="temperature"):
+            make_generate(decode_model, max_new_tokens=new, top_p=0.9)
+
     def test_flash_prefill_matches_dense_prefill(self):
         """Long-prompt serving: prefill runs causal self-attention over
         the prompt (flash when configured) instead of materializing
