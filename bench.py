@@ -5,9 +5,17 @@ The north-star metric (BASELINE.json:2). The reference published no numbers
 (BASELINE.md), so the baseline is the value established on this hardware in
 round 1; ``vs_baseline`` is measured against it.
 
-Prints exactly ONE JSON line on stdout:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-Diagnostics go to stderr.
+Artifact contract (round-5, VERDICT r4 Weak #1): the driver captures a
+bounded tail of stdout and parses the FINAL line. Round 4's single
+~4.3 KB detail line outgrew that window and the round's numbers were
+lost to the record. So:
+
+  - The LAST stdout line is a COMPACT summary (``compact()``) —
+    top-level metric/value/unit/vs_baseline plus per-block
+    ``{value, unit, ...}`` essentials — pinned by test to stay far
+    under the 2000-byte tail window.
+  - The FULL detail dict goes to stderr and to ``BENCH_DETAIL.json``
+    next to this file.
 
 Usage:
     python bench.py            # full run on the real device (TPU)
@@ -36,6 +44,13 @@ BASELINE_IMAGES_PER_SEC_PER_CHIP = 2667.0
 # Round-2 established Llama-0.3B number (BASELINE.md): flash attention +
 # remat + chunked xent, S=4096, per-chip batch 4 -> 40,580 tokens/sec/chip.
 BASELINE_LLAMA_TOKENS_PER_SEC_PER_CHIP = 40580.0
+
+# Round-4 established serving number (BASELINE.md "Decode path v2"):
+# 1b, batch 8, int8 weights + int8 KV, 4096 cache budget ->
+# 2,151 tokens/sec/chip. The serving continuity anchor (VERDICT r4
+# Weak #2): future rounds detect a serving regression from the artifact
+# alone, exactly as resnet's vs_baseline does for training.
+BASELINE_SERVING_TOKENS_PER_SEC_PER_CHIP = 2151.0
 
 # MFU denominators. Peak: TPU v5e bf16 ~197 TFLOP/s. Sustained: the
 # measured 4096^3 bf16 matmul-chain rate on THIS backend, 160-168 TF/s
@@ -445,6 +460,9 @@ def run(argv=None) -> dict:
                 "quantize": "int8 weights + int8 kv",
                 "fp_tokens_per_sec_per_chip": fp["value"],
                 "int8_stack_speedup": round(q8["value"] / fp["value"], 3),
+                "vs_baseline": round(
+                    q8["value"] / BASELINE_SERVING_TOKENS_PER_SEC_PER_CHIP, 4
+                ),
             }
         except Exception as e:
             log(f"[bench] serving decode bench failed: {e!r}")
@@ -533,5 +551,96 @@ def run(argv=None) -> dict:
     return out
 
 
+def _pick(src: dict, *keys: str) -> dict:
+    """The present subset of ``keys``, rounded floats — compact-line cells."""
+    out = {}
+    for k in keys:
+        v = src.get(k)
+        if v is None:
+            continue
+        out[k] = round(v, 4) if isinstance(v, float) else v
+    return out
+
+
+# Hard ceiling for the compact line, with margin under the driver's
+# 2000-byte tail window (the full line must survive even if a few other
+# stdout bytes share the tail). Pinned by test_resnet_bench.
+COMPACT_MAX_BYTES = 1600
+
+
+def compact(out: dict) -> dict:
+    """The final-stdout-line summary: a strict allowlist per block.
+
+    Everything the judge tracks round-over-round must appear here —
+    flagship LM (value + vs_baseline + MFU), resnet continuity, serving
+    (value + vs_baseline + speedup + latency percentiles), real-data
+    learning evidence, scale/moe MFU, bert/vit, schedule latency —
+    but ONLY the tracked numbers. Full detail lives in the sidecar.
+    """
+    top = _pick(out, "metric", "value", "unit", "vs_baseline", "config")
+    if isinstance(out.get("mfu"), dict):
+        top["mfu_pct"] = out["mfu"].get("vs_sustained_matmul_pct")
+    blocks = {
+        "resnet": ("resnet", ("value", "unit", "vs_baseline")),
+        "real_data": (
+            "llama_real_data",
+            ("value", "eval_loss", "chance_loss", "learned"),
+        ),
+        "scale_1b": ("llama_1b_scale", ("value",)),
+        "moe": ("moe", ("value",)),
+        "serving": (
+            "serving_decode",
+            (
+                "value", "unit", "vs_baseline", "int8_stack_speedup",
+                "quality", "ttft_ms_p50", "ttft_ms_p99",
+                "tpot_ms_p50", "tpot_ms_p99",
+            ),
+        ),
+        "bert": ("bert", ("value", "unit")),
+        "vit": ("vit", ("value", "unit")),
+    }
+    for short, (key, keep) in blocks.items():
+        src = out.get(key)
+        if not isinstance(src, dict):
+            continue
+        cell = _pick(src, *keep)
+        if isinstance(src.get("mfu"), dict):
+            cell["mfu_pct"] = src["mfu"].get("vs_sustained_matmul_pct")
+        if cell:
+            top[short] = cell
+    lat = out.get("schedule_to_first_step_s")
+    if isinstance(lat, dict):
+        top["schedule_to_first_step_s"] = _pick(lat, "cold", "warm")
+    top["detail"] = "BENCH_DETAIL.json"
+    # Defensive backstop: the allowlist keeps this far under the cap,
+    # but a pathological value (e.g. a huge repr leaking into `unit`)
+    # must degrade by dropping sub-blocks, never by breaking the line.
+    # Largest block goes first so one corrupt cell can't evict the
+    # healthy trackers around it.
+    droppable = sorted(
+        (k for k in top if isinstance(top[k], dict)),
+        key=lambda k: len(json.dumps(top[k])),
+    )
+    while len(json.dumps(top)) > COMPACT_MAX_BYTES and droppable:
+        top.pop(droppable.pop())
+    return top
+
+
 if __name__ == "__main__":
-    print(json.dumps(run()))
+    import os
+    from pathlib import Path
+
+    full = run()
+    detail_path = Path(
+        os.environ.get(
+            "TPUJOB_BENCH_DETAIL",
+            Path(__file__).resolve().parent / "BENCH_DETAIL.json",
+        )
+    )
+    try:
+        detail_path.write_text(json.dumps(full, indent=1) + "\n")
+    except OSError as e:
+        print(f"[bench] could not write {detail_path}: {e!r}", file=sys.stderr)
+    print(json.dumps(full), file=sys.stderr, flush=True)
+    # The LAST stdout line — the only thing the driver parses.
+    print(json.dumps(compact(full)), flush=True)
