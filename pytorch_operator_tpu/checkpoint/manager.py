@@ -98,14 +98,64 @@ class CheckpointManager:
         return step
 
     def restore_tree(self, step: Optional[int] = None) -> tuple[int, Any]:
-        """Restore the checkpoint AS SAVED — no target tree required
-        (host numpy arrays, saved structure). The serve-side loader:
-        ``tpujob``'s generate workload restores a TRAIN checkpoint this
-        way and picks out ``["params"]`` without needing to reconstruct
-        the training run's optimizer-state structure. Returns
-        ``(step, tree)``."""
+        """Restore the ENTIRE checkpoint AS SAVED — no target tree
+        required (host numpy arrays, saved structure). For inspection
+        and structure-editing callers that need the whole state; peak
+        host memory is the FULL state's bytes, so serve-side loading of
+        one subtree should use :meth:`restore_subtree` instead (the
+        generate workload does). Returns ``(step, tree)``."""
         step = self._resolve_step(step)
         return step, self._mgr.restore(step)
+
+    def restore_subtree(self, key: str, step: Optional[int] = None) -> tuple[int, Any]:
+        """Restore ONLY the top-level subtree ``key`` (e.g. ``"params"``)
+        from the checkpoint as saved — host numpy arrays, saved
+        structure. Returns ``(step, subtree)``.
+
+        This is the serve-side loader (ADVICE r4 medium):
+        :meth:`restore_tree` materializes the ENTIRE saved train state in
+        host RAM before the caller pops ``params`` — for an 8B adamw
+        checkpoint that is ~96 GB of transient residency on a ~125 GB
+        host. A partial restore reads only the requested shards, so peak
+        host memory is bounded by the subtree's bytes (~32 GB for 8B f32
+        params).
+
+        Implementation rides orbax's ``PyTreeRestore(partial_restore=
+        True)`` on the step directory directly: the manager's registered
+        Standard handlers reject placeholder/partial targets, and the
+        step layout (``<dir>/<step>/default``) is this facade's own
+        save format (StandardSave under the default item name), pinned
+        by tests/test_checkpoint.py."""
+        import jax
+        import numpy as np
+
+        step = self._resolve_step(step)
+        step_dir = self.directory / str(step) / "default"
+        with self._ocp.Checkpointer(
+            self._ocp.PyTreeCheckpointHandler()
+        ) as ckptr:
+            # The manager's item_metadata() is None on a freshly opened
+            # manager (no save/restore registered a handler yet); the
+            # raw checkpointer reads the step's metadata directly.
+            meta = ckptr.metadata(step_dir).item_metadata.tree
+            if key not in meta:
+                raise KeyError(
+                    f"checkpoint at step {step} has no top-level {key!r} "
+                    f"(keys: {sorted(meta)})"
+                )
+            item = {
+                key: jax.tree.map(
+                    lambda _: self._ocp.RestoreArgs(restore_type=np.ndarray),
+                    meta[key],
+                )
+            }
+            tree = ckptr.restore(
+                step_dir,
+                args=self._ocp.args.PyTreeRestore(
+                    item=item, partial_restore=True
+                ),
+            )
+        return step, tree[key]
 
     def restore_or_none(self, state_like: Any) -> Optional[tuple[int, Any]]:
         """(step, state) from the latest checkpoint, or None if there is none

@@ -51,6 +51,25 @@ def test_resume_idiom_and_retention(ckpt_dir):
             mgr.restore(_state(0.0), step=1)  # rotated out
 
 
+def test_restore_subtree_reads_only_requested(ckpt_dir):
+    """Partial restore (ADVICE r4 medium): the serve path must be able
+    to load ONLY the params subtree — peak host memory bounded by
+    params bytes, not full train-state bytes. Also pins the step-dir
+    layout (<dir>/<step>/default) restore_subtree rides on."""
+    with CheckpointManager(ckpt_dir) as mgr:
+        mgr.save(7, _state(7.0))
+        step, params = mgr.restore_subtree("params")
+        assert step == 7
+        assert set(params) == {"w", "b"}
+        np.testing.assert_allclose(np.asarray(params["w"]), 7.0)
+        assert isinstance(params["w"], np.ndarray)  # host, not device
+        with pytest.raises(KeyError, match="no top-level"):
+            mgr.restore_subtree("optimizer")
+        # The layout restore_subtree depends on: manager saves land at
+        # <dir>/<step>/default.
+        assert (ckpt_dir / "7" / "default").is_dir()
+
+
 def test_restore_onto_fsdp_shardings(ckpt_dir):
     import jax
 

@@ -191,6 +191,30 @@ class TestGenerate:
         with pytest.raises(ValueError, match="temperature"):
             make_generate(decode_model, max_new_tokens=new, top_p=0.9)
 
+    def test_top_p_near_one_composed_with_top_k_stays_in_range(self):
+        """ADVICE r4: keep = sum(cum < top_p) can equal V when the float
+        cumsum never reaches a top_p near 1.0 (and saturates early under
+        a composed top_k); the cutoff gather is now explicitly clamped
+        instead of leaning on gather's implicit clip mode. The edge case
+        must sample valid in-range tokens."""
+        import jax
+
+        new = 8
+        cfg, train_model, decode_model, params, prompt = _setup(new=new)
+        gen = make_generate(
+            decode_model, max_new_tokens=new, temperature=1.0,
+            top_k=4, top_p=1.0 - 1e-12,
+        )
+        toks, _ = gen(
+            params,
+            init_cache(decode_model, prompt.shape[0], prompt.shape[1]),
+            prompt,
+            jax.random.key(3),
+        )
+        t = np.asarray(toks)
+        assert t.shape == (2, new)
+        assert ((t >= 0) & (t < cfg.vocab_size)).all()
+
     def test_flash_prefill_matches_dense_prefill(self):
         """Long-prompt serving: prefill runs causal self-attention over
         the prompt (flash when configured) instead of materializing

@@ -90,6 +90,36 @@ class TestTrainToServe:
                 max_new_tokens=4, restore=str(ckpt), log=lambda *_: None,
             )
 
+    def test_wrong_depth_rejected_with_path_message(
+        self, tmp_path, monkeypatch
+    ):
+        """ADVICE r4: a checkpoint with a MATCHING embedding but a
+        different layer stack used to pass the friendly check and die
+        inside tracing. The full-structure check must name the first
+        mismatching path."""
+        import pytest
+
+        from pytorch_operator_tpu.checkpoint.manager import CheckpointManager
+
+        ckpt, _ = _train_checkpoint(tmp_path, monkeypatch, steps=2)
+        with CheckpointManager(ckpt) as mgr:
+            step, tree = mgr.restore_tree()
+        # Same embedding, half the layers: slice the stacked leading
+        # (n_layers) dim of every per-layer leaf.
+        import jax
+
+        tree["params"]["layers"] = jax.tree.map(
+            lambda x: x[:1], tree["params"]["layers"]
+        )
+        forged = tmp_path / "forged"
+        with CheckpointManager(forged) as mgr:
+            mgr.save(step, tree)
+        with pytest.raises(ValueError, match=r"layers"):
+            gen_mod.run(
+                config="tiny", batch_size=1, prompt_len=8,
+                max_new_tokens=4, restore=str(forged), log=lambda *_: None,
+            )
+
     def test_missing_checkpoint_is_a_clear_error(self, tmp_path):
         import pytest
 
