@@ -105,6 +105,30 @@ class LlamaConfig:
     # that fits long-context 8B serving on one chip next to the int8
     # weights (BASELINE.md round-4). Independent of ``quantize``.
     kv_quantize: Optional[str] = None
+    # Per-row decode offsets (decode only): False keeps the batch-uniform
+    # contract (every row at the same position; cache writes are ONE
+    # dynamic_update_slice at positions[0,0] — the fastest write and the
+    # right one for the single-stream generate loop). True switches the
+    # cache write to per-row offsets (positions[:, 0] may differ per row
+    # — a batched vmapped update-slice, i.e. a scatter), which is what a
+    # continuous-batching serving engine needs: each row of the batch is
+    # a DIFFERENT request at a different depth in its own stream. The
+    # attention validity mask is per-row in BOTH modes (it reads the
+    # full positions array; the uniform case is just the special case
+    # where the rows agree).
+    decode_per_row: bool = False
+    # Multi-token decode inputs (S > 1): "self" = the whole prompt of a
+    # FRESH cache (positions [0, S)) — causal self-attention over the
+    # incoming tokens alone IS the full attention, so the flash kernel
+    # applies and no [B,K,G,S,L] scores materialize. "cache" = a CHUNK
+    # of a partially prefilled stream (positions [start, start+S)): the
+    # chunk is written to the cache, then attends against the full cache
+    # with the position-validity mask — intra-chunk causality and the
+    # prefix both fall out of col <= row. Memory is O(S·L) scores, so
+    # chunked prefill picks S (the chunk) to bound it; that bound is the
+    # point (one-shot 8B long prompts exceed one program's activation
+    # budget).
+    prefill_mode: str = "self"
     # Weight-only quantization mode (inference): "int8" makes apply()
     # expect a params tree produced by ``ops.quantize.quantize_tree``
     # (QuantizedTensor leaves — int8 payload + per-channel scales).
@@ -131,6 +155,14 @@ class LlamaConfig:
         if self.kv_quantize not in (None, "int8"):
             raise ValueError(
                 f"kv_quantize={self.kv_quantize!r} not in (None, 'int8')"
+            )
+        if self.prefill_mode not in ("self", "cache"):
+            raise ValueError(
+                f"prefill_mode={self.prefill_mode!r} not in ('self', 'cache')"
+            )
+        if (self.decode_per_row or self.prefill_mode != "self") and not self.decode:
+            raise ValueError(
+                "decode_per_row / prefill_mode='cache' require decode=True"
             )
         if self.decode and self.attn_impl in ("ring", "ulysses"):
             # The decode prefill runs plain causal self-attention over
@@ -386,11 +418,14 @@ class Attention(nn.Module):
         position-validity mask (col_pos <= row_pos), so the program shape
         is static no matter how much of the cache is filled.
 
-        CONTRACT: positions must be batch-uniform (every row at the same
-        offsets — the standard unpadded generate loop). The cache write
-        offset and mask read row 0; left-padded/ragged batches would need
-        per-row offsets and are not supported here. Because a violation
-        is silently wrong (not an error), ``TPUJOB_DEBUG_CHECKS=1``
+        CONTRACT (``cfg.decode_per_row=False``): positions must be
+        batch-uniform (every row at the same offsets — the standard
+        unpadded generate loop); the cache write offset reads row 0.
+        With ``decode_per_row=True`` each row writes at its own
+        ``positions[b, 0]`` (continuous-batching serving, where every
+        row is a different request mid-stream). The attention validity
+        mask is per-row in both modes. Because a contract violation is
+        silently wrong (not an error), ``TPUJOB_DEBUG_CHECKS=1``
         installs a host-callback assert at the model top level (see
         ``Llama.__call__`` — once per step, not per layer).
         """
@@ -422,56 +457,74 @@ class Attention(nn.Module):
             )
         if not self.is_initializing():
             # The incoming S tokens sit at contiguous positions starting
-            # at positions[:, 0] (prefill: the whole prompt from 0;
+            # at positions[:, 0] (prefill: the prompt or a chunk of it;
             # decode: one token at the current index).
-            start = positions[0, 0]
+            if cfg.decode_per_row:
+                # Per-row write offsets: a batched update-slice (XLA
+                # lowers the vmapped DUS to a scatter). Only the serving
+                # engine's mixed-depth batches pay this; the uniform
+                # path below stays a single DUS.
+                starts = positions[:, 0]
+
+                def write(slab, vals):
+                    return jax.vmap(
+                        lambda c, u, s: jax.lax.dynamic_update_slice(
+                            c, u, (0, s, 0)
+                        )
+                    )(slab, vals, starts)
+
+            else:
+                start = positions[0, 0]
+
+                def write(slab, vals):
+                    return jax.lax.dynamic_update_slice(
+                        slab, vals, (0, 0, start, 0)
+                    )
+
             k_in = k.swapaxes(1, 2)  # [B, K, S, D]
             v_in = v.swapaxes(1, 2)
             if kv8:
                 from ..ops.quantize import quantize
 
                 kq, vq = quantize(k_in, axis=-1), quantize(v_in, axis=-1)
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, kq.q, (0, 0, start, 0)
-                )
-                ks.value = jax.lax.dynamic_update_slice(
-                    ks.value, kq.scale, (0, 0, start, 0)
-                )
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, vq.q, (0, 0, start, 0)
-                )
-                vs.value = jax.lax.dynamic_update_slice(
-                    vs.value, vq.scale, (0, 0, start, 0)
-                )
+                ck.value = write(ck.value, kq.q)
+                ks.value = write(ks.value, kq.scale)
+                cv.value = write(cv.value, vq.q)
+                vs.value = write(vs.value, vq.scale)
             else:
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k_in.astype(cfg.dtype), (0, 0, start, 0)
-                )
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v_in.astype(cfg.dtype), (0, 0, start, 0)
-                )
-        if S > 1:
-            # PREFILL: by the generate contract the prompt lands at
-            # positions [0, S) of a fresh cache, so causal attention
-            # over the incoming tokens alone IS the full attention —
-            # run the standard self-attention path (flash when
-            # configured: O(S·D) blockwise HBM) after the cache writes
-            # above, instead of materializing [B, K, G, S, L] f32
-            # scores against the whole cache budget (~17 GB at S=L=8k
-            # — the long-prompt OOM this branch removes). A nonzero
-            # prefill start would make this silently wrong, so the
+                ck.value = write(ck.value, k_in.astype(cfg.dtype))
+                cv.value = write(cv.value, v_in.astype(cfg.dtype))
+        if S > 1 and cfg.prefill_mode == "self":
+            # PREFILL (mode "self"): the prompt lands at positions
+            # [0, S) of a fresh cache, so causal attention over the
+            # incoming tokens alone IS the full attention — run the
+            # standard self-attention path (flash when configured:
+            # O(S·D) blockwise HBM) after the cache writes above,
+            # instead of materializing [B, K, G, S, L] f32 scores
+            # against the whole cache budget (~17 GB at S=L=8k — the
+            # long-prompt OOM this branch removes). A nonzero prefill
+            # start would make this silently wrong, so the
             # TPUJOB_DEBUG_CHECKS callback in ``Llama.__call__``
-            # asserts start == 0 for multi-token inputs.
+            # asserts start == 0 for multi-token inputs in this mode;
+            # chunked continuations use prefill_mode="cache" below.
             out = self._self_attend(q, k, v)
         else:
+            # Single-token decode steps, and (prefill_mode="cache")
+            # chunks of a partially prefilled stream: attend against
+            # the full cache — the chunk's own tokens were written
+            # above at their true positions, so intra-chunk causality
+            # and the prefix both fall out of the col <= row mask.
             out = self._cache_attend(q, positions, ck, cv, ks, vs)
         out = out.reshape(B, S, K * G * D)
         out = nn.with_logical_constraint(out, ("batch", "seq", None))
         return self._o_proj(out)
 
     def _cache_attend(self, q, positions, ck, cv, ks, vs):
-        """Single-token decode: q against the FULL cache with a
-        position-validity mask (static shapes however much is filled)."""
+        """q against the FULL cache with a per-(row, token) position-
+        validity mask — static shapes however much of the cache is
+        filled. Serves single-token decode steps (S=1, possibly at
+        per-row depths) and chunked-prefill continuations (S>1,
+        prefill_mode="cache")."""
         cfg = self.cfg
         B, S, K, G, D = q.shape
         L = cfg.max_decode_len
@@ -494,10 +547,12 @@ class Attention(nn.Module):
             # scores[b,k,g,s,t] · key_scale[b,k,t]: the K dequant, moved
             # past the dot (linear in K).
             scores = scores * ks.value.squeeze(-1)[:, :, None, None, :]
-        col = jnp.arange(L)[None, :]            # cache position
-        row = positions[0][:, None]             # query position
+        col = jnp.arange(L)[None, None, :]      # cache position [1,1,L]
+        row = positions[:, :, None]             # query position [B,S,1]
+        # Per-(row, token) validity: col <= row — the uniform generate
+        # loop is just the special case where the B rows agree.
         scores = jnp.where(
-            (col <= row)[None, None, None, :, :],
+            (col <= row)[:, None, None, :, :],  # [B,1,1,S,L]
             scores,
             jnp.finfo(jnp.float32).min,
         )
@@ -685,7 +740,7 @@ class Llama(nn.Module):
             # device->host sync per decode step. decode_forward (the
             # serving path, which bypasses this __call__) installs the
             # same check.
-            _debug_check_decode_positions(positions)
+            _debug_check_decode_positions(positions, cfg)
 
         dequant = None
         if cfg.quantize:
@@ -780,31 +835,57 @@ class Llama(nn.Module):
         )
 
 
-def _debug_check_decode_positions(positions):
-    """Install the TPUJOB_DEBUG_CHECKS host assert on decode positions:
-    batch-uniform (cache offset/mask read row 0) and, for multi-token
-    inputs (prefill), start == 0 (prefill self-attends — a chunked
-    prefill would silently drop earlier context). No-op unless the env
-    var is set."""
+def _debug_check_decode_positions(positions, cfg):
+    """Install the TPUJOB_DEBUG_CHECKS host assert on decode positions,
+    per the config's contract:
+
+    - always: rows are per-row CONTIGUOUS (pos[b, s] = pos[b, 0] + s)
+      and the last write lands inside the cache (pos < max_decode_len —
+      dynamic_update_slice would silently CLAMP an overflow and corrupt
+      the newest cache rows).
+    - ``decode_per_row=False``: batch-uniform (the cache write offset
+      reads row 0).
+    - ``prefill_mode="self"``: multi-token inputs start at position 0
+      (self-attention prefill would silently drop earlier context at a
+      nonzero start; chunked continuations need prefill_mode="cache").
+
+    No-op unless the env var is set."""
     import os
 
     if os.environ.get("TPUJOB_DEBUG_CHECKS", "").lower() in (
         "", "0", "false", "no",
     ):
         return
+    per_row, prefill_mode, L = (
+        cfg.decode_per_row, cfg.prefill_mode, cfg.max_decode_len,
+    )
 
     def _assert_valid(pos):
-        if not (pos == pos[0:1]).all():
+        import numpy as np
+
+        S = pos.shape[-1]
+        if not (pos == pos[:, :1] + np.arange(S)).all():
+            raise ValueError(
+                f"decode positions must be contiguous per row; got {pos}"
+            )
+        if not per_row and not (pos == pos[0:1]).all():
             raise ValueError(
                 "decode positions must be batch-uniform (unpadded "
                 f"equal-length batch); got rows {pos}. Bucket ragged "
-                "prompts to equal length first."
+                "prompts to equal length, generate row-by-row, or build "
+                "the model with decode_per_row=True (serving engine)."
             )
-        if pos.shape[-1] > 1 and pos[0, 0] != 0:
+        if pos.max() >= L:
+            raise ValueError(
+                f"decode position {pos.max()} >= max_decode_len {L}: "
+                "the cache write would clamp and corrupt the rollout"
+            )
+        if prefill_mode == "self" and S > 1 and (pos[:, 0] != 0).any():
             raise ValueError(
                 "multi-token decode input (prefill) must start at "
-                f"position 0, got {pos[0, 0]}: prefill attends over the "
-                "incoming tokens only (chunked prefill is not supported)."
+                f"position 0, got starts {pos[:, 0]}: prefill_mode="
+                "'self' attends over the incoming tokens only. Chunked "
+                "prefill needs prefill_mode='cache'."
             )
 
     jax.debug.callback(_assert_valid, positions)
@@ -876,8 +957,10 @@ def decode_forward(
         )
     else:
         # Same TPUJOB_DEBUG_CHECKS contract assert as Llama.__call__
-        # (this path bypasses it): batch-uniform, prefill starts at 0.
-        _debug_check_decode_positions(positions)
+        # (this path bypasses it); the checked contract follows the
+        # config: batch-uniform unless decode_per_row, start-0 prefill
+        # unless prefill_mode="cache".
+        _debug_check_decode_positions(positions, model.cfg)
     p = nn.meta.unbox(params)
 
     table = p["embed"]["embedding"]

@@ -47,12 +47,15 @@ def make_generate(
     stay int8 in HBM and the convert+scale fuses into each matmul's
     operand read.
 
-    CONTRACT (inherited from ``Llama._decode_attend``): every prompt row
-    must occupy the same positions — i.e. an unpadded, equal-length
-    prompt batch. Left-padded/ragged prompts would attend wrongly (the
-    KV-cache write offset and mask read row 0); ragged batches must be
-    bucketed to equal length (or generated row-by-row) by the caller.
-    Set ``TPUJOB_DEBUG_CHECKS=1`` to assert this at runtime.
+    CONTRACT (inherited from ``Llama._decode_attend`` at the default
+    ``decode_per_row=False``): every prompt row must occupy the same
+    positions — i.e. an unpadded, equal-length prompt batch (the cache
+    write offset reads row 0). Ragged batches must be bucketed to equal
+    length here, generated row-by-row, or decoded through a
+    ``decode_per_row=True`` model at per-row positions (what a
+    continuous-batching serving engine does; see
+    tests/test_serving_batch.py for the parity contract). Set
+    ``TPUJOB_DEBUG_CHECKS=1`` to assert the contract at runtime.
     """
     import functools
 

@@ -20,6 +20,11 @@ import numpy as np
 import tests.jaxenv  # noqa: F401
 from pytorch_operator_tpu.parallel import make_mesh
 
+import pytest
+
+# Fast-lane exclusion (-m 'not slow'): real training to convergence goldens.
+pytestmark = pytest.mark.slow
+
 # Golden curves, 6 steps each (generated 2026-07-30, jax 0.9.0 CPU,
 # bit-exact over repeated runs).
 RESNET18_GOLDEN = [2.494654, 2.425305, 0.967371, 0.889857, 0.903853, 0.876274]

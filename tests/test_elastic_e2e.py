@@ -23,6 +23,11 @@ from pytorch_operator_tpu.api import (
 from pytorch_operator_tpu.controller import Supervisor
 from tests.testutil import new_job
 
+import pytest
+
+# Fast-lane exclusion (-m 'not slow'): real-subprocess elastic shrink/grow e2es.
+pytestmark = pytest.mark.slow
+
 def _llama_args(max_steps):
     """The canonical tiny-llama e2e arg list (one definition so the two
     e2e scenarios cannot drift on shared knobs)."""

@@ -18,6 +18,11 @@ import sys
 import time
 from pathlib import Path
 
+import pytest
+
+# Fast-lane exclusion (-m 'not slow'): real-subprocess HA leader failover.
+pytestmark = pytest.mark.slow
+
 REPO_ROOT = str(Path(__file__).resolve().parents[1])
 
 

@@ -13,6 +13,10 @@ from pytorch_operator_tpu.controller.runner import FakeRunner, SubprocessRunner,
 from pytorch_operator_tpu.controller.supervisor import Supervisor
 from tests.testutil import new_job
 
+import pytest
+
+
+
 
 def make_sup(capacity, preempt=True):
     return Supervisor(
@@ -119,6 +123,9 @@ class TestPreemption:
         assert len(sup.runner.list_for_job(mid_key)) == 1
 
 
+# Fast-lane exclusion (-m 'not slow'): real-subprocess preemption restart;
+# the FakeRunner classes above stay in the fast lane.
+@pytest.mark.slow
 class TestPreemptionE2E:
     def test_real_world_evicted_and_relaunched(self, tmp_path):
         """Real subprocess worlds: a high-priority job evicts a running

@@ -1,0 +1,363 @@
+"""Per-row decode offsets + chunked prefill (the serving-batch
+contracts — VERDICT r4 Missing #4).
+
+Round 4's decode stack required batch-uniform positions (cache write
+offset read row 0) and start-0 prefill — fine for benchmarks, fatal for
+a real request mix where every row of the serving batch is a DIFFERENT
+request at a different depth. These tests pin the two generalizations:
+
+- ``decode_per_row=True``: a mixed-depth batch decodes every row at its
+  own position, numerically equal to generating each row alone.
+- ``prefill_mode="cache"``: a prompt prefilled in chunks (each chunk
+  attends against the already-filled cache prefix) equals the one-shot
+  prefill, token for token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import pytest
+
+import tests.jaxenv  # noqa: F401
+from pytorch_operator_tpu.models import llama as llama_lib
+from pytorch_operator_tpu.models.llama import decode_forward, init_decode_cache
+
+
+def _params_and_model(max_decode_len=32, **over):
+    import jax
+    import flax.linen as nn
+
+    cfg = llama_lib.llama_tiny(
+        decode=True, max_decode_len=max_decode_len, **over
+    )
+    train_model = llama_lib.Llama(dataclasses.replace(
+        cfg, decode=False, decode_per_row=False, prefill_mode="self"
+    ))
+    params = nn.meta.unbox(
+        train_model.init(jax.random.key(0), np.zeros((1, 8), np.int32))[
+            "params"
+        ]
+    )
+    return cfg, llama_lib.Llama(cfg), params
+
+
+def _greedy_steps(model, params, cache, last_tok, pos, n):
+    """n greedy decode steps through decode_forward at per-row positions
+    ``pos`` [B]; returns (tokens [B, n], cache)."""
+    import jax.numpy as jnp
+
+    toks = []
+    for _ in range(n):
+        logits, cache = decode_forward(
+            model, params, cache, last_tok[:, None], pos[:, None],
+            return_hidden=False,
+        )
+        last_tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks.append(last_tok)
+        pos = pos + 1
+    return jnp.stack(toks, axis=1), cache
+
+
+# The parity classes compile many distinct tiny programs (~3 min on this
+# one-core host) — fast-lane excluded; TestDebugChecks below stays fast.
+@pytest.mark.slow
+class TestPerRowDecode:
+    def test_mixed_depth_batch_matches_row_by_row(self):
+        """The serving-batch property: two requests at DIFFERENT depths
+        decode together in one per-row batch, each row numerically equal
+        to generating it alone through the uniform path."""
+        import jax.numpy as jnp
+
+        L, new = 32, 6
+        cfg, _, params = _params_and_model(L)
+        uni_model = llama_lib.Llama(cfg)  # batch-uniform (B=1 rows)
+        pr_model = llama_lib.Llama(
+            dataclasses.replace(cfg, decode_per_row=True)
+        )
+
+        rng = np.random.default_rng(0)
+        prompts = [
+            jnp.asarray(rng.integers(0, cfg.vocab_size, (1, p)), jnp.int32)
+            for p in (5, 9)  # different prompt lengths
+        ]
+
+        # Reference: each row alone (B=1, uniform contract).
+        want, row_caches = [], []
+        for prompt in prompts:
+            cache = init_decode_cache(cfg, 1)
+            logits, cache = decode_forward(
+                uni_model, params, cache, prompt, return_hidden=False
+            )
+            tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            toks, cache = _greedy_steps(
+                uni_model, params, cache, tok,
+                jnp.full((1,), prompt.shape[1], jnp.int32), new - 1,
+            )
+            want.append(np.concatenate([np.asarray(tok)[:, None],
+                                        np.asarray(toks)], axis=1))
+            row_caches.append(cache)
+
+        # Serving batch: stitch the per-row caches into one B=2 batch
+        # (exactly what the engine's slot assembly does) and decode both
+        # rows together at per-row positions.
+        import jax
+
+        batch_cache = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *row_caches
+        )
+        first = jnp.concatenate(
+            [jnp.asarray(w[:, :1]) for w in want], axis=0
+        )  # each row's first generated token, shape [2, 1]
+        pos = jnp.asarray([5, 9], jnp.int32)  # per-row depths
+        got, _ = _greedy_steps(
+            pr_model, params, batch_cache, first[:, 0], pos, new - 1
+        )
+        got = np.concatenate([np.asarray(first), np.asarray(got)], axis=1)
+        np.testing.assert_array_equal(
+            got, np.concatenate(want, axis=0)
+        )
+
+    def test_uniform_batch_identical_in_both_modes(self):
+        """On a uniform batch the per-row path must be numerically
+        identical to the uniform path (same math, scatter vs slice
+        write)."""
+        import jax.numpy as jnp
+
+        cfg, uni_model, params = _params_and_model(24)
+        pr_model = llama_lib.Llama(
+            dataclasses.replace(cfg, decode_per_row=True)
+        )
+        prompt = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab_size, (3, 8)),
+            jnp.int32,
+        )
+        outs = []
+        for model in (uni_model, pr_model):
+            cache = init_decode_cache(cfg, 3)
+            logits, cache = decode_forward(
+                model, params, cache, prompt, return_hidden=False
+            )
+            tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            toks, _ = _greedy_steps(
+                model, params, cache, tok,
+                jnp.full((3,), 8, jnp.int32), 5,
+            )
+            outs.append(np.asarray(toks))
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+    def test_per_row_composes_with_int8_kv(self):
+        """The serving stack quantizes the KV cache; per-row writes must
+        quantize/scale per row exactly as the uniform path does."""
+        import jax.numpy as jnp
+
+        cfg, uni_model, params = _params_and_model(24, kv_quantize="int8")
+        pr_model = llama_lib.Llama(
+            dataclasses.replace(cfg, decode_per_row=True)
+        )
+        prompt = jnp.asarray(
+            np.random.default_rng(2).integers(0, cfg.vocab_size, (2, 6)),
+            jnp.int32,
+        )
+        outs = []
+        for model in (uni_model, pr_model):
+            cache = init_decode_cache(cfg, 2)
+            logits, cache = decode_forward(
+                model, params, cache, prompt, return_hidden=False
+            )
+            tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            toks, _ = _greedy_steps(
+                model, params, cache, tok, jnp.full((2,), 6, jnp.int32), 4
+            )
+            outs.append(np.asarray(toks))
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+
+@pytest.mark.slow
+class TestChunkedPrefill:
+    def _one_shot(self, cfg, model, params, prompt):
+        import jax.numpy as jnp
+
+        cache = init_decode_cache(cfg, prompt.shape[0])
+        logits, cache = decode_forward(
+            model, params, cache, prompt, return_hidden=False
+        )
+        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), cache
+
+    def _chunked(self, cfg, params, prompt, sizes):
+        """Prefill ``prompt`` in chunks of the given sizes through the
+        prefill_mode='cache' model; returns (next_token, cache)."""
+        import jax.numpy as jnp
+
+        model = llama_lib.Llama(
+            dataclasses.replace(cfg, prefill_mode="cache")
+        )
+        B = prompt.shape[0]
+        cache = init_decode_cache(cfg, B)
+        start = 0
+        for size in sizes:
+            chunk = prompt[:, start : start + size]
+            positions = jnp.broadcast_to(
+                jnp.arange(start, start + size, dtype=jnp.int32), (B, size)
+            )
+            logits, cache = decode_forward(
+                model, params, cache, chunk, positions, return_hidden=False
+            )
+            start += size
+        assert start == prompt.shape[1], "sizes must cover the prompt"
+        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), cache
+
+    def test_chunked_equals_one_shot(self):
+        """The chunked-prefill property: any chunking of the prompt
+        (equal chunks, ragged chunks, single-token chunks) produces the
+        same cache and the same next token as the one-shot prefill."""
+        import jax
+
+        cfg, model, params = _params_and_model(32)
+        prompt = np.random.default_rng(3).integers(
+            0, cfg.vocab_size, (2, 12)
+        ).astype(np.int32)
+        want_tok, want_cache = self._one_shot(cfg, model, params, prompt)
+        for sizes in ([4, 4, 4], [5, 7], [12], [1] * 12):
+            got_tok, got_cache = self._chunked(cfg, params, prompt, sizes)
+            np.testing.assert_array_equal(
+                np.asarray(got_tok), np.asarray(want_tok),
+                err_msg=f"chunking {sizes}",
+            )
+            # The caches must agree everywhere (unwritten slots are
+            # zeros in both).
+            for w, g in zip(
+                jax.tree.leaves(want_cache), jax.tree.leaves(got_cache)
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(w), rtol=2e-5, atol=2e-5
+                )
+
+    def test_chunked_rollout_matches_one_shot_rollout(self):
+        """End to end: greedy decode after a chunked prefill equals the
+        rollout after one-shot prefill."""
+        import jax.numpy as jnp
+
+        cfg, model, params = _params_and_model(32)
+        prompt = np.random.default_rng(4).integers(
+            0, cfg.vocab_size, (2, 10)
+        ).astype(np.int32)
+        tok_a, cache_a = self._one_shot(cfg, model, params, prompt)
+        toks_a, _ = _greedy_steps(
+            model, params, cache_a, tok_a, jnp.full((2,), 10, jnp.int32), 6
+        )
+        tok_b, cache_b = self._chunked(cfg, params, prompt, [3, 3, 4])
+        toks_b, _ = _greedy_steps(
+            model, params, cache_b, tok_b, jnp.full((2,), 10, jnp.int32), 6
+        )
+        np.testing.assert_array_equal(np.asarray(tok_a), np.asarray(tok_b))
+        np.testing.assert_array_equal(np.asarray(toks_a), np.asarray(toks_b))
+
+    def test_chunked_composes_with_int8_kv(self):
+        """Chunked prefill under int8 KV: every cache-mode chunking is
+        bit-identical to every other (cache mode ALWAYS reads the
+        quantized cache, so chunk boundaries can't change what any
+        token sees). Against the one-shot SELF-mode prefill the caches
+        agree only to quantization tolerance: self-attention reads the
+        exact k/v while cache mode reads their int8 round trip, and
+        that ulp difference propagates through layer>=1 hidden states
+        into the later layers' cache writes."""
+        import jax
+
+        cfg, model, params = _params_and_model(32, kv_quantize="int8")
+        prompt = np.random.default_rng(5).integers(
+            0, cfg.vocab_size, (2, 8)
+        ).astype(np.int32)
+        _, cache_a = self._one_shot(cfg, model, params, prompt)
+        tok_b, cache_b = self._chunked(cfg, params, prompt, [4, 4])
+        tok_c, cache_c = self._chunked(cfg, params, prompt, [2, 6])
+        tok_d, cache_d = self._chunked(cfg, params, prompt, [8])
+        np.testing.assert_array_equal(np.asarray(tok_b), np.asarray(tok_c))
+        np.testing.assert_array_equal(np.asarray(tok_b), np.asarray(tok_d))
+        for w, g in zip(
+            jax.tree.leaves(cache_b), jax.tree.leaves(cache_c)
+        ):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+        def dequant(slab):
+            return {
+                "k": np.asarray(slab["cached_key"], np.float32)
+                * np.asarray(slab["key_scale"]),
+                "v": np.asarray(slab["cached_value"], np.float32)
+                * np.asarray(slab["value_scale"]),
+            }
+
+        for layer in cache_a:
+            a = dequant(cache_a[layer]["attn"])
+            b = dequant(cache_b[layer]["attn"])
+            for key in ("k", "v"):
+                np.testing.assert_allclose(
+                    b[key], a[key], rtol=0.05, atol=0.02
+                )
+
+
+class TestDebugChecks:
+    def test_per_row_model_accepts_ragged_positions(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("TPUJOB_DEBUG_CHECKS", "1")
+        cfg, _, params = _params_and_model(16)
+        pr_model = llama_lib.Llama(
+            dataclasses.replace(cfg, decode_per_row=True)
+        )
+        cache = init_decode_cache(cfg, 2)
+        tok = jnp.zeros((2, 1), jnp.int32)
+        pos = jnp.asarray([[3], [7]], jnp.int32)  # ragged: fine per-row
+        out, _ = decode_forward(pr_model, params, cache, tok, pos)
+        jax.block_until_ready(out)
+
+    def test_overflow_positions_rejected(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+        import pytest
+
+        monkeypatch.setenv("TPUJOB_DEBUG_CHECKS", "1")
+        cfg, _, params = _params_and_model(16)
+        pr_model = llama_lib.Llama(
+            dataclasses.replace(cfg, decode_per_row=True)
+        )
+        cache = init_decode_cache(cfg, 2)
+        tok = jnp.zeros((2, 1), jnp.int32)
+        pos = jnp.asarray([[3], [16]], jnp.int32)  # row 1 past the cache
+        with pytest.raises(Exception, match="max_decode_len"):
+            out, _ = decode_forward(pr_model, params, cache, tok, pos)
+            jax.block_until_ready(out)
+
+    def test_self_mode_still_rejects_nonzero_prefill_start(
+        self, monkeypatch
+    ):
+        import jax
+        import jax.numpy as jnp
+        import pytest
+
+        monkeypatch.setenv("TPUJOB_DEBUG_CHECKS", "1")
+        cfg, model, params = _params_and_model(16)
+        cache = init_decode_cache(cfg, 1)
+        toks = jnp.zeros((1, 4), jnp.int32)
+        pos = jnp.arange(2, 6, dtype=jnp.int32)[None, :]
+        with pytest.raises(Exception, match="prefill"):
+            out, _ = decode_forward(model, params, cache, toks, pos)
+            jax.block_until_ready(out)
+
+    def test_cache_mode_accepts_nonzero_prefill_start(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("TPUJOB_DEBUG_CHECKS", "1")
+        cfg, _, params = _params_and_model(16)
+        model = llama_lib.Llama(
+            dataclasses.replace(cfg, prefill_mode="cache")
+        )
+        cache = init_decode_cache(cfg, 1)
+        toks = jnp.zeros((1, 4), jnp.int32)
+        pos = jnp.arange(2, 6, dtype=jnp.int32)[None, :]
+        out, _ = decode_forward(model, params, cache, toks, pos)
+        jax.block_until_ready(out)

@@ -24,6 +24,11 @@ from pytorch_operator_tpu.controller.standby import StandbyPool
 from pytorch_operator_tpu.controller.supervisor import Supervisor
 from tests.testutil import new_job
 
+import pytest
+
+# Fast-lane exclusion (-m 'not slow'): standby pool subprocesses.
+pytestmark = pytest.mark.slow
+
 KEY = "default/warm"
 
 

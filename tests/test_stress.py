@@ -20,6 +20,10 @@ from pytorch_operator_tpu.controller.supervisor import Supervisor
 
 from tests.testutil import new_job
 
+import pytest
+
+
+
 
 class TestSupervisorStress:
     def test_concurrent_submit_sync_delete(self, tmp_path):

@@ -17,6 +17,11 @@ import tests.jaxenv  # noqa: F401
 from pytorch_operator_tpu.workloads import generate as gen_mod
 from pytorch_operator_tpu.workloads import llama_train
 
+import pytest
+
+# Fast-lane exclusion (-m 'not slow'): real train->checkpoint->serve runs.
+pytestmark = pytest.mark.slow
+
 
 def _train_checkpoint(tmp_path, monkeypatch, steps=30):
     ckpt = tmp_path / "ckpt"
