@@ -161,7 +161,13 @@ class NativeLoader:
 
     def next_batch(self) -> Tuple[int, int, Dict[str, np.ndarray]]:
         """Blocks for the next prefetched batch; returns (epoch, index,
-        {field: array}). Releases the previously borrowed slot first."""
+        {field: array}). Releases the previously borrowed slot first.
+
+        BORROW CONTRACT: the returned arrays are zero-copy views into a
+        prefetch ring slot owned by the C++ loader. They are valid ONLY
+        until the next ``next_batch()`` or ``close()`` — consume them
+        (device_put / compute) or ``np.array(..., copy=True)`` before
+        either; a held view reads recycled memory afterwards."""
         if self._handle is None:
             raise RuntimeError("loader is closed")
         if self._borrowed:

@@ -1,0 +1,12 @@
+"""Continuous-batching LM serving (the inference-side analog of the
+training operator's long-running reconciled workload).
+
+- :mod:`engine` — the slot-based decode engine: request admission at
+  decode-block boundaries, per-row positions, chunked prefill, latency
+  accounting (TTFT / per-token percentiles).
+- :mod:`spool` — file-based request/response IPC (this environment has
+  no network; local spool directories are the transport).
+"""
+
+from .engine import Request, RequestResult, ServingEngine  # noqa: F401
+from .spool import Spool  # noqa: F401

@@ -1,0 +1,131 @@
+"""File-spool request/response transport for the serving engine.
+
+Reference analog: the reference exposes workloads through cluster
+Services; this environment has no network, so the serving job's request
+surface is a spool DIRECTORY (the same local-IPC substrate the
+supervisor's store/progress layers ride). The protocol is the classic
+maildir trick: writers create a temp file and ``rename`` it into place
+— rename is atomic on POSIX, so the scanner never sees a torn file —
+and the engine claims a request by renaming it out of ``requests/``,
+so a crashed engine leaves claims visible for inspection instead of
+silently re-running them.
+
+Layout under the spool root:
+
+    requests/<id>.json     submitted, unclaimed
+    claimed/<id>.json      claimed by the engine (in flight)
+    responses/<id>.json    completed (tokens + latency record)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from pathlib import Path
+from typing import Optional
+
+
+class Spool:
+    def __init__(self, root: Path | str, create: bool = True):
+        self.root = Path(root)
+        self.requests = self.root / "requests"
+        self.claimed = self.root / "claimed"
+        self.responses = self.root / "responses"
+        if create:
+            for d in (self.requests, self.claimed, self.responses):
+                d.mkdir(parents=True, exist_ok=True)
+
+    # ---- client side ----
+
+    def submit(
+        self,
+        *,
+        prompt=None,
+        prompt_len: Optional[int] = None,
+        max_new_tokens: int = 64,
+        request_id: Optional[str] = None,
+    ) -> str:
+        """Drop a request into the spool; returns its id.
+
+        ``prompt`` is an explicit token-id list; ``prompt_len`` asks the
+        engine to synthesize a deterministic prompt of that length (no
+        tokenizer ships in this environment). Exactly one must be set.
+        """
+        if (prompt is None) == (prompt_len is None):
+            raise ValueError("exactly one of prompt / prompt_len required")
+        rid = request_id or uuid.uuid4().hex[:12]
+        rec = {
+            "id": rid,
+            "prompt": list(map(int, prompt)) if prompt is not None else None,
+            "prompt_len": prompt_len,
+            "max_new_tokens": int(max_new_tokens),
+            "submit_time": time.time(),
+        }
+        tmp = self.requests / f".{rid}.tmp"
+        tmp.write_text(json.dumps(rec))
+        os.rename(tmp, self.requests / f"{rid}.json")
+        return rid
+
+    def wait_response(self, request_id: str, timeout: float = 60.0) -> dict:
+        """Poll for the response record; raises TimeoutError."""
+        path = self.responses / f"{request_id}.json"
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if path.exists():
+                return json.loads(path.read_text())
+            time.sleep(0.02)
+        raise TimeoutError(f"no response for {request_id} in {timeout}s")
+
+    # ---- engine side ----
+
+    def claim(self, limit: int) -> list[dict]:
+        """Claim up to ``limit`` unclaimed requests, oldest first."""
+        out = []
+
+        def mtime(p):
+            # A concurrent claimer may rename the file between iterdir
+            # and stat; such entries sort last and lose the per-file
+            # rename race below instead of aborting the whole batch.
+            try:
+                return p.stat().st_mtime
+            except FileNotFoundError:
+                return float("inf")
+
+        try:
+            pending = sorted(
+                (p for p in self.requests.iterdir() if p.suffix == ".json"),
+                key=mtime,
+            )
+        except FileNotFoundError:
+            return out
+        for path in pending[: max(0, limit)]:
+            dst = self.claimed / path.name
+            try:
+                os.rename(path, dst)
+            except FileNotFoundError:
+                continue  # lost a race with another claimer
+            try:
+                out.append(json.loads(dst.read_text()))
+            except (OSError, json.JSONDecodeError):
+                continue
+        return out
+
+    def respond(self, request_id: str, record: dict) -> None:
+        tmp = self.responses / f".{request_id}.tmp"
+        tmp.write_text(json.dumps(record))
+        os.rename(tmp, self.responses / f"{request_id}.json")
+        claimed = self.claimed / f"{request_id}.json"
+        try:
+            claimed.unlink()
+        except FileNotFoundError:
+            pass
+
+    def pending_count(self) -> int:
+        try:
+            return sum(
+                1 for p in self.requests.iterdir() if p.suffix == ".json"
+            )
+        except FileNotFoundError:
+            return 0

@@ -63,54 +63,11 @@ def make_generate(
     import jax.numpy as jnp
 
     from ..models.llama import decode_forward
+    from ..ops.sampling import make_sampler
 
-    if not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p={top_p} not in (0, 1]")
-    if top_k < 0:
-        raise ValueError(f"top_k={top_k} must be 0 (off) or >= 1")
-    if temperature == 0.0 and (top_k > 0 or top_p < 1.0):
-        # T=0 short-circuits to argmax; silently ignoring the knobs
-        # would hand every row the identical greedy rollout.
-        raise ValueError(
-            "top_k/top_p require temperature > 0 (temperature=0 is greedy)"
-        )
-
-    def sample(logits, rng):
-        """Greedy at T=0, else categorical over the temperature-scaled
-        logits with optional top-k and/or nucleus (top-p) truncation —
-        static-shape masks off ONE shared descending sort (the sort is
-        the dominant sampling cost on the decode hot path)."""
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits / temperature
-        neg = jnp.finfo(logits.dtype).min
-        V = logits.shape[-1]
-        if (0 < top_k < V) or top_p < 1.0:
-            sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
-            if 0 < top_k < V:
-                # Keep the k highest logits: threshold at the k-th value
-                # (ties at the threshold survive).
-                kth = sorted_desc[..., top_k - 1 : top_k]
-                logits = jnp.where(logits < kth, neg, logits)
-                # Nucleus composes on the TRUNCATED distribution
-                # (HF-style sequential semantics): mask the sorted tail.
-                sorted_desc = jnp.where(
-                    jnp.arange(V) >= top_k, neg, sorted_desc
-                )
-            if top_p < 1.0:
-                # Smallest token set whose cumulative probability
-                # reaches top_p; the top token always survives.
-                probs = jax.nn.softmax(sorted_desc, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                keep = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-                # float cumsum can fail to reach a top_p near 1.0 (and
-                # saturates early under a composed top_k), making keep
-                # == V; the always-keep-top-token invariant must not
-                # rest on gather's implicit index clamping (ADVICE r4).
-                keep = jnp.minimum(keep, V - 1)
-                cutoff = jnp.take_along_axis(sorted_desc, keep, axis=-1)
-                logits = jnp.where(logits < cutoff, neg, logits)
-        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    # Shared with the serving engine (ops/sampling.py): greedy / T /
+    # top-k / nucleus off one descending sort, knobs validated up front.
+    sample = make_sampler(temperature, top_k, top_p)
 
     def last_logits(params, hidden):
         # Head matmul on the LAST position only: prefill would otherwise
@@ -167,83 +124,55 @@ def init_cache(model, batch: int, prompt_len: int = 0):
     return init_decode_cache(model.cfg, batch)
 
 
-def run(
+def load_params(
+    cfg,
     *,
-    config: str = "tiny",
-    batch_size: int = 8,
-    prompt_len: int = 64,
-    max_new_tokens: int = 64,
-    max_decode_len: int | None = None,
-    temperature: float = 0.0,
-    top_k: int = 0,
-    top_p: float = 1.0,
+    config: str,
+    restore: str | None = None,
     quantize: str | None = None,
-    kv_quantize: str | None = None,
     init_host: bool = False,
     compare_unquantized: bool = False,
-    restore: str | None = None,
     seed: int = 0,
     log=print,
-) -> dict:
+    tag: str = "generate",
+):
+    """Build the serving param tree for ``cfg`` — shared by the
+    single-stream generate workload and the serving engine workload.
+
+    Init-or-restore (params-only partial restore with the full-structure
+    shape check), optional host-side init for trees beyond device HBM,
+    optional int8 weight-only quantization, and a one-time device
+    commit. Returns ``(params, params_fp, n_params, weight_bytes,
+    restored_step)`` where ``params_fp`` is the unquantized control
+    (only when ``compare_unquantized``)."""
+    import contextlib
+
+    import flax.linen as nn
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from ..models import llama as llama_lib
-    from .llama_train import CONFIGS
 
-    if quantize not in (None, "int8"):
-        raise ValueError(f"quantize={quantize!r} not in (None, 'int8')")
     if init_host and not quantize:
         # Host init exists exactly for models whose full-precision tree
         # does not fit device HBM (8B f32 = 32 GB > 16 GB); without
-        # quantization the transferred tree wouldn't fit either.
+        # quantization the transferred tree wouldn't fit either — and
+        # the tree would stay committed to the CPU backend. Lives HERE
+        # so every caller (generate, serve, bench) gets the guard.
         raise ValueError("init_host requires quantize='int8'")
-    if compare_unquantized and (not quantize or init_host):
-        # The same-session A/B needs both trees resident — exactly what
-        # init_host models cannot do.
-        raise ValueError(
-            "compare_unquantized requires quantize and not init_host"
-        )
-
-    cfg = getattr(llama_lib, CONFIGS[config])(
-        decode=True,
-        # The cache is statically sized by max_decode_len; overriding it
-        # beyond prompt+new measures serving at a context budget without
-        # generating the whole window (the step cost is L-dependent
-        # regardless of fill — static shapes).
-        max_decode_len=max_decode_len or (prompt_len + max_new_tokens),
-        # attn_impl stays the config's default (flash for the llama
-        # configs): prefill runs causal self-attention over the prompt
-        # (blockwise — long prompts don't materialize scores against
-        # the cache budget); decode steps attend against the cache.
-        quantize=quantize,
-        kv_quantize=kv_quantize,
-    )
-    model = llama_lib.Llama(cfg)
-    log(
-        f"[generate] config={config} d_model={cfg.d_model} "
-        f"layers={cfg.n_layers} batch={batch_size} prompt={prompt_len} "
-        f"new={max_new_tokens} T={temperature} "
-        f"({jax.devices()[0].platform})"
-    )
 
     def make_params(key):
         train_cfg = dataclasses.replace(cfg, decode=False, quantize=None)
         return llama_lib.Llama(train_cfg).init(
-            key, jnp.zeros((1, prompt_len), jnp.int32)
+            key, jnp.zeros((1, 8), jnp.int32)
         )["params"]
-
-    import flax.linen as nn
-
-    import contextlib
 
     restored_step = None
     if restore is not None:
         # Serve a TRAINED checkpoint (the train -> checkpoint -> serve
         # journey): restore the train state as saved — no optimizer
-        # reconstruction — and keep only its params. Wrong-config
-        # mismatches surface as a friendly shape check below.
+        # reconstruction — and keep only its params.
         from ..checkpoint.manager import CheckpointManager
 
         # Partial restore of ONLY the params subtree: the saved
@@ -284,7 +213,7 @@ def run(
                     f"{exp.get(path, 'nothing')}"
                 )
         log(
-            f"[generate] restored params from {restore} "
+            f"[{tag}] restored params from {restore} "
             f"(step {restored_step})"
         )
     else:
@@ -306,7 +235,7 @@ def run(
         if restored_step is not None
         else "random init — no tokenizer here"
     )
-    log(f"[generate] {n_params / 1e6:.1f}M params ({src})")
+    log(f"[{tag}] {n_params / 1e6:.1f}M params ({src})")
 
     weight_bytes = None
     params_fp = None
@@ -334,19 +263,83 @@ def run(
         params = qparams
         weight_bytes = quant_lib.tree_bytes(params)
         log(
-            f"[generate] int8 weight-only quantization: {weight_bytes / 1e9:.2f} "
+            f"[{tag}] int8 weight-only quantization: {weight_bytes / 1e9:.2f} "
             f"GB on device (f32 would be {4 * n_params / 1e9:.2f} GB) "
             f"+{time.time() - t0:.1f}s"
         )
     elif restored_step is not None:
         # Restored params are host numpy; committed to the device ONCE
-        # here, or every jitted generate call (compile + each timed rep)
-        # would re-upload the whole tree and the reported tok/s would
-        # include per-call weight transfer (ADVICE r4). The quantize
-        # branch gets this for free from jit(quantize_tree).
+        # here, or every jitted call (compile + each timed rep) would
+        # re-upload the whole tree and the reported tok/s would include
+        # per-call weight transfer (ADVICE r4). The quantize branch gets
+        # this for free from jit(quantize_tree).
         params = jax.block_until_ready(
             jax.device_put(params, jax.devices()[0])
         )
+    return params, params_fp, n_params, weight_bytes, restored_step
+
+
+def run(
+    *,
+    config: str = "tiny",
+    batch_size: int = 8,
+    prompt_len: int = 64,
+    max_new_tokens: int = 64,
+    max_decode_len: int | None = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    quantize: str | None = None,
+    kv_quantize: str | None = None,
+    init_host: bool = False,
+    compare_unquantized: bool = False,
+    restore: str | None = None,
+    seed: int = 0,
+    log=print,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import llama as llama_lib
+    from .llama_train import CONFIGS
+
+    if quantize not in (None, "int8"):
+        raise ValueError(f"quantize={quantize!r} not in (None, 'int8')")
+    if compare_unquantized and (not quantize or init_host):
+        # The same-session A/B needs both trees resident — exactly what
+        # init_host models cannot do.
+        raise ValueError(
+            "compare_unquantized requires quantize and not init_host"
+        )
+
+    cfg = getattr(llama_lib, CONFIGS[config])(
+        decode=True,
+        # The cache is statically sized by max_decode_len; overriding it
+        # beyond prompt+new measures serving at a context budget without
+        # generating the whole window (the step cost is L-dependent
+        # regardless of fill — static shapes).
+        max_decode_len=max_decode_len or (prompt_len + max_new_tokens),
+        # attn_impl stays the config's default (flash for the llama
+        # configs): prefill runs causal self-attention over the prompt
+        # (blockwise — long prompts don't materialize scores against
+        # the cache budget); decode steps attend against the cache.
+        quantize=quantize,
+        kv_quantize=kv_quantize,
+    )
+    model = llama_lib.Llama(cfg)
+    log(
+        f"[generate] config={config} d_model={cfg.d_model} "
+        f"layers={cfg.n_layers} batch={batch_size} prompt={prompt_len} "
+        f"new={max_new_tokens} T={temperature} "
+        f"({jax.devices()[0].platform})"
+    )
+
+    params, params_fp, n_params, weight_bytes, restored_step = load_params(
+        cfg, config=config, restore=restore, quantize=quantize,
+        init_host=init_host, compare_unquantized=compare_unquantized,
+        seed=seed, log=log,
+    )
 
     prompt = jnp.asarray(
         np.random.default_rng(seed).integers(

@@ -1,0 +1,268 @@
+"""Quantization quality, measured end-to-end THROUGH the serving path.
+
+Reference analog: none (the reference is a training operator). VERDICT
+r4 Missing #2: every int8 check was structural (RMS bounds, logit
+closeness at random init); nobody had measured what int8 weights /
+int8 KV COST on TRAINED weights. This workload closes both halves of
+the quantization trade:
+
+- **Held-out loss through the serving path**: teacher-forced
+  next-token loss over held-out sequences computed by the REAL decode
+  stack — ``decode_forward`` in cache mode (``prefill_mode="cache"``),
+  chunked, so int8-KV evaluations actually READ the quantized cache the
+  way a serving request would (the train-path eval never touches the
+  cache). Variants: fp control, int8 weights, int8 weights + int8 KV.
+- **Next-token agreement drift vs context fill**: a greedy fp rollout
+  of N tokens from a held-out prompt, then each variant teacher-forced
+  over that SAME stream — per-position argmax agreement, windowed, so
+  scale-error compounding over a filling cache is visible as a falling
+  tail window. (Independent rollouts would trivially diverge at the
+  first disagreement and measure nothing.)
+
+Drive it at a trained checkpoint (``--restore`` — the production
+train -> checkpoint -> serve journey); the bench calls :func:`run`
+directly after its real-data byte-LM leg to put a ``quality`` record in
+the serving block.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def eval_serving_stream(cfg, params, tokens, *, chunk: int = 128):
+    """Teacher-forced pass of ``tokens`` [B, S] through the serving
+    decode stack (chunked cache-mode prefill): returns
+    ``(mean_nats, argmax [B, S-1])`` — the held-out next-token loss and
+    each position's greedy prediction, both computed by exactly the
+    numerics a serving request sees (int8 weights dequantized at use
+    sites, int8 KV read back from the quantized cache when
+    configured)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..models import llama as llama_lib
+    from ..models.llama import decode_forward, init_decode_cache
+
+    B, S = tokens.shape
+    if cfg.max_decode_len < S:
+        raise ValueError(
+            f"max_decode_len {cfg.max_decode_len} < sequence {S}"
+        )
+    model = llama_lib.Llama(
+        dataclasses.replace(cfg, prefill_mode="cache")
+    )
+
+    def chunk_step(cache, chunk_toks, positions):
+        logits, cache = decode_forward(
+            model, params, cache, chunk_toks, positions,
+            return_hidden=False,
+        )
+        return logits, cache
+
+    step = jax.jit(chunk_step, donate_argnums=(0,))
+    cache = init_decode_cache(cfg, B)
+    total = 0.0
+    count = 0
+    preds = []
+    for start in range(0, S, chunk):
+        size = min(chunk, S - start)
+        toks = tokens[:, start : start + size]
+        positions = jnp.broadcast_to(
+            jnp.arange(start, start + size, dtype=jnp.int32), (B, size)
+        )
+        logits, cache = step(cache, toks, positions)
+        # logits[:, j] predicts token start+j+1.
+        targets = tokens[:, start + 1 : start + size + 1]
+        t = targets.shape[1]  # == size except at the sequence end
+        if t:
+            total += float(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits[:, :t].astype(jnp.float32), targets
+                ).sum()
+            )
+            count += B * t
+        preds.append(jnp.argmax(logits, axis=-1).astype(jnp.int32))
+    import numpy as np
+
+    return total / count, np.concatenate(
+        [np.asarray(p) for p in preds], axis=1
+    )[:, : S - 1]
+
+
+def run(
+    *,
+    config: str = "tiny",
+    restore: str,
+    eval_file: str,
+    eval_batches: int = 2,
+    batch_size: int = 8,
+    seq_len: int | None = None,
+    chunk: int = 128,
+    drift_tokens: int = 2048,
+    drift_window: int = 256,
+    drift_prompt: int = 128,
+    seed: int = 0,
+    log=print,
+) -> dict:
+    """Measure fp / int8 / int8+kv8 held-out loss through the serving
+    path, plus agreement drift over a ``drift_tokens`` rollout."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..data import open_training_loader
+    from ..models import llama as llama_lib
+    from ..ops.quantize import quantize_tree
+    from .generate import load_params, make_generate
+    from .llama_train import CONFIGS
+
+    # Held-out sequences from the packed eval file (same format the
+    # trainer's --eval-file takes).
+    loader = open_training_loader(eval_file, batch_size, seed=1)
+    batches = []
+    try:
+        for _ in range(eval_batches):
+            _, _, fields = loader.next_batch()
+            # COPY out of the borrowed slot: the native loader's field
+            # arrays are zero-copy views into a prefetch ring slot that
+            # is recycled on the next next_batch()/close() — holding
+            # the view past either reads freed memory (out-of-range
+            # "tokens" turned every eval loss NaN when this was
+            # np.asarray).
+            batches.append(np.array(fields["tokens"], np.int32, copy=True))
+    finally:
+        loader.close()
+    eval_tokens = np.concatenate(batches, axis=0).astype(np.int32)
+    if seq_len:
+        eval_tokens = eval_tokens[:, :seq_len]
+    S = eval_tokens.shape[1]
+    L = max(S, drift_prompt + drift_tokens)
+
+    base = getattr(llama_lib, CONFIGS[config])(
+        decode=True, max_decode_len=L
+    )
+    params_fp, _, n_params, _, restored_step = load_params(
+        base, config=config, restore=restore, seed=seed, log=log,
+        tag="quality",
+    )
+    params_q = jax.jit(quantize_tree)(params_fp)
+
+    variants = {
+        "fp": (base, params_fp),
+        "int8": (dataclasses.replace(base, quantize="int8"), params_q),
+        "int8_kv8": (
+            dataclasses.replace(base, quantize="int8", kv_quantize="int8"),
+            params_q,
+        ),
+    }
+    out = {
+        "config": config,
+        "restored_step": restored_step,
+        "params_m": round(n_params / 1e6, 1),
+        "eval_rows": int(eval_tokens.shape[0]),
+        "eval_seq_len": int(S),
+    }
+    toks_dev = jnp.asarray(eval_tokens, jnp.int32)
+    preds = {}
+    for name, (cfg_v, p_v) in variants.items():
+        loss, pred = eval_serving_stream(cfg_v, p_v, toks_dev, chunk=chunk)
+        preds[name] = pred
+        out[f"{name}_eval_loss"] = round(loss, 4)
+        log(f"[quality] {name}: held-out loss {loss:.4f} (serving path)")
+    out["int8_loss_delta"] = round(
+        out["int8_eval_loss"] - out["fp_eval_loss"], 4
+    )
+    out["int8_kv8_loss_delta"] = round(
+        out["int8_kv8_eval_loss"] - out["fp_eval_loss"], 4
+    )
+    # Argmax agreement with the fp serving path on the same held-out
+    # context (position-for-position, identical prefixes).
+    for name in ("int8", "int8_kv8"):
+        out[f"{name}_eval_argmax_agreement"] = round(
+            float((preds[name] == preds["fp"]).mean()), 4
+        )
+
+    # ---- drift vs context fill: greedy fp rollout, each variant
+    # teacher-forced over the SAME stream, windowed agreement.
+    rng = np.random.default_rng(seed + 1)
+    row = int(rng.integers(0, eval_tokens.shape[0]))
+    prompt = eval_tokens[row : row + 1, :drift_prompt]
+    fp_model = llama_lib.Llama(base)
+    gen = make_generate(fp_model, max_new_tokens=drift_tokens)
+    from ..models.llama import init_decode_cache
+
+    rollout, _ = gen(
+        params_fp, init_decode_cache(base, 1),
+        jnp.asarray(prompt, jnp.int32), jax.random.key(seed),
+    )
+    stream = np.concatenate(
+        [prompt, np.asarray(rollout)], axis=1
+    )  # [1, drift_prompt + drift_tokens]
+    stream_dev = jnp.asarray(stream, jnp.int32)
+    drift = {}
+    for name in ("int8", "int8_kv8"):
+        cfg_v, p_v = variants[name]
+        _, pred = eval_serving_stream(cfg_v, p_v, stream_dev, chunk=chunk)
+        # Agreement with the stream itself over the GENERATED region:
+        # the stream is the fp greedy continuation, so matching it IS
+        # next-token agreement with fp under identical context.
+        # Token i of the stream (i >= drift_prompt) is predicted from
+        # position i-1 — pred index i-1 spans [drift_prompt-1, T-2],
+        # i.e. the whole tail of pred.
+        gen_region_pred = pred[0, drift_prompt - 1 :]
+        gen_region_true = stream[0, drift_prompt:]
+        agree = gen_region_pred == gen_region_true
+        n = agree.shape[0]
+        w = min(drift_window, n // 2)
+        drift[name] = {
+            "overall": round(float(agree.mean()), 4),
+            f"first_{w}": round(float(agree[:w].mean()), 4),
+            f"last_{w}": round(float(agree[-w:].mean()), 4),
+            "tokens": int(n),
+        }
+        log(f"[quality] {name} drift: {drift[name]}")
+    out["drift"] = drift
+    return out
+
+
+def main(argv=None) -> int:
+    from .llama_train import CONFIGS
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", choices=sorted(CONFIGS), default="tiny")
+    p.add_argument("--restore", required=True, metavar="CKPT_DIR")
+    p.add_argument("--eval-file", required=True)
+    p.add_argument("--eval-batches", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=None)
+    p.add_argument("--chunk", type=int, default=128)
+    p.add_argument("--drift-tokens", type=int, default=2048)
+    p.add_argument("--drift-window", type=int, default=256)
+    p.add_argument("--drift-prompt", type=int, default=128)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    result = run(
+        config=args.config,
+        restore=args.restore,
+        eval_file=args.eval_file,
+        eval_batches=args.eval_batches,
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        chunk=args.chunk,
+        drift_tokens=args.drift_tokens,
+        drift_window=args.drift_window,
+        drift_prompt=args.drift_prompt,
+        seed=args.seed,
+        log=lambda m: print(m, flush=True),
+    )
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

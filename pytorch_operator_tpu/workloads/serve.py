@@ -1,0 +1,262 @@
+"""Persistent serving job: spool-fed continuous batching under the
+supervisor.
+
+Reference analog: SURVEY §1's spec -> supervisor -> workload chain —
+the operator's long-running reconciled workload — applied to inference.
+Where ``workloads/generate.py`` decodes ONE fixed batch and exits (the
+benchmark shape), this runs indefinitely: clients drop requests into a
+spool directory (serving/spool.py — this environment's Service
+substrate), the engine (serving/engine.py) admits them into cache slots
+at decode-block boundaries, finished requests free their slot for the
+next arrival, and responses carry the per-request latency record (TTFT,
+per-token). Progress/metrics flow through the same rendezvous surface
+training workloads use, so ``tpujob describe`` shows a serving job's
+live throughput exactly like a training job's.
+
+The train -> checkpoint -> serve journey: point ``--restore`` at a
+training job's checkpoint directory (params-only partial restore;
+optimizer state never touches host memory).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from ..runtime import rendezvous
+
+
+def run(
+    *,
+    config: str = "tiny",
+    spool_dir: str,
+    slots: int = 8,
+    chunk: int = 64,
+    block: int = 16,
+    max_decode_len: int = 2048,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    eos_token: int | None = None,
+    quantize: str | None = None,
+    kv_quantize: str | None = None,
+    init_host: bool = False,
+    restore: str | None = None,
+    max_requests: int = 0,
+    idle_timeout: float = 0.0,
+    poll_interval: float = 0.05,
+    report_every: float = 5.0,
+    seed: int = 0,
+    log=print,
+) -> dict:
+    """The serving loop. ``max_requests``/``idle_timeout`` bound the run
+    for tests and benches; both 0 means serve forever (the production
+    daemon shape — the supervisor owns the lifecycle)."""
+    import jax
+    import numpy as np
+
+    from ..models import llama as llama_lib
+    from ..serving import Request, ServingEngine, Spool
+    from .generate import load_params
+    from .llama_train import CONFIGS
+
+    cfg = getattr(llama_lib, CONFIGS[config])(
+        decode=True,
+        max_decode_len=max_decode_len,
+        quantize=quantize,
+        kv_quantize=kv_quantize,
+    )
+    log(
+        f"[serve] config={config} slots={slots} chunk={chunk} "
+        f"block={block} L={max_decode_len} spool={spool_dir} "
+        f"({jax.devices()[0].platform})"
+    )
+    params, _, n_params, weight_bytes, restored_step = load_params(
+        cfg, config=config, restore=restore, quantize=quantize,
+        init_host=init_host, seed=seed, log=log, tag="serve",
+    )
+    engine = ServingEngine(
+        cfg, params, slots=slots, chunk=chunk, block=block,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        eos_token=eos_token, seed=seed,
+    )
+    spool = Spool(spool_dir)
+    rendezvous.report_first_step(0)
+
+    served = 0
+    rejected = 0
+    last_activity = time.time()
+    last_report = 0.0
+    synth_rng = np.random.default_rng(seed)
+
+    def to_request(rec: dict) -> Request:
+        if rec.get("prompt") is not None:
+            prompt = np.asarray(rec["prompt"], np.int32)
+        else:
+            # Synthetic prompt of the requested length (no tokenizer in
+            # this environment); deterministic per request id ACROSS
+            # processes (crc32, not str hash — PYTHONHASHSEED randomizes
+            # the latter, which would break claimed-request replay after
+            # an engine restart).
+            import zlib
+
+            seed_ = zlib.crc32(rec["id"].encode())
+            prompt = np.random.default_rng(seed_).integers(
+                0, cfg.vocab_size, (int(rec["prompt_len"]),)
+            ).astype(np.int32)
+        return Request(
+            id=rec["id"],
+            prompt=prompt,
+            max_new_tokens=int(rec["max_new_tokens"]),
+            submit_time=float(rec["submit_time"]),
+        )
+
+    def finish(res) -> None:
+        nonlocal served, last_activity
+        spool.respond(
+            res.id,
+            {
+                "id": res.id,
+                "tokens": res.tokens,
+                "prompt_len": res.prompt_len,
+                "ttft_ms": round(1000 * res.ttft_s, 3),
+                "admit_wait_ms": round(1000 * res.admit_wait_s, 3),
+                "tpot_ms": (
+                    round(1000 * res.tpot_s, 3)
+                    if res.tpot_s is not None
+                    else None
+                ),
+            },
+        )
+        served += 1
+        last_activity = time.time()
+
+    while True:
+        # Admission feed: claim enough to keep the slots fed one
+        # iteration ahead.
+        for rec in spool.claim(2 * slots - engine.queued):
+            try:
+                engine.submit(to_request(rec))
+                last_activity = time.time()
+            except (ValueError, KeyError, TypeError) as e:
+                rejected += 1
+                spool.respond(rec.get("id", "unknown"), {"error": str(e)})
+        if engine.busy:
+            for res in engine.step():
+                finish(res)
+        else:
+            time.sleep(poll_interval)
+        now = time.time()
+        if now - last_report > report_every:
+            last_report = now
+            s = engine.stats()
+            rendezvous.report_metrics(
+                served,
+                serve_requests=served,
+                serve_pending=spool.pending_count(),
+                serve_decode_tokens_per_sec=s["decode_tokens_per_sec"],
+                serve_ttft_ms_p50=s["ttft_ms_p50"],
+                serve_tpot_ms_p50=s["tpot_ms_p50"],
+            )
+        if max_requests and served >= max_requests and not engine.busy:
+            break
+        if (
+            idle_timeout
+            and not engine.busy
+            and now - last_activity > idle_timeout
+        ):
+            log(f"[serve] idle for {idle_timeout}s, exiting")
+            break
+
+    stats = engine.stats()
+    stats.update(
+        served=served,
+        rejected=rejected,
+        params_m=round(n_params / 1e6, 1),
+        config=config,
+    )
+    if weight_bytes is not None:
+        stats["weight_mb"] = round(weight_bytes / 1e6, 2)
+    if restored_step is not None:
+        stats["restored_step"] = restored_step
+    n_dev = jax.device_count()
+    if stats["decode_tokens_per_sec"]:
+        stats["decode_tokens_per_sec_per_chip"] = round(
+            stats["decode_tokens_per_sec"] / n_dev, 1
+        )
+    rendezvous.report_metrics(served, **{
+        k: v for k, v in stats.items()
+        if isinstance(v, (int, float)) and v is not None
+    })
+    log(f"[serve] done: {json.dumps(stats)}")
+    return stats
+
+
+def main(argv=None) -> int:
+    from .llama_train import CONFIGS
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", choices=sorted(CONFIGS), default="tiny")
+    p.add_argument(
+        "--spool", required=True,
+        help="spool directory (requests/ claimed/ responses/) — the "
+        "serving job's request surface",
+    )
+    p.add_argument("--slots", type=int, default=8,
+                   help="concurrent cache slots (the serving batch)")
+    p.add_argument("--chunk", type=int, default=64,
+                   help="prefill chunk length (bounds prefill memory)")
+    p.add_argument("--block", type=int, default=16,
+                   help="decode steps per dispatch; admission happens "
+                   "at block boundaries")
+    p.add_argument("--max-decode-len", type=int, default=2048)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--eos-token", type=int, default=None)
+    p.add_argument("--quantize", choices=["int8"], default=None)
+    p.add_argument("--kv-quantize", choices=["int8"], default=None)
+    p.add_argument("--init-host", action="store_true")
+    p.add_argument("--restore", default=None, metavar="CKPT_DIR")
+    p.add_argument(
+        "--max-requests", type=int, default=0,
+        help="exit after serving N requests (0 = serve forever)",
+    )
+    p.add_argument(
+        "--idle-timeout", type=float, default=0.0,
+        help="exit after this many idle seconds (0 = serve forever)",
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    world = rendezvous.initialize_from_env()
+    stats = run(
+        config=args.config,
+        spool_dir=args.spool,
+        slots=args.slots,
+        chunk=args.chunk,
+        block=args.block,
+        max_decode_len=args.max_decode_len,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        eos_token=args.eos_token,
+        quantize=args.quantize,
+        kv_quantize=args.kv_quantize,
+        init_host=args.init_host,
+        restore=args.restore,
+        max_requests=args.max_requests,
+        idle_timeout=args.idle_timeout,
+        seed=args.seed,
+        log=lambda msg: print(msg, flush=True),
+    )
+    if args.json and world.process_id == 0:
+        print(json.dumps(stats), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

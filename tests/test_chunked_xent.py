@@ -89,6 +89,7 @@ class TestForward:
 
 
 class TestLlamaIntegration:
+    @pytest.mark.slow
     def test_chunked_llama_matches_dense_loss(self):
         """End-to-end through the shared trainer: the chunked path's loss and
         first train step must agree with the dense path."""

@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 
 import numpy as np
+import pytest
 
 import tests.jaxenv  # noqa: F401
 from pytorch_operator_tpu.models import llama as llama_lib
@@ -55,6 +56,7 @@ def _greedy_reference(train_model, params, prompt, new):
 
 
 class TestGenerate:
+    @pytest.mark.slow
     def test_greedy_cache_decode_matches_full_forward(self):
         import jax
 
@@ -215,6 +217,7 @@ class TestGenerate:
         assert t.shape == (2, new)
         assert ((t >= 0) & (t < cfg.vocab_size)).all()
 
+    @pytest.mark.slow
     def test_flash_prefill_matches_dense_prefill(self):
         """Long-prompt serving: prefill runs causal self-attention over
         the prompt (flash when configured) instead of materializing

@@ -146,6 +146,7 @@ class TestLlamaImport:
         )
         assert toks.shape == (1, 8)
 
+    @pytest.mark.slow
     def test_imported_weights_quantize_and_decode_int8(self):
         """The serving path end to end: a real (HF-layout) checkpoint
         imports, quantizes to int8 (the importer's tree uses the same
@@ -219,6 +220,7 @@ class TestLlamaImport:
         for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    @pytest.mark.slow
     def test_export_trained_flax_params(self):
         """Params born in THIS framework (flax init, boxed metadata)
         export to a state_dict the torch reference can run."""

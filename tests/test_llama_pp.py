@@ -20,6 +20,10 @@ from pytorch_operator_tpu.workloads.trainer import (
     make_lm_train_step,
 )
 
+# Fast-lane exclusion (-m 'not slow'): pp-schedule numerics parity,
+# ~30-60s per test.
+pytestmark = pytest.mark.slow
+
 
 def _tokens(b=8, s=16, seed=0):
     import jax.numpy as jnp

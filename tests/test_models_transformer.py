@@ -89,6 +89,7 @@ class TestLlama:
             b = jax.jit(remat_model.apply)(variables, tokens)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
+    @pytest.mark.slow
     def test_flash_matches_dense(self, setup):
         """attn_impl='flash' (pallas kernel, sharded via shard_map over the
         dp/fsdp/tp mesh) reproduces the dense path's logits and grads."""

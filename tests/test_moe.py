@@ -9,6 +9,9 @@ import tests.jaxenv  # noqa: F401
 from pytorch_operator_tpu.parallel import make_mesh
 from pytorch_operator_tpu.parallel.moe import moe_mlp
 
+# Fast-lane exclusion (-m 'not slow'): MoE training + dispatch parity runs.
+pytestmark = pytest.mark.slow
+
 
 def _params(e, d, f, seed=0):
     rng = np.random.default_rng(seed)

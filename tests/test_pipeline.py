@@ -74,6 +74,7 @@ class TestPipelineForward:
 
 
 class TestPipelineBackward:
+    @pytest.mark.slow
     def test_grads_match_sequential(self):
         """Autodiff through the pipeline = the reverse schedule; grads must
         equal the unpipelined model's."""

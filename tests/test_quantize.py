@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 
 import numpy as np
+import pytest
 
 import tests.jaxenv  # noqa: F401
 from pytorch_operator_tpu.models import llama as llama_lib
@@ -253,6 +254,7 @@ class TestQuantizedGenerate:
         assert result["tokens_per_sec_per_chip_unquantized"] > 0
         assert result["int8_speedup"] > 0
 
+    @pytest.mark.slow
     def test_init_host_path_runs(self):
         """Host-init + host-quantize + device_put (the 8B-on-one-chip
         path) — on CPU the 'transfer' is trivial but the code path and
@@ -288,6 +290,7 @@ class TestKVQuantize:
         )
         assert layer["key_scale"].dtype == np.float32
 
+    @pytest.mark.slow
     def test_decode_forward_matches_flax_apply(self):
         """The unrolled serving path (decode_forward — flat per-layer
         cache, token-slice writes) is numerically IDENTICAL to the flax
@@ -374,6 +377,7 @@ class TestKVQuantize:
         rms = np.sqrt(((got - ref) ** 2).mean()) / np.sqrt((ref**2).mean())
         assert rms < 0.02, rms
 
+    @pytest.mark.slow
     def test_generate_runs_and_tracks_fp_rollout(self):
         """End to end through make_generate: the int8-cache rollout is
         valid tokens; on this tiny model the greedy path stays within
@@ -400,6 +404,7 @@ class TestKVQuantize:
             np.asarray(t_q)[:, :2], np.asarray(t_fp)[:, :2]
         )
 
+    @pytest.mark.slow
     def test_moe_decode_forward_matches_flax_apply(self):
         """The unrolled serving path must also carry MoE blocks (router
         + expert banks slice per layer like any stacked leaf)."""
@@ -431,6 +436,7 @@ class TestKVQuantize:
             np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6
         )
 
+    @pytest.mark.slow
     def test_quantized_moe_decode_runs(self):
         """Quantized expert banks (w_in/w_out QuantizedTensors) slice
         and dequantize per layer through the serving path."""
@@ -459,6 +465,7 @@ class TestKVQuantize:
         )
         np.testing.assert_array_equal(np.asarray(got_q), np.asarray(ref))
 
+    @pytest.mark.slow
     def test_decode_forward_tp_sharded_matches_unsharded(self):
         """Distributed serving: decode_forward under a dp×fsdp×tp mesh
         with born-sharded params (logical rules: heads/mlp/vocab over

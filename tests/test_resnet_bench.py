@@ -83,6 +83,7 @@ class TestResNetModel:
 
 
 class TestBench:
+    @pytest.mark.slow
     def test_bench_smoke_emits_schema(self, capsys):
         import bench
 
@@ -116,6 +117,7 @@ class TestBench:
         lat = result["schedule_to_first_step_s"]
         assert lat["cold"] > 0 and lat["warm"] > 0
 
+    @pytest.mark.slow
     def test_bench_smoke_no_latency_flag(self):
         import bench
 
@@ -140,6 +142,7 @@ class TestBench:
 
 
 class TestDataFileMode:
+    @pytest.mark.slow
     def test_trains_from_packed_file(self, tmp_path):
         """Real-data path: distinct per-step batches from the native
         prefetch loader, scanned inside one dispatch."""
@@ -164,6 +167,7 @@ class TestDataFileMode:
         assert np.isfinite(result["final_loss"])
         assert result["value"] > 0
 
+    @pytest.mark.slow
     def test_labels_exceeding_classes_rejected(self, tmp_path):
         from pytorch_operator_tpu.data.pack import main as pack_main
         from pytorch_operator_tpu.workloads.resnet_bench import run_benchmark
@@ -179,6 +183,7 @@ class TestDataFileMode:
                 data_file=str(out), log=lambda *_: None,
             )
 
+    @pytest.mark.slow
     def test_bad_label_beyond_first_chunk_rejected(self, tmp_path):
         """ADVICE r2: the old first-chunk latch sampled only the first
         drawn batches; a bad label in a later record one-hotted to a zero
@@ -226,6 +231,7 @@ class TestDataFileMode:
 
 
 class TestProfileTrace:
+    @pytest.mark.slow
     def test_profile_dir_writes_trace(self, tmp_path):
         from pytorch_operator_tpu.workloads.resnet_bench import run_benchmark
 
@@ -282,6 +288,7 @@ class TestGraftEntry:
         # Flagship LM (llama 0.3b): logits [batch, seq, vocab].
         assert out.shape == (4, 1024, 32000)
 
+    @pytest.mark.slow
     def test_dryrun_multichip_8(self, capsys):
         import __graft_entry__ as g
 
@@ -490,6 +497,7 @@ class TestBenchArtifactContract:
             assert c["serving"]["vs_baseline"] == pytest.approx(0.9957)
             assert c["schedule_to_first_step_s"]["warm"] == 1.297
 
+    @pytest.mark.slow
     def test_main_final_stdout_line_is_compact(self, tmp_path):
         """End-to-end: `python bench.py --smoke` must end stdout with a
         parseable line under the cap, and write the detail sidecar."""

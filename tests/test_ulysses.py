@@ -17,6 +17,9 @@ from pytorch_operator_tpu.parallel import make_mesh
 from pytorch_operator_tpu.parallel.ring import _single_shard
 from pytorch_operator_tpu.parallel.ulysses import ulysses_self_attention
 
+# Fast-lane exclusion (-m 'not slow'): sp-mesh training runs.
+pytestmark = pytest.mark.slow
+
 
 def _qkv(B=2, S=32, K=4, G=2, D=8, dtype=jnp.float32, seed=0):
     rng = np.random.default_rng(seed)

@@ -9,6 +9,9 @@ import tests.jaxenv  # noqa: F401
 from pytorch_operator_tpu.models import vit as vit_lib
 from pytorch_operator_tpu.parallel import make_mesh
 
+# Fast-lane exclusion (-m 'not slow'): real ViT training/remat runs.
+pytestmark = pytest.mark.slow
+
 
 def tiny_cfg(**over):
     return vit_lib.ViTConfig(

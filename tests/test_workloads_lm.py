@@ -11,6 +11,9 @@ import tests.jaxenv  # noqa: F401
 
 from pytorch_operator_tpu.workloads import bert_fsdp, llama_train
 
+# Fast-lane exclusion (-m 'not slow'): full llama workload runs (resume/accum/optimizers).
+pytestmark = pytest.mark.slow
+
 
 def test_bert_fsdp_learns_and_shards_opt_state():
     import jax
