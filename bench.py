@@ -507,15 +507,11 @@ def run(argv=None) -> dict:
             # detail under llama_real_data.quality_detail in the sidecar.
             qd = (llama_data_block or {}).get("quality_detail")
             if qd:
-                kv8 = qd["drift"]["int8_kv8"]
-                last_key = next(
-                    (k for k in kv8 if k.startswith("last_")), None
-                )
                 decode_block["quality"] = {
                     "fp_eval_loss": qd["fp_eval_loss"],
                     "int8_eval_loss": qd["int8_eval_loss"],
                     "int8_kv8_eval_loss": qd["int8_kv8_eval_loss"],
-                    "kv8_drift_last_window": kv8.get(last_key),
+                    "kv8_drift_last_window": qd["drift"]["int8_kv8"]["last"],
                 }
         except Exception as e:
             log(f"[bench] serving decode bench failed: {e!r}")

@@ -14,6 +14,7 @@ the same tests run against :class:`~.runner.FakeRunner`.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from pathlib import Path
@@ -47,6 +48,15 @@ from .status import (
     master_handle,
     update_replica_statuses,
 )
+
+# Crash-loop backoff schedule (kubelet CrashLoopBackOff analog): the
+# FIRST failure respawns immediately (preemption recovery must not
+# wait), then a replica that keeps dying QUICKLY respawns after
+# base * 2^(streak-2) seconds, capped; a failed run that lived at least
+# the reset uptime counts as healthy-then-died and restarts the streak.
+CRASH_BACKOFF_BASE_S = 1.0
+CRASH_BACKOFF_CAP_S = 300.0
+CRASH_RESET_UPTIME_S = 600.0
 
 
 class Reconciler:
@@ -98,8 +108,37 @@ class Reconciler:
         # would both observe a missing replica and double-create it.
         self._key_locks: dict = {}
         self._key_locks_guard = threading.Lock()
+        # Crash-loop backoff (kubelet CrashLoopBackOff analog — the
+        # reference delegates per-pod respawn damping to the kubelet;
+        # this supervisor IS its own kubelet). replica name ->
+        # (consecutive quick failures, earliest respawn time). A replica
+        # whose failed run lived >= CRASH_RESET_UPTIME_S counts as
+        # healthy-then-died and resets the streak, so long-running jobs
+        # killed by preemption restart after one base delay while a
+        # replica dying at startup backs off exponentially instead of
+        # respawning every sync pass (observed: an argparse-rejected
+        # workload restarted ~2x/second, 1300 restarts in 10 minutes).
+        self._crash_backoff: dict = {}
 
     # ---- helpers ----
+
+    def prune_crash_backoff(self, key: str) -> None:
+        """Drop crash-loop state for exactly this job's replicas.
+
+        Exact replica-name structure match (``<key>-<type>-<index>``),
+        NOT a string prefix: job ``default/train`` finishing must not
+        also purge ``default/train-2``'s streak (the same trap
+        _reset_status_dir documents). Called on job finish AND by
+        Supervisor.delete_job — a same-name resubmission starts with a
+        clean slate either way."""
+        pat = re.compile(
+            re.escape(key)
+            + r"-(?:"
+            + "|".join(rt.value.lower() for rt in ReplicaType)
+            + r")-\d+$"
+        )
+        for name in [n for n in self._crash_backoff if pat.fullmatch(n)]:
+            del self._crash_backoff[name]
 
     @staticmethod
     def job_subdir(root: Optional[Path], key: str) -> Optional[str]:
@@ -297,6 +336,7 @@ class Reconciler:
         self.expectations.delete_expectations(key)
         self._unschedulable_warned.discard(key)
         self._pass_reservations.pop(key, None)
+        self.prune_crash_backoff(key)
 
     def _reset_status_dir(self, key: str) -> None:
         """Clear a prior incarnation's status reports (and their scan
@@ -531,6 +571,29 @@ class Reconciler:
         # blocks at rendezvous forever, and the shrink arithmetic assumes
         # "master admitted first") — enforce it with a stable sort.
         missing.sort(key=lambda mi: mi[0] != ReplicaType.MASTER)
+
+        if missing:
+            # Crash-loop backoff gate: while ANY missing replica is
+            # inside its respawn delay, hold the WHOLE job's creation
+            # (partial creation would break the master-first gang
+            # prefix); the poll loop retries next pass.
+            held = max(
+                (
+                    self._crash_backoff[replica_name(key, rt, i)][1] - now
+                    for rt, i in missing
+                    if replica_name(key, rt, i) in self._crash_backoff
+                ),
+                default=0.0,
+            )
+            if held > 0:
+                self.events.warning(
+                    key, "CrashLoopBackOff",
+                    "delaying respawn after repeated quick failures "
+                    "(exponential backoff, capped at "
+                    f"{CRASH_BACKOFF_CAP_S:.0f}s).",
+                )
+                self.store.update(job)
+                return True
 
         if missing:
             total = sum(self._desired_replicas(job, rt) for rt in job.spec.replica_specs)
@@ -820,6 +883,24 @@ class Reconciler:
         replicas are torn down and recreated with a fresh world (SURVEY.md §5
         "Failure detection / elastic recovery").
         """
+        # Record crash-loop state BEFORE the failed handles are deleted:
+        # respawn (next sync's create pass) honors the delay.
+        for h in restarts:
+            if h.phase != ReplicaPhase.FAILED:
+                continue
+            uptime = (h.finished_at or now) - (h.created_at or now)
+            streak, _ = self._crash_backoff.get(h.name, (0, 0.0))
+            streak = 1 if uptime >= CRASH_RESET_UPTIME_S else streak + 1
+            delay = (
+                0.0
+                if streak == 1
+                else min(
+                    CRASH_BACKOFF_CAP_S,
+                    CRASH_BACKOFF_BASE_S * 2 ** (streak - 2),
+                )
+            )
+            self._crash_backoff[h.name] = (streak, now + delay)
+
         elastic = job.spec.elastic_policy
         n_new_restarts = len(restarts)
         backoff = job.spec.run_policy.backoff_limit

@@ -146,6 +146,7 @@ class Supervisor:
             )
             self.gang.delete_group(key)
             self.expectations.delete_expectations(key)
+            self.reconciler.prune_crash_backoff(key)
             if job is not None:
                 self.store.delete(key)
             self.events.drop_job(key)

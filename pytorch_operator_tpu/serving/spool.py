@@ -7,8 +7,11 @@ supervisor's store/progress layers ride). The protocol is the classic
 maildir trick: writers create a temp file and ``rename`` it into place
 — rename is atomic on POSIX, so the scanner never sees a torn file —
 and the engine claims a request by renaming it out of ``requests/``,
-so a crashed engine leaves claims visible for inspection instead of
-silently re-running them.
+so an in-flight request is never double-served. A crashed engine
+leaves its claims in ``claimed/``; the serve workload calls
+:meth:`Spool.recover_claimed` at startup to move them back into
+``requests/`` (the supervisor's restart policy re-runs the job, and
+the orphaned clients would otherwise wait out their timeouts).
 
 Layout under the spool root:
 
@@ -111,6 +114,28 @@ class Spool:
             except (OSError, json.JSONDecodeError):
                 continue
         return out
+
+    def recover_claimed(self) -> int:
+        """Move claims a dead engine left behind back into ``requests/``
+        (skipping any that already have a response). Returns how many
+        were recovered; call once at engine startup."""
+        n = 0
+        try:
+            stuck = list(self.claimed.iterdir())
+        except FileNotFoundError:
+            return n
+        for path in stuck:
+            if path.suffix != ".json":
+                continue
+            if (self.responses / path.name).exists():
+                path.unlink(missing_ok=True)
+                continue
+            try:
+                os.rename(path, self.requests / path.name)
+                n += 1
+            except FileNotFoundError:
+                continue
+        return n
 
     def respond(self, request_id: str, record: dict) -> None:
         tmp = self.responses / f".{request_id}.tmp"

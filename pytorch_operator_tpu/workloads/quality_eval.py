@@ -57,14 +57,17 @@ def eval_serving_stream(cfg, params, tokens, *, chunk: int = 128):
         dataclasses.replace(cfg, prefill_mode="cache")
     )
 
-    def chunk_step(cache, chunk_toks, positions):
+    def chunk_step(p, cache, chunk_toks, positions):
+        # params as an ARGUMENT, never a closure constant: the tunneled
+        # backend embeds jit closure constants in the remote-compile
+        # HTTP request — a 1.2 GB tree broke the transport outright.
         logits, cache = decode_forward(
-            model, params, cache, chunk_toks, positions,
+            model, p, cache, chunk_toks, positions,
             return_hidden=False,
         )
         return logits, cache
 
-    step = jax.jit(chunk_step, donate_argnums=(0,))
+    step = jax.jit(chunk_step, donate_argnums=(1,))
     cache = init_decode_cache(cfg, B)
     total = 0.0
     count = 0
@@ -75,7 +78,7 @@ def eval_serving_stream(cfg, params, tokens, *, chunk: int = 128):
         positions = jnp.broadcast_to(
             jnp.arange(start, start + size, dtype=jnp.int32), (B, size)
         )
-        logits, cache = step(cache, toks, positions)
+        logits, cache = step(params, cache, toks, positions)
         # logits[:, j] predicts token start+j+1.
         targets = tokens[:, start + 1 : start + size + 1]
         t = targets.shape[1]  # == size except at the sequence end
@@ -219,10 +222,13 @@ def run(
         agree = gen_region_pred == gen_region_true
         n = agree.shape[0]
         w = min(drift_window, n // 2)
+        # Fixed key names (consumers index directly; the window size is
+        # its own field).
         drift[name] = {
             "overall": round(float(agree.mean()), 4),
-            f"first_{w}": round(float(agree[:w].mean()), 4),
-            f"last_{w}": round(float(agree[-w:].mean()), 4),
+            "first": round(float(agree[:w].mean()), 4),
+            "last": round(float(agree[-w:].mean()), 4),
+            "window": int(w),
             "tokens": int(n),
         }
         log(f"[quality] {name} drift: {drift[name]}")

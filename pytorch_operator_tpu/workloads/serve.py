@@ -83,6 +83,12 @@ def run(
         eos_token=eos_token, seed=seed,
     )
     spool = Spool(spool_dir)
+    recovered = spool.recover_claimed()
+    if recovered:
+        # A previous life of this job (the supervisor's restart policy)
+        # died with claims in flight; they're requests again now.
+        log(f"[serve] recovered {recovered} claimed request(s) from a "
+            "previous life")
     rendezvous.report_first_step(0)
 
     served = 0
@@ -160,6 +166,15 @@ def run(
                 serve_ttft_ms_p50=s["ttft_ms_p50"],
                 serve_tpot_ms_p50=s["tpot_ms_p50"],
             )
+            # The LIVE operator surface (`tpujob describe` Training
+            # block + per-job gauges) folds only progress records —
+            # report through it like training workloads do, with
+            # served requests as the step counter.
+            rendezvous.report_progress(
+                served,
+                throughput=s["decode_tokens_per_sec"] or 0.0,
+                unit="tok/s",
+            )
         if max_requests and served >= max_requests and not engine.busy:
             break
         if (
@@ -228,6 +243,11 @@ def main(argv=None) -> int:
         "--idle-timeout", type=float, default=0.0,
         help="exit after this many idle seconds (0 = serve forever)",
     )
+    p.add_argument(
+        "--report-every", type=float, default=5.0,
+        help="seconds between progress/metrics reports to the "
+        "supervisor surface",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
@@ -250,6 +270,7 @@ def main(argv=None) -> int:
         restore=args.restore,
         max_requests=args.max_requests,
         idle_timeout=args.idle_timeout,
+        report_every=args.report_every,
         seed=args.seed,
         log=lambda msg: print(msg, flush=True),
     )

@@ -85,8 +85,9 @@ class TestQualityEval:
         for name in ("int8", "int8_kv8"):
             d = q["drift"][name]
             assert d["tokens"] == 96  # the FULL generated region
+            assert d["window"] == 32
             assert 0.0 <= d["overall"] <= 1.0
-            assert d["first_32"] is not None and d["last_32"] is not None
+            assert d["first"] is not None and d["last"] is not None
             # Trained-model greedy agreement at tiny scale stays high.
             assert d["overall"] > 0.8, d
 

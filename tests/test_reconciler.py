@@ -353,11 +353,13 @@ class TestRestartPolicies:
         key = store.add(
             new_job(workers=1, restart_policy=RestartPolicy.ON_FAILURE, backoff_limit=2)
         )
+        t = 1000.0
         for i in range(3):
-            rec.sync(key)
+            rec.sync(key, now=t)
             runner.set_all_running(key)
             self._fail_worker(runner, key, 1)
-            rec.sync(key)
+            rec.sync(key, now=t)
+            t += 400.0  # past any crash-loop backoff delay
         job = store.get(key)
         assert job.is_failed()
         c = job.get_condition(ConditionType.FAILED)
@@ -485,3 +487,120 @@ class TestElastic:
         job = store.get(key)
         assert job.is_failed()
         assert job.get_condition(ConditionType.FAILED).reason == "MaxRestartsExceeded"
+
+
+class TestCrashLoopBackoff:
+    """Kubelet CrashLoopBackOff analog: a replica dying quickly respawns
+    after an exponentially growing delay instead of every sync pass
+    (observed live: an argparse-rejected workload restarted ~2x/second
+    under OnFailure with no backoff_limit)."""
+
+    def _fail_master(self, store, runner, key, t):
+        name = replica_name(key, ReplicaType.MASTER, 0)
+        runner.set_phase(name, ReplicaPhase.FAILED, exit_code=2)
+        return name
+
+    def test_quick_failures_back_off_exponentially(self):
+        store, runner, events, metrics, rec = make_harness()
+        key = store.add(new_job(workers=0))
+        t = 1000.0
+        rec.sync(key, now=t)  # create
+        spawns = 1
+        # Drive many fast sync passes with instant failures: respawn
+        # times must follow 1, 2, 4, 8... seconds, NOT once per pass.
+        respawn_gaps = []
+        last_spawn_t = t
+        for _ in range(5):
+            self._fail_master(store, runner, key, t)
+            rec.sync(key, now=t)  # classifies + deletes + records delay
+            # Poll every 0.25s until the replica respawns.
+            for _ in range(10000):
+                t += 0.25
+                rec.sync(key, now=t)
+                if runner.get(replica_name(key, ReplicaType.MASTER, 0)):
+                    respawn_gaps.append(t - last_spawn_t)
+                    last_spawn_t = t
+                    spawns += 1
+                    break
+            else:
+                raise AssertionError("replica never respawned")
+        # Kubelet schedule: first respawn immediate (one poll tick),
+        # then 1, 2, 4, 8 seconds — not once per pass.
+        assert [round(g) for g in respawn_gaps] == [0, 1, 2, 4, 8], (
+            respawn_gaps
+        )
+        assert any(
+            e.reason == "CrashLoopBackOff" for e in events.for_job(key)
+        )
+
+    def test_long_uptime_resets_the_streak(self):
+        from pytorch_operator_tpu.controller.reconciler import (
+            CRASH_RESET_UPTIME_S,
+        )
+
+        store, runner, events, metrics, rec = make_harness()
+        key = store.add(new_job(workers=0))
+        t = 1000.0
+        rec.sync(key, now=t)
+        name = replica_name(key, ReplicaType.MASTER, 0)
+        # Two quick failures build a streak...
+        for _ in range(2):
+            runner.set_phase(name, ReplicaPhase.FAILED, exit_code=2)
+            rec.sync(key, now=t)
+            t += 60.0
+            rec.sync(key, now=t)
+            assert runner.get(name) is not None
+        # ...then a LONG healthy run that dies (preemption shape).
+        h = runner.get(name)
+        h.created_at = t
+        runner.set_phase(name, ReplicaPhase.FAILED, exit_code=137)
+        h.finished_at = t + CRASH_RESET_UPTIME_S + 1
+        rec.sync(key, now=t)
+        # The streak reset to 1: respawn after ~base delay, not 8s.
+        t += 1.5
+        rec.sync(key, now=t)
+        assert runner.get(name) is not None
+
+    def test_backoff_state_cleared_on_job_finish(self):
+        store, runner, events, metrics, rec = make_harness()
+        key = store.add(new_job(workers=0))
+        rec.sync(key, now=1000.0)
+        name = replica_name(key, ReplicaType.MASTER, 0)
+        runner.set_phase(name, ReplicaPhase.FAILED, exit_code=2)
+        rec.sync(key, now=1000.0)
+        assert rec._crash_backoff  # recorded
+        # Next life succeeds: job finishes, state pruned.
+        rec.sync(key, now=1002.0)
+        runner.set_phase(name, ReplicaPhase.SUCCEEDED, exit_code=0)
+        rec.sync(key, now=1003.0)
+        assert store.get(key).is_succeeded()
+        assert not rec._crash_backoff
+
+    def test_prune_matches_exact_replica_names_only(self):
+        """'default/train' finishing must not purge sibling
+        'default/train-2''s streak (the _reset_status_dir trap)."""
+        store, runner, events, metrics, rec = make_harness()
+        rec._crash_backoff = {
+            "default/train-master-0": (3, 99.0),
+            "default/train-2-master-0": (5, 99.0),
+            "default/train-worker-12": (2, 99.0),
+        }
+        rec.prune_crash_backoff("default/train")
+        assert rec._crash_backoff == {"default/train-2-master-0": (5, 99.0)}
+
+    def test_delete_job_clears_backoff_state(self, tmp_path):
+        """A deleted crash-looping job resubmitted under the same name
+        must start with a clean slate (immediate first respawn)."""
+        from pytorch_operator_tpu.controller.supervisor import Supervisor
+
+        sup = Supervisor(state_dir=None, runner=FakeRunner(), persist=False)
+        key = sup.submit(new_job(name="loopy", workers=0))
+        sup.sync_once(now=1000.0)
+        name = replica_name(key, ReplicaType.MASTER, 0)
+        for t in (1000.0, 1005.0):  # two quick failures build a streak
+            sup.runner.set_phase(name, ReplicaPhase.FAILED, exit_code=2)
+            sup.reconciler.sync(key, now=t)
+            sup.reconciler.sync(key, now=t + 4.0)
+        assert sup.reconciler._crash_backoff
+        sup.delete_job(key)
+        assert not sup.reconciler._crash_backoff

@@ -237,12 +237,104 @@ class TestSpool:
         assert len(sp.claim(2)) == 2
         assert sp.pending_count() == 2
 
+    def test_recover_claimed_requeues_orphans(self, tmp_path):
+        """A crashed engine's in-flight claims must become requests
+        again on restart (the supervisor restart policy re-runs the
+        job; orphaned clients would otherwise wait out their
+        timeouts). Already-answered claims are NOT re-run."""
+        sp = Spool(tmp_path / "sp")
+        a = sp.submit(prompt_len=3, max_new_tokens=2)
+        b = sp.submit(prompt_len=4, max_new_tokens=2)
+        sp.claim(2)  # both in flight
+        assert sp.pending_count() == 0
+        # Simulate a crash AFTER b's response was written but before
+        # its claim was unlinked.
+        (sp.responses / f"{b}.json").write_text('{"tokens": []}')
+        assert sp.recover_claimed() == 1
+        assert sp.pending_count() == 1
+        assert sp.recover_claimed() == 0  # nothing left to recover
+        assert [r["id"] for r in sp.claim(5)] == [a]
+
     def test_submit_validates(self, tmp_path):
         sp = Spool(tmp_path / "sp")
         with pytest.raises(ValueError, match="exactly one"):
             sp.submit(prompt=[1], prompt_len=3)
         with pytest.raises(ValueError, match="exactly one"):
             sp.submit()
+
+
+@pytest.mark.slow
+def test_serve_job_under_supervisor(tmp_path):
+    """The operator-analog serving journey end to end: a REAL serve job
+    under the supervisor (subprocess, rendezvous env, progress surface),
+    fed by a client through the spool, exiting cleanly after its request
+    budget — the reconciled-workload lifecycle applied to inference."""
+    import threading
+
+    from pytorch_operator_tpu.api import (
+        ProcessTemplate,
+        ReplicaType,
+        Resources,
+    )
+    from pytorch_operator_tpu.controller import Supervisor
+    from tests.testutil import new_job
+
+    spool_dir = tmp_path / "spool"
+    sp = Spool(spool_dir)
+    got = {}
+
+    def client():
+        ids = [
+            sp.submit(prompt_len=5, max_new_tokens=6),
+            sp.submit(prompt=[3, 1, 4, 1, 5], max_new_tokens=4),
+        ]
+        for rid in ids:
+            got[rid] = sp.wait_response(rid, timeout=240)
+
+    t = threading.Thread(target=client)
+    t.start()
+    sup = Supervisor(state_dir=tmp_path / "state", poll_interval=0.1)
+    job = new_job(name="serve-e2e", workers=0)
+    job.spec.port = None
+    job.spec.replica_specs[ReplicaType.MASTER].template = ProcessTemplate(
+        module="pytorch_operator_tpu.workloads.serve",
+        args=[
+            "--config", "tiny", "--spool", str(spool_dir),
+            "--slots", "2", "--chunk", "8", "--block", "4",
+            "--max-decode-len", "48", "--max-requests", "2",
+            "--idle-timeout", "120", "--json",
+        ],
+        resources=Resources(cpu_devices=1),
+    )
+    done = sup.run(job, timeout=240)
+    t.join(timeout=60)
+    log = (
+        tmp_path / "state" / "logs" / "default_serve-e2e-master-0.log"
+    ).read_text()
+    assert done.is_succeeded(), f"log:\n{log[-3000:]}"
+    assert not t.is_alive()
+    assert len(got) == 2
+    for r in got.values():
+        assert len(r["tokens"]) in (4, 6)
+        assert r["ttft_ms"] > 0
+    # The serving job reports through the same progress surface as
+    # training jobs: the status stream carries a metrics record with
+    # the latency percentiles.
+    import json as _json
+
+    from pytorch_operator_tpu.controller.progress import job_status_dir
+    from pytorch_operator_tpu.controller.store import job_key
+
+    status = (
+        job_status_dir(tmp_path / "state" / "status", job_key(done))
+        / "master-0.jsonl"
+    ).read_text()
+    metrics = [
+        r for r in map(_json.loads, status.splitlines())
+        if r.get("event") == "metrics" and "ttft_ms_p50" in r
+    ]
+    assert metrics and metrics[-1]["requests"] == 2, status[-1500:]
+    sup.shutdown()
 
 
 @pytest.mark.slow
