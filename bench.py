@@ -540,8 +540,12 @@ def run(argv=None) -> dict:
                 eng_cfg, config="1b", quantize="int8",
                 log=lambda m: log(f"[bench] {m}"), tag="bench-serve",
             )
+            # block=64: the measured sweet spot on this stream (round-5
+            # sweep, BASELINE.md): +23% decode tok/s over block=32 AND
+            # better TTFT (faster drain beats shorter blocks); 128
+            # over-shoots (finished slots idle longer).
             eng = ServingEngine(
-                eng_cfg, eparams, slots=8, chunk=128, block=32,
+                eng_cfg, eparams, slots=8, chunk=128, block=64,
             )
             rng = _np.random.default_rng(0)
 
