@@ -708,6 +708,65 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_serve_request(args) -> int:
+    """Submit a request to a serving job's spool and (optionally) wait
+    for the response — the client half of the serving service
+    (serving/spool.py; the serve workload is the engine half)."""
+    from pathlib import Path
+
+    from pytorch_operator_tpu.serving import Spool
+
+    if (args.prompt is None) == (args.prompt_len is None):
+        print(
+            "exactly one of --prompt / --prompt-len is required",
+            file=sys.stderr,
+        )
+        return 2
+    prompt = None
+    if args.prompt is not None:
+        try:
+            prompt = [int(t) for t in args.prompt.split(",") if t.strip()]
+        except ValueError:
+            print(
+                f"--prompt must be comma-separated token ids, got "
+                f"{args.prompt!r}",
+                file=sys.stderr,
+            )
+            return 2
+        if not prompt:
+            print(
+                f"--prompt contains no token ids: {args.prompt!r}",
+                file=sys.stderr,
+            )
+            return 2
+    # The SERVE JOB owns spool creation; the client creating a fresh
+    # spool at a typo'd path would leave dead directories and block the
+    # full timeout on a request nothing will ever read.
+    if not Path(args.spool).is_dir():
+        print(
+            f"spool {args.spool!r} does not exist — is the serve job "
+            "running? (its --spool flag names the directory)",
+            file=sys.stderr,
+        )
+        return 1
+    spool = Spool(args.spool)
+    rid = spool.submit(
+        prompt=prompt,
+        prompt_len=args.prompt_len,
+        max_new_tokens=args.max_new_tokens,
+    )
+    if args.no_wait:
+        print(rid)
+        return 0
+    try:
+        resp = spool.wait_response(rid, timeout=args.timeout)
+    except TimeoutError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(json.dumps(resp))
+    return 0 if "error" not in resp else 1
+
+
 def cmd_manifests(args) -> int:
     # Deploy-manifest generation (SURVEY.md §1 layer 6): the CRD schema is
     # introspected from api/types.py so it cannot drift (api/crdgen.py).
@@ -874,6 +933,31 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("metrics", help="print supervisor metrics")
     sp.set_defaults(func=cmd_metrics)
+
+    sp = sub.add_parser(
+        "serve-request",
+        help="submit a request to a serving job's spool and print the "
+        "response (tokens + TTFT/per-token latency)",
+    )
+    sp.add_argument("--spool", required=True, help="the serve job's --spool dir")
+    sp.add_argument(
+        "--prompt", default=None,
+        help="comma-separated token ids (no tokenizer ships here)",
+    )
+    sp.add_argument(
+        "--prompt-len", type=int, default=None,
+        help="synthesize a deterministic prompt of this length instead",
+    )
+    sp.add_argument("--max-new-tokens", type=int, default=64)
+    sp.add_argument(
+        "--timeout", type=float, default=300.0,
+        help="seconds to wait for the response",
+    )
+    sp.add_argument(
+        "--no-wait", action="store_true",
+        help="print the request id and exit (poll responses/<id>.json)",
+    )
+    sp.set_defaults(func=cmd_serve_request)
 
     return p
 

@@ -379,3 +379,110 @@ class TestServeWorkload:
             assert r["ttft_ms"] > 0
         assert "budget" in bad[0]["error"]
         assert stats["ttft_ms_p50"] > 0 and stats["tpot_ms_p50"] > 0
+
+
+class TestServeRequestCLI:
+    """`tpujob serve-request` — the client half of the serving service
+    as a first-class CLI surface (no server needed for these: the spool
+    IS the contract)."""
+
+    def _cli(self, *argv):
+        from pytorch_operator_tpu.client.cli import main
+
+        return main(list(argv))
+
+    def test_no_wait_submits_a_claimable_request(self, tmp_path, capsys):
+        spool = tmp_path / "sp"
+        Spool(spool)  # the serve job owns spool creation
+        rc = self._cli(
+            "serve-request", "--spool", str(spool),
+            "--prompt", "3,1,4,1,5", "--max-new-tokens", "7", "--no-wait",
+        )
+        assert rc == 0
+        rid = capsys.readouterr().out.strip()
+        (rec,) = Spool(spool).claim(5)
+        assert rec["id"] == rid
+        assert rec["prompt"] == [3, 1, 4, 1, 5]
+        assert rec["max_new_tokens"] == 7
+
+    def test_wait_returns_the_engine_response(self, tmp_path, capsys):
+        import json
+        import threading
+
+        spool_dir = tmp_path / "sp"
+        sp = Spool(spool_dir)
+
+        def fake_engine():
+            # Answer the first request that shows up.
+            import time as _t
+
+            deadline = _t.time() + 30
+            while _t.time() < deadline:
+                recs = sp.claim(1)
+                if recs:
+                    sp.respond(
+                        recs[0]["id"],
+                        {"tokens": [9, 8], "ttft_ms": 12.0, "tpot_ms": 3.0},
+                    )
+                    return
+                _t.sleep(0.02)
+
+        t = threading.Thread(target=fake_engine)
+        t.start()
+        rc = self._cli(
+            "serve-request", "--spool", str(spool_dir),
+            "--prompt-len", "5", "--max-new-tokens", "2", "--timeout", "30",
+        )
+        t.join()
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["tokens"] == [9, 8] and out["ttft_ms"] == 12.0
+
+    def test_bad_args_and_timeout(self, tmp_path, capsys):
+        spool = str(tmp_path / "sp")
+        assert self._cli("serve-request", "--spool", spool) == 2
+        assert (
+            self._cli(
+                "serve-request", "--spool", spool,
+                "--prompt", "1,2", "--prompt-len", "3",
+            )
+            == 2
+        )
+        assert (
+            self._cli(
+                "serve-request", "--spool", spool,
+                "--prompt", "not,ints",
+            )
+            == 2
+        )
+        # A prompt with no valid ids is rejected locally, not after a
+        # guaranteed-error server round trip.
+        assert (
+            self._cli(
+                "serve-request", "--spool", spool, "--prompt", ","
+            )
+            == 2
+        )
+        # Arg errors must NOT have created the spool as a side effect,
+        # and a missing spool is a clear client-side error (rc 1), not
+        # a 300s hang against directories nothing reads.
+        import pathlib
+
+        assert not pathlib.Path(spool).exists()
+        assert (
+            self._cli(
+                "serve-request", "--spool", spool,
+                "--prompt-len", "4", "--timeout", "0.2",
+            )
+            == 1
+        )
+        assert "does not exist" in capsys.readouterr().err
+        # With a live spool but nothing serving: the wait times out, rc 1.
+        Spool(spool)
+        assert (
+            self._cli(
+                "serve-request", "--spool", spool,
+                "--prompt-len", "4", "--timeout", "0.2",
+            )
+            == 1
+        )
