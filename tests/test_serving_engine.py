@@ -486,3 +486,73 @@ class TestServeRequestCLI:
             )
             == 1
         )
+
+
+@pytest.mark.slow
+def test_serve_loop_churn_under_threaded_clients(tmp_path, monkeypatch):
+    """Stress the spool+engine+loop composition: 12 requests from 3
+    client threads with jittered submit timing into 2 slots — every
+    request answered exactly once, no response lost or duplicated
+    (the serving analog of the control plane's test_stress.py)."""
+    import collections
+    import threading
+
+    from pytorch_operator_tpu.workloads import serve as serve_mod
+
+    spool_dir = tmp_path / "spool"
+    sp = Spool(spool_dir)
+    results = {}
+    lock = threading.Lock()
+    # Count engine-side respond() calls per id — the only place
+    # duplication is actually observable (a double respond would
+    # silently overwrite the same response file).
+    respond_counts = collections.Counter()
+    real_respond = Spool.respond
+
+    def counting_respond(self, request_id, record):
+        with lock:
+            respond_counts[request_id] += 1
+        return real_respond(self, request_id, record)
+
+    monkeypatch.setattr(Spool, "respond", counting_respond)
+    rng = np.random.default_rng(0)
+    plans = [
+        [(int(rng.integers(3, 20)), int(rng.integers(2, 10)))
+         for _ in range(4)]
+        for _ in range(3)
+    ]
+
+    def client(plan, jitter):
+        for p, n in plan:
+            time.sleep(jitter)
+            rid = sp.submit(prompt_len=p, max_new_tokens=n)
+            r = sp.wait_response(rid, timeout=240)
+            with lock:
+                results[rid] = (n, r)
+
+    threads = [
+        threading.Thread(target=client, args=(plan, 0.2 * i))
+        for i, plan in enumerate(plans)
+    ]
+    for t in threads:
+        t.start()
+    stats = serve_mod.run(
+        config="tiny", spool_dir=str(spool_dir), slots=2, chunk=8,
+        block=4, max_decode_len=48, max_requests=12, idle_timeout=120,
+        log=lambda *_: None,
+    )
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    assert stats["served"] == 12 and stats["rejected"] == 0
+    assert len(results) == 12
+    # Exactly-once: every submitted id answered by exactly ONE engine
+    # respond() call.
+    assert sorted(respond_counts) == sorted(results)
+    assert set(respond_counts.values()) == {1}, respond_counts
+    for rid, (n, r) in results.items():
+        assert len(r["tokens"]) == n, rid
+        assert r["ttft_ms"] > 0
+    # The spool drained completely: nothing claimed or pending.
+    assert sp.pending_count() == 0
+    assert list(sp.claimed.iterdir()) == []
