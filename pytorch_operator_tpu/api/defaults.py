@@ -39,6 +39,14 @@ AUTO_PORT_ANNOTATION = "tpujob.dev/auto-port"
 # target as capacity frees. Manual `tpujob scale` re-pins it.
 ELASTIC_TARGET_ANNOTATION = "tpujob.dev/elastic-target-workers"
 
+# Opt-in hung-world detection: a job carrying this annotation (seconds,
+# float) promises its workload heartbeats via rendezvous.report_progress;
+# when the newest heartbeat (or, before any, the master's spawn) is older
+# than the deadline, the supervisor kills and restarts the world — the
+# recovery for a wedged collective that exits nothing (a host dropping
+# off ICI mid-allreduce hangs forever instead of crashing).
+HANG_DEADLINE_ANNOTATION = "tpujob.dev/hang-deadline-seconds"
+
 
 def set_defaults(job: TPUJob) -> TPUJob:
     """Fill defaulted fields in place (idempotent); returns the job."""

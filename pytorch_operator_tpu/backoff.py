@@ -1,0 +1,87 @@
+"""Jittered exponential backoff — THE retry schedule for transient
+failures (rendezvous joins, checkpoint I/O, anything a fault plan can
+make flake).
+
+Why one shared helper: the rendezvous loop retried on a fixed 1 s
+interval, which synchronizes every worker of a gang into a thundering
+herd against the coordinator; checkpoint I/O had no retry at all. Both
+now share this schedule: exponential growth, a cap, and DETERMINISTIC
+jitter — derived by hashing (seed, attempt), never from a PRNG or the
+clock — so a replayed fault plan (faults/) sleeps the identical
+schedule both times while distinct seeds (e.g. per process id) still
+decorrelate real workers.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple, Type
+
+
+@dataclass(frozen=True)
+class Backoff:
+    """attempt (0-based) -> delay seconds: ``base * factor^attempt``,
+    capped, then jittered by ±``jitter`` fraction deterministically."""
+
+    base_s: float = 0.1
+    cap_s: float = 30.0
+    factor: float = 2.0
+    jitter: float = 0.25
+    seed: int = 0
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.cap_s, self.base_s * self.factor ** max(0, attempt))
+        if self.jitter:
+            h = hashlib.blake2b(
+                f"{self.seed}:{attempt}".encode(), digest_size=8
+            ).digest()
+            frac = int.from_bytes(h, "big") / 2**64  # [0, 1)
+            d *= 1.0 + self.jitter * (2.0 * frac - 1.0)
+        return max(0.0, d)
+
+    def delays(self, attempts: int):
+        return [self.delay(a) for a in range(attempts)]
+
+
+def retry_call(
+    fn: Callable,
+    *,
+    backoff: Backoff,
+    attempts: Optional[int] = None,
+    timeout_s: Optional[float] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    on_retry: Optional[Callable[[BaseException, int], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+):
+    """Call ``fn`` until it returns, retrying ``retry_on`` failures on
+    the backoff schedule. Stops at ``attempts`` calls and/or when the
+    next sleep would cross ``timeout_s`` (measured from the first call)
+    — whichever comes first — then re-raises the last failure.
+
+    ``on_retry(exc, attempt)`` runs before each sleep (cleanup hooks:
+    e.g. removing a partially-written checkpoint step so the retry
+    starts clean).
+    """
+    if attempts is None and timeout_s is None:
+        raise ValueError("retry_call needs attempts and/or timeout_s")
+    deadline = None if timeout_s is None else clock() + timeout_s
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            attempt += 1
+            if attempts is not None and attempt >= attempts:
+                raise
+            d = backoff.delay(attempt - 1)
+            if deadline is not None:
+                remaining = deadline - clock()
+                if remaining <= 0:
+                    raise
+                d = min(d, remaining)
+            if on_retry is not None:
+                on_retry(e, attempt)
+            sleep(d)

@@ -67,13 +67,58 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        return sorted(self._mgr.all_steps())
+
     def save(self, step: int, state: Any, *, block: bool = True) -> None:
         """Save ``state`` at ``step``. ``block=True`` waits for the commit —
         the safe default for preemption-recovery tests; ``block=False``
-        overlaps the write with the next training steps."""
-        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        overlaps the write with the next training steps.
+
+        Transient I/O failures are retried on the shared backoff
+        schedule (a preempted NFS mount mid-save must not kill a
+        training step the restart policy would happily replay); each
+        retry first clears the partial step so orbax starts clean.
+        Blocking saves commit a checksum sidecar afterwards — the
+        restore side's verified-good scan (integrity.py) keys off it.
+        Non-blocking saves skip the sidecar (the bytes are still in
+        flight); their steps verify as "unknown" and restore normally.
+        """
+        from .. import faults
+        from ..backoff import Backoff, retry_call
+        from . import integrity
+
+        fault = faults.checkpoint_write_fault()
+
+        def attempt():
+            nonlocal fault
+            if fault == "fail":
+                fault = None  # transient: only the first attempt fails
+                raise OSError("injected transient checkpoint write failure")
+            self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+            if block:
+                self._mgr.wait_until_finished()
+
+        def clear_partial(_exc, _attempt):
+            import shutil
+
+            shutil.rmtree(self.directory / str(step), ignore_errors=True)
+
+        retry_call(
+            attempt,
+            backoff=Backoff(base_s=0.05, cap_s=2.0, seed=step),
+            attempts=3,
+            retry_on=(OSError,),
+            on_retry=clear_partial,
+        )
         if block:
-            self._mgr.wait_until_finished()
+            integrity.write_sidecar(self.directory, step)
+            if fault == "torn":
+                # Damage the committed bytes UNDER the fresh sidecar —
+                # the deterministic stand-in for a torn write that the
+                # verified-good restore scan must catch and skip.
+                integrity.corrupt_step(self.directory, step)
+            integrity.prune_stale_sidecars(self.directory)
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore onto the structure/shardings of ``state_like`` (pass the
@@ -137,33 +182,112 @@ class CheckpointManager:
             # The manager's item_metadata() is None on a freshly opened
             # manager (no save/restore registered a handler yet); the
             # raw checkpointer reads the step's metadata directly.
-            meta = ckptr.metadata(step_dir).item_metadata.tree
+            # Orbax API drift: older releases return the tree dict
+            # directly, newer ones wrap it in item_metadata.tree.
+            meta = ckptr.metadata(step_dir)
+            if not isinstance(meta, dict):
+                meta = meta.item_metadata.tree
             if key not in meta:
                 raise KeyError(
                     f"checkpoint at step {step} has no top-level {key!r} "
                     f"(keys: {sorted(meta)})"
                 )
-            item = {
+            restore_args = {
                 key: jax.tree.map(
                     lambda _: self._ocp.RestoreArgs(restore_type=np.ndarray),
                     meta[key],
                 )
             }
-            tree = ckptr.restore(
-                step_dir,
-                args=self._ocp.args.PyTreeRestore(
-                    item=item, partial_restore=True
-                ),
-            )
+            # Orbax API drift: newer releases spell partial restoration
+            # `PyTreeRestore(partial_restore=True)`; older ones (this
+            # image ships 0.7.0) take an item covering ONLY the wanted
+            # subtree plus `transforms={}` (= drop checkpoint keys the
+            # item omits). Same read behavior: only the requested
+            # subtree's shards are fetched.
+            import inspect
+
+            pr = self._ocp.args.PyTreeRestore
+            if "partial_restore" in inspect.signature(pr.__init__).parameters:
+                tree = ckptr.restore(
+                    step_dir,
+                    args=pr(item=restore_args, partial_restore=True),
+                )
+            else:
+                item = {
+                    key: jax.tree.map(lambda _: 0, meta[key])
+                }
+                tree = ckptr.restore(
+                    step_dir,
+                    args=pr(
+                        item=item,
+                        restore_args=restore_args,
+                        transforms={},
+                    ),
+                )
         return step, tree[key]
 
-    def restore_or_none(self, state_like: Any) -> Optional[tuple[int, Any]]:
-        """(step, state) from the latest checkpoint, or None if there is none
-        — the one-call resume idiom for workloads."""
-        step = self.latest_step()
-        if step is None:
-            return None
-        return step, self.restore(state_like, step)
+    def _report_corrupt(self, step: int, fallback=None, err=None) -> None:
+        """Surface a skipped corrupt step on the status channel — the
+        supervisor folds ``checkpoint_corrupt`` records into job events
+        (CheckpointCorrupt in ``tpujob describe``)."""
+        from ..runtime.rendezvous import report
+
+        msg = (
+            f"[tpujob] warning: checkpoint step {step} failed verification"
+            + (f" ({err})" if err else "")
+            + (
+                f"; falling back toward step {fallback}"
+                if fallback is not None
+                else "; no older step to fall back to"
+            )
+        )
+        print(msg, flush=True)
+        report("checkpoint_corrupt", step=step, fallback=fallback)
+
+    def latest_verified_step(self) -> Optional[int]:
+        """Newest step whose checksum sidecar still matches (steps
+        without a sidecar — legacy / non-blocking saves — count as
+        acceptable). Corrupt steps are reported and skipped."""
+        from . import integrity
+
+        steps = self.all_steps()
+        return integrity.latest_verified_step(
+            self.directory,
+            steps,
+            on_corrupt=lambda s: self._report_corrupt(
+                s, fallback=max((x for x in steps if x < s), default=None)
+            ),
+        )
+
+    def restore_or_none(
+        self, state_like: Any, *, verify: bool = True
+    ) -> Optional[tuple[int, Any]]:
+        """(step, state) from the newest RESTORABLE checkpoint, or None —
+        the one-call resume idiom for workloads.
+
+        With ``verify`` (the default) steps are walked newest-first:
+        checksum-mismatched steps are skipped up front, and a step whose
+        restore raises (truncated files orbax chokes on) is treated the
+        same — report, fall back to the next older step, keep going.
+        Restart-based recovery must degrade to an OLDER checkpoint, not
+        die on the newest write the crash itself tore."""
+        from . import integrity
+
+        steps = self.all_steps()
+        if not verify:
+            step = self.latest_step()
+            return None if step is None else (step, self.restore(state_like, step))
+        for i, step in enumerate(reversed(steps)):
+            older = steps[-(i + 2)] if i + 2 <= len(steps) else None
+            if integrity.verify_step(self.directory, step) is False:
+                self._report_corrupt(step, fallback=older)
+                continue
+            try:
+                return step, self.restore(state_like, step)
+            except Exception as e:  # noqa: BLE001 — any restore failure
+                # of THIS step must fall back, not kill the recovery.
+                self._report_corrupt(step, fallback=older, err=e)
+        return None
 
     def close(self) -> None:
         self._mgr.close()

@@ -81,25 +81,55 @@ def _age(ts: Optional[float]) -> str:
     return f"{s // 3600}h"
 
 
-def cmd_run(args) -> int:
+def _load_fault_plan(path):
+    """Parse a fault-plan file, or exit with a spec-style error."""
+    from pytorch_operator_tpu.faults import FaultPlan
+
+    try:
+        return FaultPlan.load(path)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise SystemExit(f"error: invalid fault plan {path}: {e}")
+
+
+def _run_foreground(args, fault_plan=None, chaos: bool = False) -> int:
+    """Shared supervise-to-completion loop behind ``run`` and ``chaos``.
+
+    With a fault plan armed, controller-side faults fire in-process and
+    worker-side faults ride into replicas via the runner's env
+    threading; ``chaos`` additionally prints a timestamp-free replay
+    summary — the artifact two runs of one plan+seed must reproduce
+    byte-identically (the determinism contract tests pin)."""
+    from pytorch_operator_tpu import faults
+
     job = load_job(args.file)
+    if fault_plan is not None:
+        faults.arm(fault_plan)
     sup = Supervisor(
         state_dir=_state_dir(args),
         gang_enabled=not args.no_gang,
         max_slots=args.max_slots,
     )
     try:
-        key = sup.submit(job)
-    except ValidationError as e:
-        print("error: invalid TPUJob spec:", file=sys.stderr)
-        for msg in e.errors:
-            print(f"  - {msg}", file=sys.stderr)
-        return 2
-    print(f"tpujob {key} submitted")
-    printed = 0
-    deadline = None if args.timeout is None else time.time() + args.timeout
-    try:
+        try:
+            key = sup.submit(job)
+        except ValidationError as e:
+            print("error: invalid TPUJob spec:", file=sys.stderr)
+            for msg in e.errors:
+                print(f"  - {msg}", file=sys.stderr)
+            return 2
+        print(f"tpujob {key} submitted")
+        if fault_plan is not None:
+            sup.events.normal(
+                key, "ChaosPlanArmed",
+                f"fault plan armed: {fault_plan.summary()}",
+            )
+        printed = 0
+        deadline = None if args.timeout is None else time.time() + args.timeout
         while True:
+            if fault_plan is not None:
+                # The daemon's sync_once runs this hook; the foreground
+                # loop syncs one key directly, so drive it here.
+                sup._inject_pass_faults()
             # Sync only the submitted job — other persisted jobs in this
             # state dir may be owned by a running daemon.
             sup.reconciler.sync(key)
@@ -115,8 +145,14 @@ def cmd_run(args) -> int:
                 sup.delete_job(key)
                 return 3
             time.sleep(sup.poll_interval)
+        # No settle pass needed: within one sync, runner.sync observes
+        # the exit BEFORE the status scan runs, so every record a
+        # replica wrote is folded into events by the pass that
+        # completes the job.
     finally:
         sup.shutdown()
+        if fault_plan is not None:
+            faults.disarm()
     if j is None:
         print("job was garbage-collected")
         return 0
@@ -125,7 +161,28 @@ def cmd_run(args) -> int:
     if lat is not None:
         print(f"schedule-to-first-step latency: {lat:.3f}s")
     print(f"tpujob {key}: {phase} (restarts={j.status.restart_count})")
+    if chaos:
+        # The deterministic replay artifact: event sequence (no
+        # timestamps, no counts), final phase, restart count.
+        seq = " -> ".join(f"{ev.type}:{ev.reason}" for ev in events)
+        print(f"chaos events: {seq}")
+        print(f"chaos final: {phase} restarts={j.status.restart_count}")
     return 0 if j.is_succeeded() else 1
+
+
+def cmd_run(args) -> int:
+    plan = None
+    if getattr(args, "fault_plan", None):
+        plan = _load_fault_plan(args.fault_plan)
+    return _run_foreground(args, fault_plan=plan)
+
+
+def cmd_chaos(args) -> int:
+    """Replay a declared failure scenario end-to-end: arm the plan, run
+    the job under it, print the deterministic replay summary."""
+    return _run_foreground(
+        args, fault_plan=_load_fault_plan(args.plan), chaos=True
+    )
 
 
 def _load_validated_job(path):
@@ -799,7 +856,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="device-slot capacity (a replica requesting N chips/devices "
         "occupies N slots)",
     )
+    sp.add_argument(
+        "--fault-plan", default=None,
+        help="arm a deterministic fault plan (YAML/JSON, faults/) for "
+        "this run — failures fire in the supervisor and ride into "
+        "replicas via TPUJOB_FAULT_PLAN",
+    )
     sp.set_defaults(func=cmd_run)
+
+    sp = sub.add_parser(
+        "chaos",
+        help="replay a declared failure scenario: run a job under a "
+        "fault plan and print the deterministic event-sequence summary",
+    )
+    sp.add_argument("file", help="TPUJob spec to run under faults")
+    sp.add_argument("--plan", required=True, help="fault plan file (YAML/JSON)")
+    sp.add_argument("--timeout", type=float, default=None)
+    sp.add_argument("--no-gang", action="store_true")
+    sp.add_argument("--max-slots", type=int, default=None)
+    sp.set_defaults(func=cmd_chaos)
 
     sp = sub.add_parser("submit", help="queue a job for a running supervisor")
     sp.add_argument("file")

@@ -23,6 +23,7 @@ from typing import List, Optional
 from ..api.defaults import (
     AUTO_PORT_ANNOTATION,
     ELASTIC_TARGET_ANNOTATION,
+    HANG_DEADLINE_ANNOTATION,
     set_defaults,
 )
 from ..api.types import (
@@ -357,9 +358,16 @@ class Reconciler:
             del self._scan_offsets[p]
 
     def _scan_first_step(self, job: TPUJob, key: str) -> None:
-        """Pick up first-training-step reports from workload status files —
-        the schedule-to-first-step latency probe (BASELINE.json:2)."""
-        if job.status.first_step_time is not None or self.status_root is None:
+        """Pick up workload status reports: first-training-step records
+        (the schedule-to-first-step latency probe, BASELINE.json:2) plus
+        failure-path telemetry — skipped-corrupt-checkpoint and injected
+        -stall records — folded into job events so `tpujob describe`
+        shows the failure story, not just the recovery's outcome.
+
+        Incremental per-file offsets keep the per-pass cost O(new
+        bytes), so the scan runs every pass (not only until the first
+        step is seen)."""
+        if self.status_root is None:
             return
         from .progress import job_status_dir
 
@@ -392,7 +400,8 @@ class Reconciler:
                     rec = json.loads(line)
                 except ValueError:
                     continue
-                if rec.get("event") == "first_step":
+                event = rec.get("event")
+                if event == "first_step" and job.status.first_step_time is None:
                     ts = float(rec.get("ts", 0.0))
                     # Defense in depth vs stale files (e.g. a daemon restart
                     # loses scan offsets): a first step cannot precede this
@@ -401,7 +410,25 @@ class Reconciler:
                         continue
                     if earliest is None or ts < earliest:
                         earliest = ts
-        if earliest is not None:
+                elif event == "checkpoint_corrupt":
+                    fb = rec.get("fallback")
+                    self.events.warning(
+                        key, "CheckpointCorrupt",
+                        f"replica skipped corrupt checkpoint step "
+                        f"{rec.get('step')}"
+                        + (
+                            f"; restoring from step {fb} or older."
+                            if fb is not None
+                            else "; no older step available."
+                        ),
+                    )
+                elif event == "fault_stall":
+                    self.events.warning(
+                        key, "FaultInjected",
+                        f"replica stalled {rec.get('seconds')}s at "
+                        f"{rec.get('site', 'rendezvous')} (fault plan).",
+                    )
+        if earliest is not None and job.status.first_step_time is None:
             job.status.first_step_time = earliest
 
     # ---- the core sync ----
@@ -774,8 +801,79 @@ class Reconciler:
                     message=f"TPUJob {key} is running.", now=now,
                 )
                 self.events.normal(key, "TPUJobRunning", f"TPUJob {key} is running.")
+            # Hung-world detection (opt-in via annotation): a wedged
+            # collective exits nothing, so liveness must come from the
+            # heartbeat channel, with a deadline kill as the recovery.
+            if self._maybe_kill_hung(job, key, handles, master, now):
+                return not job.is_finished()
 
         update_replica_statuses(job, handles)
+        self.store.update(job)
+        return True
+
+    # ---- hung-world detection ----
+
+    @staticmethod
+    def _hang_deadline_s(job: TPUJob) -> Optional[float]:
+        raw = job.metadata.annotations.get(HANG_DEADLINE_ANNOTATION)
+        if not raw:
+            return None
+        try:
+            v = float(raw)
+        except (TypeError, ValueError):
+            return None
+        return v if v > 0 else None
+
+    def _last_heartbeat(self, job: TPUJob, key: str, master) -> float:
+        """The newest liveness signal for the CURRENT world: latest
+        progress heartbeat, first-step report, or — before any report —
+        the master's spawn time (a fresh world gets one full deadline to
+        produce its first beat; without this floor a restarted world
+        would be re-killed instantly off the old world's stale file)."""
+        candidates = [master.created_at or 0.0]
+        if job.status.first_step_time is not None:
+            candidates.append(job.status.first_step_time)
+        if self.status_root is not None:
+            from .progress import job_status_dir, read_latest_progress
+
+            rec = read_latest_progress(job_status_dir(self.status_root, key))
+            if rec is not None:
+                candidates.append(float(rec.get("ts", 0.0)))
+        return max(candidates)
+
+    def _maybe_kill_hung(
+        self, job: TPUJob, key: str, handles, master, now: float
+    ) -> bool:
+        """Deadline-kill a world whose heartbeats stopped. Returns True
+        when it acted (restart spent, or job failed at the backoff
+        limit) — the caller's pass is over for this job either way."""
+        hang_s = self._hang_deadline_s(job)
+        if hang_s is None:
+            return False
+        silent = now - self._last_heartbeat(job, key, master)
+        if silent <= hang_s:
+            return False
+        backoff = job.spec.run_policy.backoff_limit
+        if backoff is not None and job.status.restart_count + 1 > backoff:
+            self._fail_job(
+                job, key, "TPUJobHung",
+                f"no heartbeat for {silent:.1f}s (deadline {hang_s:.0f}s) "
+                f"and the backoff limit ({backoff}) is exhausted.", now,
+            )
+            update_replica_statuses(job, handles)
+            self._cleanup_after_finish(job, key)
+            self.store.update(job)
+            return True
+        msg = (
+            f"no heartbeat for {silent:.1f}s (deadline {hang_s:.0f}s); "
+            f"killing the hung world "
+            f"(restart #{job.status.restart_count + 1})."
+        )
+        self.restart_world(
+            job, key, [h for h in handles if h.is_active()],
+            "TPUJobHung", msg, now=now,
+        )
+        update_replica_statuses(job, self.runner.list_for_job(key))
         self.store.update(job)
         return True
 

@@ -233,6 +233,12 @@ class ProcessRunner:
         if h is not None:
             h.slots = slots
 
+    def inject_kill(self, name: str) -> None:
+        """Fault-injection site (faults/): make this replica die as if
+        the host preempted it — an abrupt SIGKILL-style death, NOT a
+        graceful delete (the record survives so the reconciler walks the
+        real failure-classification path: exit 137, retryable)."""
+
 
 class FakeRunner(ProcessRunner):
     """In-memory runner for controller tests (fake clientset analog).
@@ -254,10 +260,31 @@ class FakeRunner(ProcessRunner):
         self._lock = threading.RLock()
 
     def create(self, job_key, rtype, index, template, env):
+        from .. import faults
+
         name = replica_name(job_key, rtype, index)
         with self._lock:
             if name in self.handles:
                 raise RuntimeError(f"duplicate create for {name}")
+            env = faults.thread_env(dict(env))
+            inj = faults.active()
+            if inj is not None and inj.spawn_should_fail(rtype.value, index):
+                h = ReplicaHandle(
+                    name=name,
+                    job_key=job_key,
+                    replica_type=rtype,
+                    index=index,
+                    phase=ReplicaPhase.FAILED,
+                    exit_code=128 + 9,  # launch casualty: retryable
+                    created_at=time.time(),
+                    finished_at=time.time(),
+                    slots=replica_slots(template),
+                )
+                self.handles[name] = h
+                self.envs[name] = dict(env)
+                self.templates[name] = template
+                self.actions.append(("create", name))
+                return h
             h = ReplicaHandle(
                 name=name,
                 job_key=job_key,
@@ -309,6 +336,14 @@ class FakeRunner(ProcessRunner):
     def list_all(self):
         with self._lock:
             return list(self.handles.values())
+
+    def inject_kill(self, name: str) -> None:
+        with self._lock:
+            h = self.handles.get(name)
+            if h is not None and h.is_active():
+                h.phase = ReplicaPhase.FAILED
+                h.exit_code = 137  # signal death, retryable
+                h.finished_at = time.time()
 
     # --- test helpers ---
 
@@ -511,6 +546,8 @@ class SubprocessRunner(ProcessRunner):
         return ["/bin/sh", "-c", _EXIT_CAPTURE_SH, "sh", str(exit_path)] + argv
 
     def create(self, job_key, rtype, index, template, env):
+        from .. import faults
+
         name = replica_name(job_key, rtype, index)
         with self._lock:
             if name in self.handles and self.handles[name].is_active():
@@ -519,6 +556,9 @@ class SubprocessRunner(ProcessRunner):
             full_env = dict(os.environ)
             full_env.update(template.env)
             full_env.update(env)
+            # Chaos threading: an armed fault plan rides into the replica
+            # (worker-side faults fire inside the subprocess itself).
+            faults.thread_env(full_env)
             # Replicas must import this package regardless of cwd, and the
             # inherited PYTHONPATH must be PRESERVED (site customizations —
             # e.g. the TPU PJRT plugin registration — live there).
@@ -575,6 +615,11 @@ class SubprocessRunner(ProcessRunner):
         with self._lock:
             log_f = open(log_path, "ab")
             try:
+                inj = faults.active()
+                if inj is not None and inj.spawn_should_fail(
+                    rtype.value, index
+                ):
+                    raise OSError("injected spawn failure (fault plan)")
                 proc = subprocess.Popen(
                     self._argv(template, self._exit_path(name)),
                     env=full_env,
@@ -676,6 +721,25 @@ class SubprocessRunner(ProcessRunner):
                     continue
                 self._adopted.pop(name)
                 self._finish_dead_adopted(self.handles[name])
+
+    def inject_kill(self, name: str) -> None:
+        """Abrupt group SIGKILL — the preemption model. The handle and
+        exit-capture file stay untouched: sync() finds the group dead
+        with no exit file and classifies 137 (retryable), exactly like a
+        real host preemption."""
+        with self._lock:
+            h = self.handles.get(name)
+            pid = h.pid if h is not None else None
+        if pid is None:
+            return
+        start = self._pid_starts.get(name)
+        stat = _proc_stat(pid)
+        if stat is not None and start is not None and stat[0] != start:
+            return  # pid reused by a stranger — never signal it
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
     def delete(self, name, grace_seconds: float = 5.0):
         self.delete_many([name], grace_seconds)

@@ -36,9 +36,14 @@ def fs_to_key(name: str) -> str:
 
 
 class JobStore:
-    def __init__(self, persist_dir: Optional[Path] = None):
+    def __init__(self, persist_dir: Optional[Path] = None, events=None):
         self._jobs: Dict[str, TPUJob] = {}
         self._lock = threading.RLock()
+        # Optional EventRecorder: persistence-layer failures (corrupt
+        # state files, stale tmp sweeps) surface in ``tpujob describe``
+        # instead of vanishing into stdout. CLI observers pass none and
+        # fall back to a printed warning.
+        self._events = events
         self.persist_dir = Path(persist_dir) if persist_dir else None
         if self.persist_dir is not None:
             self.persist_dir.mkdir(parents=True, exist_ok=True)
@@ -46,6 +51,18 @@ class JobStore:
             self._load_all()
 
     # ---- persistence ----
+
+    def _warn(self, key: str, reason: str, message: str) -> None:
+        if self._events is not None:
+            self._events.warning(key, reason, message)
+        else:
+            print(f"[tpujob] warning: {message}")
+
+    @staticmethod
+    def _key_from_filename(name: str) -> str:
+        """Best-effort job key from a persistence filename (strip every
+        extension: ``ns_job.json``, ``ns_job.json.1234.tmp``, ...)."""
+        return fs_to_key(name.split(".", 1)[0])
 
     def _sweep_stale_tmp(self) -> None:
         """Remove orphaned ``*.tmp`` files left by writers killed between
@@ -57,6 +74,12 @@ class JobStore:
             try:
                 if p.stat().st_mtime < cutoff:
                     p.unlink(missing_ok=True)
+                    self._warn(
+                        self._key_from_filename(p.name),
+                        "StaleTmpSwept",
+                        f"removed stale tmp file {p.name} (writer died "
+                        "between tmp-write and rename).",
+                    )
             except OSError:
                 continue
 
@@ -68,8 +91,13 @@ class JobStore:
             try:
                 job = TPUJob.from_dict(json.loads(p.read_text()))
             except (ValueError, KeyError) as e:
-                # Corrupt state file: skip rather than brick the supervisor.
-                print(f"[tpujob] warning: skipping corrupt state file {p}: {e}")
+                # Corrupt state file: skip rather than brick the
+                # supervisor, and leave an inspectable event trail.
+                self._warn(
+                    self._key_from_filename(p.name),
+                    "CorruptStateFile",
+                    f"skipping corrupt state file {p.name}: {e}",
+                )
                 continue
             self._jobs[job_key(job)] = job
 
@@ -81,8 +109,20 @@ class JobStore:
         if job is None:
             path.unlink(missing_ok=True)
         else:
+            text = json.dumps(job.to_dict(), indent=2)
+            from .. import faults
+
+            inj = faults.active()
+            if inj is not None and inj.torn_state_write(key):
+                # Injected torn write: land half the payload AT THE REAL
+                # PATH (bypassing the tmp+rename discipline — that
+                # discipline is exactly what a kernel-level tear defeats)
+                # so the next cross-process reader exercises the
+                # corrupt-state-file recovery path above.
+                path.write_text(text[: len(text) // 2])
+                return
             tmp = path.with_suffix(".json.tmp")
-            tmp.write_text(json.dumps(job.to_dict(), indent=2))
+            tmp.write_text(text)
             tmp.replace(path)
 
     # ---- CRUD ----

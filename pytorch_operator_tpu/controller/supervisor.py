@@ -62,10 +62,17 @@ class Supervisor:
         # library users (tests, foreground run) aren't serialized by default.
         self.lease = LeaderLease(self.state_dir) if leader_elect else None
         self.poll_interval = poll_interval
-        self.store = JobStore(
-            persist_dir=self.state_dir / "jobs" if persist else None
-        )
+        # Events before the store: persistence-layer warnings (corrupt
+        # state files skipped at load, stale tmp sweeps) land on the
+        # event surface `tpujob describe` reads.
         self.events = EventRecorder(sink_dir=self.state_dir / "events")
+        self.store = JobStore(
+            persist_dir=self.state_dir / "jobs" if persist else None,
+            events=self.events,
+        )
+        # Supervisor pass counter for the fault-injection pass hook
+        # (kill_replica faults schedule against it).
+        self._fault_pass = 0
         self.metrics = MetricsRegistry()
         self.runner = runner if runner is not None else SubprocessRunner(
             self.state_dir, max_slots=max_slots, standby=standby
@@ -300,6 +307,7 @@ class Supervisor:
         gangs claim free slots before lower ones.
         """
         now = time.time() if now is None else now
+        self._inject_pass_faults()
         any_active = False
         jobs = []
         for key in self.store.keys():
@@ -330,6 +338,30 @@ class Supervisor:
             queue_usage = self.reconciler.end_pass()
         self._update_gauges(jobs, queue_usage)
         return any_active
+
+    def _inject_pass_faults(self) -> None:
+        """The per-pass fault-injection hook: when a plan is armed
+        (``tpujob chaos`` / tests), ``kill_replica`` faults scheduled
+        for this pass SIGKILL their targets through the runner — the
+        deterministic stand-in for host preemption. A single ``is
+        None`` check when nothing is armed."""
+        from .. import faults
+
+        inj = faults.active()
+        if inj is None:
+            return
+        self._fault_pass += 1
+        for f in inj.kills_due(self._fault_pass):
+            for h in self.runner.list_all():
+                if h.is_active() and faults.FaultInjector.target_matches(
+                    f.target, h.replica_type.value, h.index
+                ):
+                    self.runner.inject_kill(h.name)
+                    self.events.warning(
+                        h.job_key,
+                        "FaultInjected",
+                        f"injected kill of {h.name} ({f.label()}).",
+                    )
 
     def _update_gauges(self, jobs, queue_usage: Optional[dict]) -> None:
         """Point-in-time scheduler state for /metrics, refreshed per pass

@@ -1,0 +1,42 @@
+"""Deterministic fault injection for the control plane.
+
+See :mod:`.plan` for the fault vocabulary and :mod:`.injector` for the
+site protocol. Import-light by design: every control-plane module
+consults a site helper on its hot path, so importing this package must
+cost nothing (yaml is loaded lazily, jax never)."""
+
+from .injector import (
+    FaultInjector,
+    InjectedFault,
+    active,
+    arm,
+    checkpoint_write_fault,
+    crash_if_due,
+    current,
+    disarm,
+    engine_step_check,
+    heartbeat_dropped,
+    rendezvous_stall_seconds,
+    thread_env,
+    worker_injector,
+)
+from .plan import ENV_VAR, Fault, FaultPlan
+
+__all__ = [
+    "ENV_VAR",
+    "Fault",
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedFault",
+    "active",
+    "arm",
+    "checkpoint_write_fault",
+    "crash_if_due",
+    "current",
+    "disarm",
+    "engine_step_check",
+    "heartbeat_dropped",
+    "rendezvous_stall_seconds",
+    "thread_env",
+    "worker_injector",
+]

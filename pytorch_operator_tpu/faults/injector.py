@@ -1,0 +1,312 @@
+"""Fault-plan evaluation at injection sites.
+
+Two deployment shapes share one :class:`FaultInjector`:
+
+- **Controller-side** (supervisor process): :func:`arm` installs a plan
+  process-wide; the runner, store, supervisor pass hook and serving
+  engine consult :func:`active`. :func:`thread_env` serializes the armed
+  plan into every spawned replica's environment.
+- **Worker-side** (replica subprocess): :func:`worker_injector` lazily
+  builds an injector from ``TPUJOB_FAULT_PLAN`` (threaded by the
+  runner), scoped to this replica's identity
+  (``TPUJOB_REPLICA_TYPE``/``INDEX``/``RESTART_COUNT``).
+
+Every site helper is a strict no-op returning its neutral value when no
+plan is armed — production pays one ``is None`` check per site.
+
+Determinism: occurrence counters are plain per-process integers; firing
+never consults the clock or a PRNG, so the same plan + seed + workload
+replays the identical failure (and therefore event) sequence.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import threading
+from typing import Dict, List, Optional
+
+from .plan import ENV_VAR, NTH_KINDS, Fault, FaultPlan
+
+
+class InjectedFault(RuntimeError):
+    """Raised by sites whose fault models an in-process error (engine
+    step, checkpoint write). Carries the fault label for log forensics."""
+
+
+class FaultInjector:
+    """Evaluates one plan. Thread-safe: the supervisor consults sites
+    from the reconcile loop while the engine/store may sit on other
+    threads."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        # Occurrence counters for NTH_KINDS, keyed per fault (two
+        # fail_checkpoint_write faults with different nth both count the
+        # same underlying site occurrences — see _occurrence()).
+        self._site_counts: Dict[str, int] = {}
+        # Remaining firings per fault index.
+        self._remaining: Dict[int, int] = {
+            i: f.times for i, f in enumerate(plan.faults)
+        }
+        self.fired: List[str] = []  # labels, in firing order (forensics)
+
+    # ---- matching ----
+
+    @staticmethod
+    def _replica_id(rtype: Optional[str], index) -> str:
+        return f"{str(rtype or '*').lower()}-{index if index is not None else '*'}"
+
+    @staticmethod
+    def target_matches(pattern: str, rtype: Optional[str], index) -> bool:
+        """``worker-0`` / ``master-*`` / ``*`` against a replica id; a
+        full replica name (``ns/job-worker-0``) also matches by suffix."""
+        rid = FaultInjector._replica_id(rtype, index)
+        return fnmatch.fnmatch(rid, pattern) or pattern.endswith("-" + rid)
+
+    def _candidates(self, kind: str, rtype=None, index=None, key=None):
+        for i, f in enumerate(self.plan.faults):
+            if f.kind != kind or self._remaining.get(i, 0) <= 0:
+                continue
+            if key is not None and f.target not in ("*",) and f.target != key:
+                continue
+            if rtype is not None and not self.target_matches(
+                f.target, rtype, index
+            ):
+                continue
+            yield i, f
+
+    def _consume(self, i: int, f: Fault) -> None:
+        self._remaining[i] -= 1
+        self.fired.append(f.label())
+
+    def _restart_ok(self, f: Fault, restart: Optional[int]) -> bool:
+        return f.restart is None or restart is None or f.restart == restart
+
+    # ---- worker-side sites ----
+
+    def crash_exit_code(
+        self, step: int, rtype=None, index=None, restart: Optional[int] = None
+    ) -> Optional[int]:
+        """crash_at_step: the exit code to die with at this step, or None."""
+        with self._lock:
+            for i, f in self._candidates("crash_at_step", rtype, index):
+                if f.at == step and self._restart_ok(f, restart):
+                    self._consume(i, f)
+                    return f.exit_code
+        return None
+
+    def stall_seconds(
+        self, rtype=None, index=None, restart: Optional[int] = None
+    ) -> float:
+        """stall_rendezvous: seconds to sleep before joining, or 0."""
+        total = 0.0
+        with self._lock:
+            for i, f in self._candidates("stall_rendezvous", rtype, index):
+                if self._restart_ok(f, restart):
+                    self._consume(i, f)
+                    total += f.seconds
+        return total
+
+    def drop_heartbeat(
+        self, rtype=None, index=None, restart: Optional[int] = None
+    ) -> bool:
+        """drop_heartbeat: suppress this progress report?"""
+        with self._lock:
+            for i, f in self._candidates("drop_heartbeat", rtype, index):
+                if self._restart_ok(f, restart):
+                    self._consume(i, f)
+                    return True
+        return False
+
+    def _occurrence(self, site: str) -> int:
+        """Bump and return the 1-based occurrence count of a site."""
+        n = self._site_counts.get(site, 0) + 1
+        self._site_counts[site] = n
+        return n
+
+    def _nth_fire(
+        self, kind: str, site: str, rtype=None, index=None,
+        restart: Optional[int] = None, key=None,
+    ) -> Optional[Fault]:
+        """Shared nth-occurrence logic: one site occurrence is counted
+        per call; a fault fires on occurrences [nth, nth+times)."""
+        with self._lock:
+            n = self._occurrence(site)
+            for i, f in self._candidates(kind, rtype, index, key=key):
+                if f.nth <= n < f.nth + f.times and self._restart_ok(
+                    f, restart
+                ):
+                    self._consume(i, f)
+                    return f
+        return None
+
+    def checkpoint_write_fault(
+        self, rtype=None, index=None, restart: Optional[int] = None
+    ) -> Optional[str]:
+        """The ``nth``-save checkpoint faults: ``"fail"`` (raise, retry
+        recovers), ``"torn"`` (corrupt bytes under a stale checksum), or
+        None. One save call = one occurrence, shared by both kinds so a
+        plan can say "write 2 fails transiently, write 3 lands torn"."""
+        with self._lock:
+            n = self._occurrence("checkpoint_write")
+            for kind in ("fail_checkpoint_write", "torn_checkpoint_write"):
+                for i, f in self._candidates(kind, rtype, index):
+                    if f.nth <= n < f.nth + f.times and self._restart_ok(
+                        f, restart
+                    ):
+                        self._consume(i, f)
+                        return "fail" if kind == "fail_checkpoint_write" else "torn"
+        return None
+
+    # ---- controller-side sites ----
+
+    def spawn_should_fail(self, rtype, index) -> bool:
+        return (
+            self._nth_fire("fail_spawn", f"spawn:{self._replica_id(rtype, index)}",
+                           rtype, index)
+            is not None
+        )
+
+    def torn_state_write(self, key: str) -> bool:
+        """One-shot torn write of a job's persisted state file."""
+        with self._lock:
+            for i, f in self._candidates("torn_state_write", key=key):
+                self._consume(i, f)
+                return True
+        return False
+
+    def kills_due(self, pass_index: int) -> List[Fault]:
+        """kill_replica faults scheduled for this supervisor pass."""
+        out = []
+        with self._lock:
+            for i, f in self._candidates("kill_replica"):
+                if f.at == pass_index:
+                    self._consume(i, f)
+                    out.append(f)
+        return out
+
+    # ---- serving site ----
+
+    def engine_step_fault(self) -> Optional[Fault]:
+        return self._nth_fire("fail_engine_step", "engine_step")
+
+
+# ---- process-global arming (controller side) ----
+
+_armed: Optional[FaultInjector] = None
+_worker: Optional[FaultInjector] = None
+_worker_loaded = False
+
+
+def arm(plan: FaultPlan) -> FaultInjector:
+    """Install a plan process-wide (chaos CLI / tests). Returns the
+    injector so callers can inspect ``fired`` afterwards."""
+    global _armed
+    _armed = FaultInjector(plan)
+    return _armed
+
+
+def disarm() -> None:
+    global _armed, _worker, _worker_loaded
+    _armed = None
+    _worker = None
+    _worker_loaded = False
+
+
+def active() -> Optional[FaultInjector]:
+    """The controller-side armed injector, if any."""
+    return _armed
+
+
+def worker_injector() -> Optional[FaultInjector]:
+    """The injector a spawning supervisor threaded into this replica via
+    ``TPUJOB_FAULT_PLAN`` (cached after first read), else None."""
+    global _worker, _worker_loaded
+    if not _worker_loaded:
+        _worker_loaded = True
+        plan = FaultPlan.from_env()
+        _worker = FaultInjector(plan) if plan is not None else None
+    return _worker
+
+
+def current() -> Optional[FaultInjector]:
+    """Site entrypoint: worker-side env plan wins (we ARE the replica),
+    else the process-global armed plan, else None — the no-plan fast
+    path is a single function call returning None."""
+    return worker_injector() or _armed
+
+
+def thread_env(env: dict) -> dict:
+    """Runner spawn hook: copy the armed plan into a replica's env so
+    worker-side faults reach the subprocess. A caller-provided plan in
+    the template env wins (explicit beats armed)."""
+    if _armed is not None and ENV_VAR not in env:
+        env[ENV_VAR] = _armed.plan.to_env()
+    return env
+
+
+def _replica_identity():
+    """(type, index, restart) of THIS process from the supervisor's
+    injected env; (None, None, None) outside a replica."""
+    rtype = os.environ.get("TPUJOB_REPLICA_TYPE")
+    if rtype is None:
+        return None, None, None
+    idx = int(os.environ.get("TPUJOB_REPLICA_INDEX", "0"))
+    restart = int(os.environ.get("TPUJOB_RESTART_COUNT", "0"))
+    return rtype, idx, restart
+
+
+# ---- convenience site helpers (the one-liners modules call) ----
+
+
+def crash_if_due(step: int) -> None:
+    """Worker site: exit the process if a crash_at_step fault is due."""
+    inj = current()
+    if inj is None:
+        return
+    rtype, idx, restart = _replica_identity()
+    code = inj.crash_exit_code(step, rtype, idx, restart)
+    if code is not None:
+        # Flush whatever the workload printed, then die abruptly — the
+        # point is an un-graceful casualty, not a clean shutdown.
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(code)
+
+
+def rendezvous_stall_seconds() -> float:
+    inj = current()
+    if inj is None:
+        return 0.0
+    rtype, idx, restart = _replica_identity()
+    return inj.stall_seconds(rtype, idx, restart)
+
+
+def heartbeat_dropped() -> bool:
+    inj = current()
+    if inj is None:
+        return False
+    rtype, idx, restart = _replica_identity()
+    return inj.drop_heartbeat(rtype, idx, restart)
+
+
+def checkpoint_write_fault() -> Optional[str]:
+    inj = current()
+    if inj is None:
+        return None
+    rtype, idx, restart = _replica_identity()
+    return inj.checkpoint_write_fault(rtype, idx, restart)
+
+
+def engine_step_check() -> None:
+    """Serving site: raise InjectedFault when a fail_engine_step is due."""
+    inj = current()
+    if inj is None:
+        return
+    f = inj.engine_step_fault()
+    if f is not None:
+        raise InjectedFault(f"injected engine-step fault {f.label()}")

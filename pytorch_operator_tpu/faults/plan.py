@@ -1,0 +1,195 @@
+"""Deterministic fault plans.
+
+The reference operator's whole value is surviving failure (restart
+policies, gang re-scheduling, crash backoff — PAPER.md §0, §7), yet a
+failure path that is only exercised by whatever the host happens to do
+is untestable. A :class:`FaultPlan` declares failures as DATA: seeded,
+step/pass/occurrence-indexed, no wall-clock randomness — so the same
+plan + seed replays the identical failure sequence every time, on a
+laptop or in CI.
+
+Plans are plain dataclasses, serializable to/from dict/JSON/YAML and a
+single environment variable (``TPUJOB_FAULT_PLAN``) so the supervisor
+can thread the armed plan into every replica it spawns (the worker-side
+faults — crash at a training step, rendezvous stall, torn checkpoint
+write — fire inside the replica process itself, giving tests a real
+subprocess casualty instead of a mock).
+
+Fault kinds (``Fault.kind``):
+
+- ``crash_at_step``          worker-side: exit ``exit_code`` at step ``at``
+- ``stall_rendezvous``       worker-side: sleep ``seconds`` before joining
+- ``drop_heartbeat``         worker-side: suppress the next ``times``
+                             progress heartbeats (trips the supervisor's
+                             hung-world detector)
+- ``fail_checkpoint_write``  worker-side: the ``nth`` checkpoint save
+                             raises (transient — the retry wrapper
+                             recovers it)
+- ``torn_checkpoint_write``  worker-side: the ``nth`` checkpoint save
+                             lands corrupt under a stale checksum sidecar
+                             (restore must fall back to the previous
+                             verified-good step)
+- ``kill_replica``           controller-side: SIGKILL the target replica
+                             at supervisor pass ``at`` (preemption model)
+- ``fail_spawn``             controller-side: the ``nth`` spawn of the
+                             target replica fails at launch
+- ``torn_state_write``       controller-side: the next persisted write of
+                             the target job's state file is torn
+- ``fail_engine_step``       serving: the ``nth`` engine iteration raises
+                             (the serve loop must recover in-flight
+                             requests with an error response)
+
+``target`` matches a replica as ``<type>-<index>`` (e.g. ``worker-0``,
+``master-*``) or a job key for job-scoped kinds; ``*`` matches all.
+``restart`` pins a worker-side fault to one job incarnation
+(``TPUJOB_RESTART_COUNT``), so a crash at step N does not re-fire after
+the restart it caused.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import List, Optional
+
+ENV_VAR = "TPUJOB_FAULT_PLAN"
+
+KINDS = frozenset(
+    {
+        "crash_at_step",
+        "stall_rendezvous",
+        "drop_heartbeat",
+        "fail_checkpoint_write",
+        "torn_checkpoint_write",
+        "kill_replica",
+        "fail_spawn",
+        "torn_state_write",
+        "fail_engine_step",
+    }
+)
+
+# Which kinds index by the nth OCCURRENCE of their site (1-based) vs by
+# an absolute step/pass number (``at``).
+NTH_KINDS = frozenset(
+    {
+        "fail_checkpoint_write",
+        "torn_checkpoint_write",
+        "fail_spawn",
+        "fail_engine_step",
+    }
+)
+
+
+@dataclass
+class Fault:
+    """One declared failure. Fully deterministic: firing is a pure
+    function of (kind, target, indices seen so far) — never of wall
+    clock or randomness."""
+
+    kind: str
+    target: str = "*"
+    at: int = 0  # step (crash_at_step) / supervisor pass (kill_replica)
+    nth: int = 1  # 1-based occurrence index for NTH_KINDS
+    times: int = 1  # consecutive firings (e.g. drop N heartbeats)
+    seconds: float = 0.0  # stall duration
+    exit_code: int = 9  # crash_at_step exit status
+    restart: Optional[int] = None  # pin to one incarnation (None = any)
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (known: {sorted(KINDS)})"
+            )
+        if self.times < 1:
+            raise ValueError(f"{self.kind}: times must be >= 1")
+        if self.nth < 1:
+            raise ValueError(f"{self.kind}: nth is 1-based, must be >= 1")
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        # Terse round-trip: drop defaulted fields so plans stay readable.
+        defaults = Fault(kind=self.kind)
+        return {
+            k: v
+            for k, v in d.items()
+            if k == "kind" or v != getattr(defaults, k)
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Fault":
+        known = {f for f in cls.__dataclass_fields__}
+        extra = set(d) - known
+        if extra:
+            raise ValueError(f"fault has unknown fields: {sorted(extra)}")
+        return cls(**d)
+
+    def label(self) -> str:
+        """Compact deterministic description for events/replay output."""
+        idx = f"@{self.at}" if self.kind not in NTH_KINDS else f"#{self.nth}"
+        return f"{self.kind}({self.target}{idx})"
+
+
+@dataclass
+class FaultPlan:
+    """A seeded, ordered set of faults — the unit ``tpujob chaos``
+    replays. ``seed`` feeds every deterministic-jitter consumer (backoff
+    delays) so two runs of one plan sleep the same schedule."""
+
+    seed: int = 0
+    faults: List[Fault] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed, "faults": [f.to_dict() for f in self.faults]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        if not isinstance(d, dict):
+            raise ValueError(f"fault plan must be a mapping, got {type(d)}")
+        faults = [
+            f if isinstance(f, Fault) else Fault.from_dict(f)
+            for f in d.get("faults", [])
+        ]
+        return cls(seed=int(d.get("seed", 0)), faults=faults)
+
+    # ---- serialization (env var / file) ----
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "FaultPlan":
+        import yaml
+
+        return cls.from_dict(yaml.safe_load(text) or {})
+
+    @classmethod
+    def load(cls, path) -> "FaultPlan":
+        """Read a plan file (YAML — JSON is a YAML subset)."""
+        with open(path) as f:
+            return cls.from_yaml(f.read())
+
+    def to_env(self) -> str:
+        return self.to_json()
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["FaultPlan"]:
+        """The plan the spawning supervisor threaded into this process,
+        or None. The value is either inline JSON or ``@/path/to/plan``."""
+        environ = os.environ if environ is None else environ
+        raw = environ.get(ENV_VAR, "").strip()
+        if not raw:
+            return None
+        if raw.startswith("@"):
+            return cls.load(raw[1:])
+        return cls.from_json(raw)
+
+    def summary(self) -> str:
+        """One-line deterministic description (chaos events/replay)."""
+        return f"seed={self.seed} " + ", ".join(
+            f.label() for f in self.faults
+        )

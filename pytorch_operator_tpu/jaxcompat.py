@@ -1,0 +1,90 @@
+"""jax version-compat seam.
+
+The parallel/ops stack is written against the current jax surface
+(``jax.shard_map`` with ``axis_names``/``check_vma``, ``jax.typeof``
+with vma-annotated avals). Deployments pinning an older jax (this
+image ships 0.4.x, where shard_map lives in ``jax.experimental`` and
+speaks ``auto``/``check_rep``) must still run the same code — one
+wrapper owns the translation so call sites stay written against the
+NEW API and this file is the only thing to delete when the floor
+moves.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    axis_names=None,
+    check_vma=None,
+):
+    """``jax.shard_map`` when available, else the experimental one with
+    the kwargs translated:
+
+    - ``axis_names`` (the set of MANUAL mesh axes) becomes the old
+      ``auto`` complement (every other mesh axis stays automatic);
+    - ``check_vma`` becomes ``check_rep`` (same replication check,
+      renamed when the vma machinery landed).
+    """
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return sm(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - set(axis_names)
+        if auto:
+            # TRUE partial-manual (some axes left automatic) trips an
+            # XLA SPMD-partitioner CHECK on this jaxlib (manual-subgroup
+            # mismatch) — a process ABORT at compile, not an exception.
+            # Refuse cleanly at trace instead; full-manual regions
+            # (axis_names covering the whole mesh) are fine.
+            raise NotImplementedError(
+                f"partial-manual shard_map (auto axes {sorted(auto)}) "
+                "miscompiles on this jax version; use a mesh whose axes "
+                "are all manual here, or a newer jax"
+            )
+    # The legacy replication checker predates vma casts: code written to
+    # satisfy the vma type system (pcast-ing scan carries to varying) is
+    # identity under this jax, so the old checker rejects exactly the
+    # carries the casts exist to bless. Default it OFF here — numerics
+    # are pinned by tests, not by the advisory checker — unless the
+    # caller asked explicitly.
+    kwargs["check_rep"] = False if check_vma is None else check_vma
+    return legacy(f, **kwargs)
+
+
+def pcast_varying(x, axis):
+    """``jax.lax.pcast(x, (axis,), to="varying")`` on jax versions with
+    vma typing; identity on older jax, where manual-region types carry
+    no varying-axis annotation and carry-type stability needs no cast."""
+    import jax
+
+    if not hasattr(jax.lax, "pcast"):
+        return x
+    return jax.lax.pcast(x, (axis,), to="varying")
+
+
+def axis_size(axis):
+    """``jax.lax.axis_size`` when available; on older jax,
+    ``psum(1, axis)`` — special-cased there to return the static axis
+    size as a Python int, so perm-list builders stay static either way.
+    Call inside a manual region (shard_map) only."""
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis)
+    return jax.lax.psum(1, axis)

@@ -522,7 +522,7 @@ def flash_attention(
     if not manual:
         return core(q, k, v)
 
-    from jax import shard_map
+    from ..jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     batch = tuple(batch_axes) or None

@@ -43,7 +43,9 @@ def ring_shift(x: Any, axis: str, *, shift: int = 1):
     shard to the next rank (rank i's output = rank i-1's input)."""
     import jax
 
-    n = jax.lax.axis_size(axis)
+    from ..jaxcompat import axis_size as _axis_size
+
+    n = _axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis, perm)
 
@@ -55,6 +57,6 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str):
-    import jax
+    from ..jaxcompat import axis_size as _axis_size
 
-    return jax.lax.axis_size(axis)
+    return _axis_size(axis)

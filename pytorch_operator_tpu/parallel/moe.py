@@ -187,7 +187,7 @@ def moe_mlp_sparse(
     ep = mesh.shape[axis]
     if n_exp % ep:
         raise ValueError(f"experts {n_exp} not divisible by ep={ep}")
-    from jax import shard_map
+    from ..jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def per_shard(weights, dispatch_g, combine_g, xg_g):
@@ -235,7 +235,7 @@ def moe_mlp(
     (standard renormalized top-k routing); expert FFN is gelu.
     """
     import jax
-    from jax import shard_map
+    from ..jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n_exp, d_model, d_ff = params["w_in"].shape

@@ -56,7 +56,9 @@ def ring_attention_shard(
     import jax.numpy as jnp
 
     B, Sq, K, G, D = q.shape
-    n = jax.lax.axis_size(axis_name)
+    from ..jaxcompat import axis_size
+
+    n = axis_size(axis_name)
     scale = 1.0 / (D**0.5)
     neg = jnp.finfo(jnp.float32).min
 
@@ -92,7 +94,9 @@ def ring_attention_shard(
     # after the first block; mark them varying over the ring axis up front so
     # the fori_loop carry type is stable (shard_map VMA typing).
     def varying(x):
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        from ..jaxcompat import pcast_varying
+
+        return pcast_varying(x, axis_name)
 
     m0 = varying(jnp.full((B, K, G, Sq), neg, jnp.float32))
     l0 = varying(jnp.zeros((B, K, G, Sq), jnp.float32))
@@ -139,7 +143,9 @@ def ring_self_attention(
     ``axis_name`` ONLY — batch and head dims stay compiler-managed so dp /
     fsdp / tp sharding composes without re-specifying it here.
     """
-    from jax import shard_map
+    import jax
+
+    from ..jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     if (
@@ -152,7 +158,6 @@ def ring_self_attention(
         or q.shape[1] % mesh.shape[axis_name]
     ):
         return _single_shard(q, k, v, positions, causal=causal)
-
     body = functools.partial(
         ring_attention_shard, axis_name=axis_name, causal=causal
     )

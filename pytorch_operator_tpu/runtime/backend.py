@@ -26,9 +26,17 @@ def setup_backend(platform: Optional[str] = None) -> str:
     platform = platform or os.environ.get("TPUJOB_PLATFORM", "")
     if platform:
         jax.config.update("jax_platforms", platform)
-    if platform == "cpu":
+    if (
+        platform == "cpu"
+        and int(os.environ.get("TPUJOB_NUM_PROCESSES", "1")) > 1
+    ):
         # Gloo gives the CPU backend real inter-process collectives — the
         # stand-in for ICI/DCN when testing multi-host topologies locally
-        # (SURVEY.md §4: multi-host without a pod).
+        # (SURVEY.md §4: multi-host without a pod). Only for multi-process
+        # worlds: gloo needs the distributed client jax.distributed.
+        # initialize creates, and building a single-process CPU backend
+        # with gloo configured but no client hard-fails at first use
+        # (observed on this jaxlib), taking every single-process jax
+        # test/workload down with it.
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     return platform

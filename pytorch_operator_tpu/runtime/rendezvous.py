@@ -55,6 +55,38 @@ def world_from_env() -> WorldInfo:
     )
 
 
+def fault_stall_if_armed() -> float:
+    """The ``stall_rendezvous`` injection site: sleep (and report) the
+    seconds an armed fault plan asks for, returning them. A no-op
+    (0.0, no imports beyond the light faults package) without a plan.
+
+    Public because workloads that never reach jax.distributed (e.g. the
+    single-process ``exit_with`` chaos casualty) call it directly to
+    model a slow join on the same code path."""
+    from .. import faults
+
+    seconds = faults.rendezvous_stall_seconds()
+    if seconds > 0:
+        report("fault_stall", seconds=seconds, site="rendezvous")
+        time.sleep(seconds)
+    return seconds
+
+
+def join_backoff(timeout_s: float, base_s: float, seed: int):
+    """The rendezvous retry schedule: exponential + deterministic jitter
+    (seeded per process id so a gang's workers decorrelate instead of
+    herding on the coordinator every fixed 1 s), capped well inside the
+    join timeout so late attempts still fit."""
+    from ..backoff import Backoff
+
+    return Backoff(
+        base_s=base_s,
+        cap_s=max(base_s, min(10.0, timeout_s / 4.0)),
+        jitter=0.25,
+        seed=seed,
+    )
+
+
 def initialize_from_env(
     timeout_s: float = 60.0, retry_interval_s: float = 1.0
 ) -> WorldInfo:
@@ -62,11 +94,14 @@ def initialize_from_env(
 
     Single-process worlds skip initialization entirely (single-process SPMD
     across local devices). Multi-process worlds call
-    ``jax.distributed.initialize`` with retries — the connect-retry gate that
-    replaces the reference's initContainer DNS loop.
+    ``jax.distributed.initialize`` with retries — the connect-retry gate
+    that replaces the reference's initContainer DNS loop, now on a
+    jittered exponential backoff (``retry_interval_s`` is the base
+    delay); the outer ``timeout_s`` contract is unchanged.
     """
     from .backend import setup_backend
 
+    fault_stall_if_armed()
     setup_backend()
     world = world_from_env()
     if world.num_processes <= 1:
@@ -74,23 +109,27 @@ def initialize_from_env(
 
     import jax
 
-    deadline = time.time() + timeout_s
-    last_err: Optional[Exception] = None
-    while time.time() < deadline:
-        try:
-            jax.distributed.initialize(
-                coordinator_address=world.coordinator,
-                num_processes=world.num_processes,
-                process_id=world.process_id,
-            )
-            return world
-        except Exception as e:  # pragma: no cover - env-dependent errors
-            last_err = e
-            time.sleep(retry_interval_s)
-    raise TimeoutError(
-        f"rendezvous with coordinator {world.coordinator} failed after "
-        f"{timeout_s}s: {last_err}"
-    )
+    from ..backoff import retry_call
+
+    def join():
+        jax.distributed.initialize(
+            coordinator_address=world.coordinator,
+            num_processes=world.num_processes,
+            process_id=world.process_id,
+        )
+
+    try:
+        retry_call(
+            join,
+            backoff=join_backoff(timeout_s, retry_interval_s, world.process_id),
+            timeout_s=timeout_s,
+        )
+        return world
+    except Exception as e:  # pragma: no cover - env-dependent errors
+        raise TimeoutError(
+            f"rendezvous with coordinator {world.coordinator} failed after "
+            f"{timeout_s}s: {e}"
+        ) from e
 
 
 # ---- status reporting (workload → supervisor) ----
@@ -147,6 +186,13 @@ def report_progress(
     (controller/progress.py). Emit every ~10s, not every step — each
     record is a host write and the caller usually pays a device fence
     to know the loss."""
+    # ``drop_heartbeat`` injection site: an armed fault plan can
+    # suppress heartbeats to trip the supervisor's hung-world detector
+    # (controller/reconciler.py). No-op without a plan.
+    from .. import faults
+
+    if faults.heartbeat_dropped():
+        return
     fields = {}
     if loss is not None:
         fields["loss"] = round(float(loss), 6)

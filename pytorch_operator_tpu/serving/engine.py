@@ -311,6 +311,12 @@ class ServingEngine:
         """One engine iteration: admit into free slots at this block
         boundary, run one decode block, harvest finished requests.
         Returns the requests completed this iteration."""
+        from .. import faults
+
+        # Fault-injection site: a ``fail_engine_step`` plan entry makes
+        # this iteration raise InjectedFault — the serve loop's recovery
+        # (abort_in_flight + error responses) is what chaos tests pin.
+        faults.engine_step_check()
         jnp = self._jnp
         # 1. Admission.
         for slot in self._free_slots():
@@ -383,6 +389,20 @@ class ServingEngine:
             self._slots[i] = None  # the slot is free for the next admit
         self.completed.extend(out)
         return out
+
+    def abort_in_flight(self) -> list[str]:
+        """Failure-path hardening: evict every occupied slot and return
+        the aborted request ids (the serve loop answers each with an
+        error response — exactly-once, never a silent drop). Queued
+        requests stay queued. Safe without cache surgery: admission
+        prefills a row in full before any decode reads it, so a freed
+        slot's stale k/v can never leak into a later request."""
+        aborted = []
+        for i, st in enumerate(self._slots):
+            if st is not None:
+                aborted.append(st.request.id)
+                self._slots[i] = None
+        return aborted
 
     @property
     def queued(self) -> int:

@@ -4,11 +4,94 @@
 ``--until-restart K`` — exit with ``--code`` while TPUJOB_RESTART_COUNT < K,
 then exit 0 (models a crash that recovers after K restarts).
 ``--sleep S`` — sleep first (keeps the replica Running for a while).
+``--steps N`` — run N numbered "training" steps instead of exiting
+immediately: each step heartbeats via rendezvous.report_progress and
+(under ``TPUJOB_CHECKPOINT_DIR``) commits a tiny step checkpoint with a
+checksum sidecar; on restart the loop resumes after the last
+VERIFIED-GOOD step. Combined with a ``TPUJOB_FAULT_PLAN`` (faults/) this
+gives e2e chaos tests a real subprocess casualty — crash at an exact
+step, stalled rendezvous, failed/torn checkpoint writes — with no jax
+import and no mocks.
+``--step-time S`` — sleep per step (keeps incarnations observable).
 """
 
 import argparse
+import json
 import os
+import sys
 import time
+from pathlib import Path
+
+from .. import faults
+from ..backoff import Backoff, retry_call
+from ..checkpoint import integrity
+from ..runtime import rendezvous
+
+
+def _save_step_checkpoint(root: Path, step: int) -> None:
+    """Commit ``root/<step>/state.json`` + sidecar, honoring the
+    checkpoint-write faults exactly like the orbax manager does: a
+    transient failure is retried on the shared backoff, a torn write
+    lands corrupt bytes under a stale sidecar."""
+    fault = faults.checkpoint_write_fault()
+
+    def attempt():
+        nonlocal fault
+        if fault == "fail":
+            fault = None  # transient: only the first attempt fails
+            raise OSError("injected transient checkpoint write failure")
+        d = root / str(step)
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "state.json").write_text(json.dumps({"step": step}))
+
+    retry_call(
+        attempt,
+        backoff=Backoff(base_s=0.01, cap_s=0.1, seed=step),
+        attempts=3,
+        retry_on=(OSError,),
+    )
+    integrity.write_sidecar(root, step)
+    if fault == "torn":
+        integrity.corrupt_step(root, step, mode="truncate")
+
+
+def _restore_step(root: Path) -> int:
+    """Last verified-good step (0 = fresh start), reporting skipped
+    corrupt steps on the status channel like the real manager."""
+    steps = integrity.list_steps(root)
+
+    def on_corrupt(s):
+        older = max((x for x in steps if x < s), default=None)
+        print(
+            f"[exit_with] checkpoint step {s} corrupt; falling back "
+            f"toward {older}",
+            flush=True,
+        )
+        rendezvous.report("checkpoint_corrupt", step=s, fallback=older)
+
+    step = integrity.latest_verified_step(root, steps, on_corrupt=on_corrupt)
+    if step is not None:
+        data = json.loads((root / str(step) / "state.json").read_text())
+        print(f"[exit_with] restored step {data['step']}", flush=True)
+        return int(data["step"])
+    return 0
+
+
+def _run_steps(steps: int, step_time: float) -> int:
+    rendezvous.fault_stall_if_armed()  # the rendezvous-join stand-in
+    ckpt = os.environ.get("TPUJOB_CHECKPOINT_DIR")
+    root = Path(ckpt) if ckpt else None
+    start = _restore_step(root) if root is not None else 0
+    rendezvous.report_first_step(start + 1)
+    for step in range(start + 1, steps + 1):
+        rendezvous.report_progress(step, steps_per_sec=1.0 / max(step_time, 1e-6))
+        faults.crash_if_due(step)
+        if root is not None:
+            _save_step_checkpoint(root, step)
+        if step_time:
+            time.sleep(step_time)
+    print(f"[exit_with] completed {steps} steps (resumed from {start})", flush=True)
+    return 0
 
 
 def main() -> int:
@@ -16,9 +99,15 @@ def main() -> int:
     p.add_argument("--code", type=int, default=1)
     p.add_argument("--until-restart", type=int, default=None)
     p.add_argument("--sleep", type=float, default=0.0)
+    p.add_argument("--steps", type=int, default=0)
+    p.add_argument("--step-time", type=float, default=0.0)
     args = p.parse_args()
     if args.sleep:
         time.sleep(args.sleep)
+    if args.steps:
+        rc = _run_steps(args.steps, args.step_time)
+        sys.stdout.flush()
+        return rc
     restart = int(os.environ.get("TPUJOB_RESTART_COUNT", "0"))
     if args.until_restart is not None and restart >= args.until_restart:
         return 0

@@ -25,6 +25,7 @@ import json
 import sys
 import time
 
+from .. import faults
 from ..runtime import rendezvous
 
 
@@ -150,7 +151,26 @@ def run(
                 rejected += 1
                 spool.respond(rec.get("id", "unknown"), {"error": str(e)})
         if engine.busy:
-            for res in engine.step():
+            try:
+                results = engine.step()
+            except faults.InjectedFault as e:
+                # Failure-path hardening: a faulted iteration must not
+                # strand its in-flight requests (a client would block
+                # its full timeout on a response nothing will write).
+                # Abort the occupied slots and answer each with an
+                # error — exactly-once responses, queued requests
+                # untouched, the engine keeps serving.
+                aborted = engine.abort_in_flight()
+                for rid in aborted:
+                    spool.respond(rid, {"id": rid, "error": f"engine fault: {e}"})
+                rejected += len(aborted)
+                log(
+                    f"[serve] engine step fault ({e}); aborted "
+                    f"{len(aborted)} in-flight request(s) with error "
+                    "responses"
+                )
+                results = []
+            for res in results:
                 finish(res)
         else:
             time.sleep(poll_interval)

@@ -55,9 +55,11 @@ def main() -> int:
 
     from functools import partial
 
+    from ..jaxcompat import shard_map
+
     @jax.jit
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=PartitionSpec("dp"),
         out_specs=(PartitionSpec(), PartitionSpec("dp")),

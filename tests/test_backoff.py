@@ -1,0 +1,150 @@
+"""The shared jittered-backoff retry helper (backoff.py) and its
+rendezvous integration — the hardening that replaced the fixed-interval
+retry loop (thundering-herd joins) and gave checkpoint I/O a retry at
+all."""
+
+import pytest
+
+from pytorch_operator_tpu.backoff import Backoff, retry_call
+
+
+class TestBackoffSchedule:
+    def test_deterministic_per_seed(self):
+        a = Backoff(base_s=0.1, cap_s=5.0, seed=3)
+        b = Backoff(base_s=0.1, cap_s=5.0, seed=3)
+        assert a.delays(8) == b.delays(8)
+
+    def test_seeds_decorrelate(self):
+        a = Backoff(base_s=0.1, cap_s=5.0, seed=0)
+        b = Backoff(base_s=0.1, cap_s=5.0, seed=1)
+        assert a.delays(8) != b.delays(8)
+
+    def test_exponential_growth_then_cap(self):
+        b = Backoff(base_s=0.5, cap_s=4.0, jitter=0.0)
+        assert b.delays(5) == [0.5, 1.0, 2.0, 4.0, 4.0]
+
+    def test_jitter_bounded(self):
+        b = Backoff(base_s=1.0, cap_s=1.0, jitter=0.25, seed=9)
+        for d in b.delays(32):
+            assert 0.75 <= d <= 1.25
+
+    def test_no_wall_clock_randomness(self):
+        # Same object, same attempt -> same delay, always.
+        b = Backoff(seed=5)
+        assert b.delay(3) == b.delay(3)
+
+
+class TestRetryCall:
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        slept = []
+        out = retry_call(
+            fn,
+            backoff=Backoff(base_s=0.01, jitter=0.0),
+            attempts=5,
+            retry_on=(OSError,),
+            sleep=slept.append,
+        )
+        assert out == "ok"
+        assert len(calls) == 3
+        assert slept == [0.01, 0.02]
+
+    def test_attempts_exhausted_reraises(self):
+        def fn():
+            raise OSError("always")
+
+        with pytest.raises(OSError, match="always"):
+            retry_call(
+                fn,
+                backoff=Backoff(base_s=0.0, jitter=0.0),
+                attempts=3,
+                retry_on=(OSError,),
+                sleep=lambda d: None,
+            )
+
+    def test_timeout_contract(self):
+        # A fake clock: every attempt costs 1s; the deadline cuts the
+        # retry loop even though attempts is unbounded.
+        t = [0.0]
+
+        def clock():
+            return t[0]
+
+        def fn():
+            t[0] += 1.0
+            raise ValueError("down")
+
+        with pytest.raises(ValueError):
+            retry_call(
+                fn,
+                backoff=Backoff(base_s=0.1, jitter=0.0),
+                timeout_s=3.0,
+                retry_on=(ValueError,),
+                sleep=lambda d: None,
+                clock=clock,
+            )
+        assert t[0] <= 4.0  # stopped at the deadline, not much past it
+
+    def test_on_retry_cleanup_hook(self):
+        seen = []
+
+        def fn():
+            if len(seen) < 1:
+                raise OSError("partial write")
+            return 42
+
+        assert (
+            retry_call(
+                fn,
+                backoff=Backoff(base_s=0.0, jitter=0.0),
+                attempts=3,
+                retry_on=(OSError,),
+                on_retry=lambda e, a: seen.append((str(e), a)),
+                sleep=lambda d: None,
+            )
+            == 42
+        )
+        assert seen == [("partial write", 1)]
+
+    def test_unlisted_exception_propagates_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise KeyError("not transient")
+
+        with pytest.raises(KeyError):
+            retry_call(
+                fn, backoff=Backoff(), attempts=5, retry_on=(OSError,),
+                sleep=lambda d: None,
+            )
+        assert len(calls) == 1
+
+    def test_needs_a_bound(self):
+        with pytest.raises(ValueError):
+            retry_call(lambda: 1, backoff=Backoff())
+
+
+class TestRendezvousIntegration:
+    def test_join_backoff_shape(self):
+        from pytorch_operator_tpu.runtime.rendezvous import join_backoff
+
+        b = join_backoff(timeout_s=60.0, base_s=1.0, seed=0)
+        # Base honored, cap inside the join timeout, capped at 10s.
+        assert b.base_s == 1.0
+        assert b.cap_s == 10.0
+        assert join_backoff(timeout_s=8.0, base_s=1.0, seed=0).cap_s == 2.0
+
+    def test_worker_seeds_decorrelate(self):
+        from pytorch_operator_tpu.runtime.rendezvous import join_backoff
+
+        w0 = join_backoff(60.0, 1.0, seed=0).delays(6)
+        w1 = join_backoff(60.0, 1.0, seed=1).delays(6)
+        assert w0 != w1  # no thundering herd on the coordinator

@@ -32,14 +32,21 @@ import pytest
 # termination timeout and the run is killed mid-AllGather (observed
 # first-hand). Raise the stuck/terminate budgets — must land in
 # XLA_FLAGS before the CPU client is created.
-_flags = os.environ.get("XLA_FLAGS", "")
-for _flag in (
-    "--xla_cpu_collective_call_warn_stuck_timeout_seconds=3600",
-    "--xla_cpu_collective_call_terminate_timeout_seconds=7200",
-):
-    if _flag.split("=")[0] not in _flags:
-        _flags = f"{_flags} {_flag}".strip()
-os.environ["XLA_FLAGS"] = _flags
+#
+# ONLY under the opt-in: pytest imports every module at collection, so
+# an unconditional mutation leaks these flags into the whole suite's
+# process — and a jaxlib that doesn't know them fatally aborts
+# (parse_flags_from_env F-check) at the first CPU client creation,
+# taking every jax test down with it.
+if os.environ.get("TPUJOB_RUN_8B"):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    for _flag in (
+        "--xla_cpu_collective_call_warn_stuck_timeout_seconds=3600",
+        "--xla_cpu_collective_call_terminate_timeout_seconds=7200",
+    ):
+        if _flag.split("=")[0] not in _flags:
+            _flags = f"{_flags} {_flag}".strip()
+    os.environ["XLA_FLAGS"] = _flags
 
 import tests.jaxenv  # noqa: F401,E402
 

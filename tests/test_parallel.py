@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import tests.jaxenv  # noqa: F401  (forces CPU platform before jax use)
+from pytorch_operator_tpu.jaxcompat import shard_map
 from pytorch_operator_tpu.parallel import (
     collectives,
     fsdp_spec,
@@ -165,7 +166,7 @@ class TestCollectives:
 
         @jax.jit
         @partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=PartitionSpec("dp"),
             out_specs=(PartitionSpec(), PartitionSpec("dp"), PartitionSpec("dp")),
@@ -195,7 +196,7 @@ class TestCollectives:
 
         @jax.jit
         @partial(
-            jax.shard_map, mesh=mesh, in_specs=(), out_specs=PartitionSpec("dp")
+            shard_map, mesh=mesh, in_specs=(), out_specs=PartitionSpec("dp")
         )
         def f():
             return jnp.reshape(collectives.axis_index("dp"), (1,))
