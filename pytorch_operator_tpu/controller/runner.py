@@ -250,6 +250,10 @@ class FakeRunner(ProcessRunner):
 
     def __init__(self, capacity: Optional[int] = None):
         self.handles: Dict[str, ReplicaHandle] = {}
+        # Per-job handle index: list_for_job is the reconciler's hottest
+        # read (every sync of every job), and a flat scan of ALL handles
+        # made a pass O(jobs x replicas) in pure bookkeeping.
+        self._by_job: Dict[str, Dict[str, ReplicaHandle]] = {}
         self.envs: Dict[str, Dict[str, str]] = {}
         self.templates: Dict[str, ProcessTemplate] = {}
         self.actions: List[tuple] = []
@@ -280,30 +284,37 @@ class FakeRunner(ProcessRunner):
                     finished_at=time.time(),
                     slots=replica_slots(template),
                 )
-                self.handles[name] = h
-                self.envs[name] = dict(env)
-                self.templates[name] = template
-                self.actions.append(("create", name))
-                return h
-            h = ReplicaHandle(
-                name=name,
-                job_key=job_key,
-                replica_type=rtype,
-                index=index,
-                phase=ReplicaPhase.PENDING,
-                created_at=time.time(),
-                slots=replica_slots(template),
-            )
+            else:
+                h = ReplicaHandle(
+                    name=name,
+                    job_key=job_key,
+                    replica_type=rtype,
+                    index=index,
+                    phase=ReplicaPhase.PENDING,
+                    created_at=time.time(),
+                    slots=replica_slots(template),
+                )
             self.handles[name] = h
+            self._by_job.setdefault(job_key, {})[name] = h
             self.envs[name] = dict(env)
             self.templates[name] = template
             self.actions.append(("create", name))
             return h
 
+    def _index_pop(self, name: str) -> Optional[ReplicaHandle]:
+        h = self.handles.pop(name, None)
+        if h is not None:
+            per_job = self._by_job.get(h.job_key)
+            if per_job is not None:
+                per_job.pop(name, None)
+                if not per_job:
+                    self._by_job.pop(h.job_key, None)
+        return h
+
     def delete(self, name, grace_seconds: float = 5.0):
         with self._lock:
             self.actions.append(("delete", name))
-            h = self.handles.pop(name, None)
+            h = self._index_pop(name)
             if h is not None:
                 self.envs.pop(name, None)
                 self.templates.pop(name, None)
@@ -313,7 +324,7 @@ class FakeRunner(ProcessRunner):
 
     def list_for_job(self, job_key):
         with self._lock:
-            return [h for h in self.handles.values() if h.job_key == job_key]
+            return list(self._by_job.get(job_key, {}).values())
 
     def get(self, name):
         with self._lock:
@@ -321,7 +332,7 @@ class FakeRunner(ProcessRunner):
 
     def remove_record(self, name):
         with self._lock:
-            self.handles.pop(name, None)
+            self._index_pop(name)
 
     def schedulable_slots(self):
         with self._lock:
@@ -399,6 +410,9 @@ class SubprocessRunner(ProcessRunner):
             self._standby_pool = StandbyPool(self.state_dir, standby)
             self._standby_pool.replenish()
         self.handles: Dict[str, ReplicaHandle] = {}
+        # Per-job handle index (see FakeRunner._by_job): keeps
+        # list_for_job O(own replicas) instead of O(all replicas).
+        self._by_job: Dict[str, Dict[str, ReplicaHandle]] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
         self._log_files: Dict[str, object] = {}
         # Replicas adopted from a previous incarnation: polled via /proc
@@ -447,6 +461,20 @@ class SubprocessRunner(ProcessRunner):
         except (OSError, ValueError):
             return None
 
+    def _index_add(self, h: ReplicaHandle) -> None:
+        self.handles[h.name] = h
+        self._by_job.setdefault(h.job_key, {})[h.name] = h
+
+    def _index_pop(self, name: str) -> Optional[ReplicaHandle]:
+        h = self.handles.pop(name, None)
+        if h is not None:
+            per_job = self._by_job.get(h.job_key)
+            if per_job is not None:
+                per_job.pop(name, None)
+                if not per_job:
+                    self._by_job.pop(h.job_key, None)
+        return h
+
     def rescan(self) -> None:
         """Adopt the worlds another incarnation left behind — the
         hot-standby takeover step. The standby's startup snapshot (taken
@@ -458,7 +486,7 @@ class SubprocessRunner(ProcessRunner):
         with self._lock:
             for name in list(self.handles):
                 if name not in self._procs:
-                    self.handles.pop(name)
+                    self._index_pop(name)
                     self._adopted.pop(name, None)
                     self._pid_starts.pop(name, None)
             self._load_records(persist_classification=True)
@@ -520,7 +548,7 @@ class SubprocessRunner(ProcessRunner):
                     self._adopted[h.name] = h.pid
                 else:
                     self._finish_dead_adopted(h, save=persist_classification)
-            self.handles[h.name] = h
+            self._index_add(h)
 
     def _finish_dead_adopted(self, h: ReplicaHandle, save: bool = True) -> None:
         """Classify a replica found dead without a waitpid: exit-capture file
@@ -605,7 +633,7 @@ class SubprocessRunner(ProcessRunner):
                             log_path=str(log_path),
                             slots=replica_slots(template),
                         )
-                        self.handles[name] = h
+                        self._index_add(h)
                         self._procs[name] = proc
                         stat = _proc_stat(proc.pid)
                         self._pid_starts[name] = stat[0] if stat else None
@@ -643,7 +671,7 @@ class SubprocessRunner(ProcessRunner):
                     log_path=str(log_path),
                     slots=replica_slots(template),
                 )
-                self.handles[name] = h
+                self._index_add(h)
                 self._save(h)
                 return h
             h = ReplicaHandle(
@@ -657,7 +685,7 @@ class SubprocessRunner(ProcessRunner):
                 log_path=str(log_path),
                 slots=replica_slots(template),
             )
-            self.handles[name] = h
+            self._index_add(h)
             self._procs[name] = proc
             self._log_files[name] = log_f
             stat = _proc_stat(proc.pid)

@@ -3,6 +3,24 @@
 Reference: the Kubernetes API server + informer caches (SURVEY.md §1 layers
 1–2) collapse locally into a thread-safe dict of TPUJob objects, optionally
 persisted as JSON files so the CLI can inspect state across processes.
+
+The persistence layer is a CACHE, informer-style: the in-memory object is
+authoritative for the owning supervisor, and disk I/O happens only on real
+transitions. Concretely (the control-plane hot path at thousands of jobs):
+
+- ``_persist`` dirty-tracks the serialized form per key and skips the
+  write when nothing changed — an idle job costs zero write I/O per pass.
+- ``rescan`` takes ONE ``scandir`` snapshot of the state dir per call:
+  job files are recognized by filename (keys derive from the name, so
+  known jobs are never re-read), and the same snapshot serves all four
+  marker scans (delete/apply/suspend/scale) for the pass — replacing the
+  old per-pass pattern of ~6 directory globs plus N whole-file reads.
+- ``_sweep_stale_tmp`` runs at load and then periodically (piggybacked
+  on the rescan snapshot), never on every pass.
+
+``cache=False`` disables all of it and reproduces the pre-cache behavior
+(every rescan reads every file, every persist writes) — kept as the
+measurable baseline for ``tpujob bench-control-plane``.
 """
 
 from __future__ import annotations
@@ -16,6 +34,34 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from ..api.types import TPUJob
+
+# Re-sweep orphaned *.tmp files at most this often (first sweep at load).
+SWEEP_INTERVAL_S = 300.0
+
+# Marker kinds a scandir snapshot collects for the pass.
+_MARKER_KINDS = ("delete", "apply", "suspend", "scale")
+
+
+class StoreIOCounters:
+    """Per-store file-I/O accounting for the control-plane bench: how many
+    job/marker files were read, written, or skipped-as-clean, and how many
+    directory scans ran. Monotonic; read deltas per pass."""
+
+    __slots__ = ("reads", "writes", "writes_skipped", "scans")
+
+    def __init__(self) -> None:
+        self.reads = 0
+        self.writes = 0
+        self.writes_skipped = 0
+        self.scans = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "reads": self.reads,
+            "writes": self.writes,
+            "writes_skipped": self.writes_skipped,
+            "scans": self.scans,
+        }
 
 
 def job_key(job: TPUJob) -> str:
@@ -36,7 +82,12 @@ def fs_to_key(name: str) -> str:
 
 
 class JobStore:
-    def __init__(self, persist_dir: Optional[Path] = None, events=None):
+    def __init__(
+        self,
+        persist_dir: Optional[Path] = None,
+        events=None,
+        cache: bool = True,
+    ):
         self._jobs: Dict[str, TPUJob] = {}
         self._lock = threading.RLock()
         # Optional EventRecorder: persistence-layer failures (corrupt
@@ -44,10 +95,24 @@ class JobStore:
         # instead of vanishing into stdout. CLI observers pass none and
         # fall back to a printed warning.
         self._events = events
+        # cache=False: pre-cache behavior (always write, always re-read on
+        # rescan, glob per marker scan) — the bench baseline.
+        self._cache_enabled = cache
+        # Dirty tracking: key -> the to_dict() form last written to (or
+        # loaded from) disk. _persist compares against it and skips clean
+        # writes; reload/rescan refresh it so external edits invalidate.
+        self._clean: Dict[str, dict] = {}
+        # The marker lists collected by the last rescan snapshot; each
+        # take_*/deletion_markers call consumes its kind once, then falls
+        # back to a fresh glob (standalone callers never see stale lists).
+        self._pass_markers: Optional[dict] = None
+        self._last_sweep = 0.0
+        self.io = StoreIOCounters()
         self.persist_dir = Path(persist_dir) if persist_dir else None
         if self.persist_dir is not None:
             self.persist_dir.mkdir(parents=True, exist_ok=True)
             self._sweep_stale_tmp()
+            self._last_sweep = time.time()
             self._load_all()
 
     # ---- persistence ----
@@ -64,16 +129,27 @@ class JobStore:
         extension: ``ns_job.json``, ``ns_job.json.1234.tmp``, ...)."""
         return fs_to_key(name.split(".", 1)[0])
 
-    def _sweep_stale_tmp(self) -> None:
+    def _sweep_stale_tmp(self, paths=None) -> int:
         """Remove orphaned ``*.tmp`` files left by writers killed between
         tmp-write and rename (pid-unique tmp names never get overwritten,
         so crashes would otherwise accumulate them forever). The age floor
-        keeps in-flight writes of live processes safe."""
+        keeps in-flight writes of live processes safe.
+
+        Runs at load and then periodically (``_maybe_sweep`` off the
+        rescan snapshot) — never on every pass. ``paths`` lets the
+        periodic caller reuse the snapshot instead of re-globbing.
+        Returns the sweep count; each sweep also lands on the event
+        recorder so `tpujob describe`/`events` shows it."""
         cutoff = time.time() - 300.0
-        for p in self.persist_dir.glob("*.tmp"):
+        swept = 0
+        if paths is None:
+            self.io.scans += 1
+            paths = self.persist_dir.glob("*.tmp")
+        for p in paths:
             try:
                 if p.stat().st_mtime < cutoff:
                     p.unlink(missing_ok=True)
+                    swept += 1
                     self._warn(
                         self._key_from_filename(p.name),
                         "StaleTmpSwept",
@@ -82,24 +158,49 @@ class JobStore:
                     )
             except OSError:
                 continue
+        return swept
+
+    def _maybe_sweep(self, tmp_paths) -> None:
+        """Periodic stale-tmp sweep driven by the rescan snapshot (no
+        extra directory scan, no per-pass cost)."""
+        now = time.time()
+        if now - self._last_sweep < SWEEP_INTERVAL_S:
+            return
+        self._last_sweep = now
+        self._sweep_stale_tmp(tmp_paths)
 
     def _path_for(self, key: str) -> Path:
         return self.persist_dir / (key_to_fs(key) + ".json")
 
+    def _load_one(self, p: Path) -> Optional[TPUJob]:
+        """Read + parse one job file, recording the clean form (so a
+        just-loaded job is not rewritten by its first no-op update)."""
+        self.io.reads += 1
+        try:
+            d = json.loads(p.read_text())
+            job = TPUJob.from_dict(d)
+        except (OSError, ValueError, KeyError) as e:
+            # Corrupt state file: skip rather than brick the
+            # supervisor, and leave an inspectable event trail.
+            self._warn(
+                self._key_from_filename(p.name),
+                "CorruptStateFile",
+                f"skipping corrupt state file {p.name}: {e}",
+            )
+            return None
+        key = job_key(job)
+        if key not in self._jobs:
+            # Known keys keep their dirty state: the in-memory object is
+            # authoritative and may have an unwritten change pending.
+            self._clean[key] = job.to_dict()
+        return job
+
     def _load_all(self) -> None:
+        self.io.scans += 1
         for p in sorted(self.persist_dir.glob("*.json")):
-            try:
-                job = TPUJob.from_dict(json.loads(p.read_text()))
-            except (ValueError, KeyError) as e:
-                # Corrupt state file: skip rather than brick the
-                # supervisor, and leave an inspectable event trail.
-                self._warn(
-                    self._key_from_filename(p.name),
-                    "CorruptStateFile",
-                    f"skipping corrupt state file {p.name}: {e}",
-                )
-                continue
-            self._jobs[job_key(job)] = job
+            job = self._load_one(p)
+            if job is not None:
+                self._jobs[job_key(job)] = job
 
     def _persist(self, key: str) -> None:
         if self.persist_dir is None:
@@ -107,9 +208,17 @@ class JobStore:
         job = self._jobs.get(key)
         path = self._path_for(key)
         if job is None:
+            self._clean.pop(key, None)
             path.unlink(missing_ok=True)
         else:
-            text = json.dumps(job.to_dict(), indent=2)
+            d = job.to_dict()
+            if self._cache_enabled and d == self._clean.get(key):
+                # Dirty tracking: the serialized form is unchanged, so the
+                # file on disk (which we wrote) is already current — an
+                # idle job costs zero write I/O per pass.
+                self.io.writes_skipped += 1
+                return
+            text = json.dumps(d, indent=2)
             from .. import faults
 
             inj = faults.active()
@@ -118,12 +227,16 @@ class JobStore:
                 # PATH (bypassing the tmp+rename discipline — that
                 # discipline is exactly what a kernel-level tear defeats)
                 # so the next cross-process reader exercises the
-                # corrupt-state-file recovery path above.
+                # corrupt-state-file recovery path above. The clean form
+                # is NOT recorded: the next persist must rewrite.
                 path.write_text(text[: len(text) // 2])
+                self.io.writes += 1
                 return
             tmp = path.with_suffix(".json.tmp")
             tmp.write_text(text)
             tmp.replace(path)
+            self.io.writes += 1
+            self._clean[key] = d
 
     # ---- CRUD ----
 
@@ -172,21 +285,63 @@ class JobStore:
 
         In-memory objects stay authoritative — this process writes them —
         so only unknown keys are loaded. Returns newly discovered keys.
+
+        One ``scandir`` snapshot per call: known job keys are recognized
+        by FILENAME (key_to_fs is bijective) and never re-read; the same
+        snapshot collects the pass's marker files for the subsequent
+        ``deletion_markers``/``take_*_markers`` calls and feeds the
+        periodic stale-tmp sweep. With ``cache=False`` every job file is
+        re-parsed (the pre-cache behavior, kept for the bench baseline).
         """
         if self.persist_dir is None:
             return []
         new_keys: List[str] = []
+        markers = {kind: [] for kind in _MARKER_KINDS}
+        tmp_paths: List[Path] = []
         with self._lock:
-            for p in sorted(self.persist_dir.glob("*.json")):
-                try:
-                    job = TPUJob.from_dict(json.loads(p.read_text()))
-                except (ValueError, KeyError):
-                    continue
-                key = job_key(job)
-                if key not in self._jobs:
-                    self._jobs[key] = job
-                    new_keys.append(key)
+            self.io.scans += 1
+            try:
+                entries = sorted(
+                    ((e.name, e.path) for e in os.scandir(self.persist_dir)),
+                )
+            except OSError:
+                return []
+            for name, epath in entries:
+                if name.endswith(".json"):
+                    if (
+                        self._cache_enabled
+                        and self._key_from_filename(name) in self._jobs
+                    ):
+                        continue
+                    job = self._load_one(Path(epath))
+                    if job is None:
+                        continue
+                    key = job_key(job)
+                    if key not in self._jobs:
+                        self._jobs[key] = job
+                        new_keys.append(key)
+                elif name.endswith(".tmp"):
+                    tmp_paths.append(Path(epath))
+                else:
+                    kind = name.rsplit(".", 1)[-1]
+                    if kind in markers:
+                        markers[kind].append(Path(epath))
+            if self._cache_enabled:
+                self._pass_markers = markers
+        self._maybe_sweep(tmp_paths)
         return new_keys
+
+    def _marker_candidates(self, kind: str) -> List[Path]:
+        """Marker files of one kind: the rescan snapshot's list when one
+        is armed (consumed — at most once per pass), else a fresh glob.
+        Claim-by-rename downstream keeps consumption exactly-once even
+        when a snapshot raced another supervisor."""
+        with self._lock:
+            pm = self._pass_markers
+            if pm is not None and pm.get(kind) is not None:
+                return pm.pop(kind)
+        self.io.scans += 1
+        return sorted(self.persist_dir.glob("*." + kind))
 
     def reload(self, key: str) -> Optional[TPUJob]:
         """Re-read one job's record from disk, replacing the cached object.
@@ -200,14 +355,21 @@ class JobStore:
             return self.get(key)
         p = self.persist_dir / (key_to_fs(key) + ".json")
         with self._lock:
+            self.io.reads += 1
             try:
                 job = TPUJob.from_dict(json.loads(p.read_text()))
             except OSError:
                 self._jobs.pop(key, None)
+                self._clean.pop(key, None)
                 return None
             except (ValueError, KeyError):
                 return self._jobs.get(key)
             self._jobs[key] = job
+            # The disk form is now the cached object: refresh the clean
+            # snapshot so dirty tracking compares against what is REALLY
+            # on disk (an external edit must not be masked by a stale
+            # clean form from before the edit).
+            self._clean[key] = job.to_dict()
             return job
 
     def _marker_path(self, key: str, kind: str) -> Path:
@@ -238,15 +400,13 @@ class JobStore:
         """Keys with a pending cross-process deletion request."""
         if self.persist_dir is None:
             return []
-        keys = []
-        for p in self.persist_dir.glob("*.delete"):
-            keys.append(fs_to_key(p.stem))
-        return keys
+        return [fs_to_key(p.stem) for p in self._marker_candidates("delete")]
 
     def _read_deletion_marker(self, key: str) -> dict:
         if self.persist_dir is None:
             return {}
         p = self._marker_path(key, "delete")
+        self.io.reads += 1
         try:
             content = p.read_text()
         except OSError:
@@ -307,12 +467,13 @@ class JobStore:
         import json as _json
 
         out = []
-        for p in sorted(self.persist_dir.glob("*.apply")):
+        for p in self._marker_candidates("apply"):
             claimed = p.with_name(p.name + "-claimed")
             try:
                 p.rename(claimed)
             except OSError:
                 continue
+            self.io.reads += 1
             try:
                 job_dict = _json.loads(claimed.read_text())
             except (OSError, ValueError):
@@ -339,12 +500,13 @@ class JobStore:
         if self.persist_dir is None:
             return []
         out = []
-        for p in sorted(self.persist_dir.glob("*.suspend")):
+        for p in self._marker_candidates("suspend"):
             claimed = p.with_name(p.name + "-claimed")
             try:
                 p.rename(claimed)
             except OSError:
                 continue
+            self.io.reads += 1
             try:
                 content = claimed.read_text().strip()
             except OSError:
@@ -376,12 +538,13 @@ class JobStore:
         if self.persist_dir is None:
             return []
         out = []
-        for p in sorted(self.persist_dir.glob("*.scale")):
+        for p in self._marker_candidates("scale"):
             claimed = p.with_name(p.name + "-claimed")
             try:
                 p.rename(claimed)
             except OSError:
                 continue  # another supervisor claimed it first
+            self.io.reads += 1
             try:
                 workers = int(claimed.read_text().strip())
             except (OSError, ValueError):

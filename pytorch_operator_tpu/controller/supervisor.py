@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import os
 import socket
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import List, Optional
 
@@ -26,8 +28,9 @@ from .expectations import ControllerExpectations
 from .gang import GangScheduler
 from .leases import LeaderLease
 from .metrics import MetricsRegistry
+from .progress import ProgressTailer
 from .reconciler import Reconciler
-from .runner import ProcessRunner, SubprocessRunner
+from .runner import ProcessRunner, SubprocessRunner, replica_name
 from .store import JobStore, job_key, purge_job_artifacts
 
 
@@ -54,6 +57,9 @@ class Supervisor:
         queue_slots: Optional[dict] = None,
         preempt: bool = False,
         standby: int = 0,
+        parallel_sync: bool = True,
+        sync_workers: Optional[int] = None,
+        cached_store: bool = True,
     ):
         self.state_dir = Path(state_dir) if state_dir is not None else default_state_dir()
         self.state_dir.mkdir(parents=True, exist_ok=True)
@@ -66,10 +72,24 @@ class Supervisor:
         # state files skipped at load, stale tmp sweeps) land on the
         # event surface `tpujob describe` reads.
         self.events = EventRecorder(sink_dir=self.state_dir / "events")
+        # cached_store=False reproduces the pre-cache store I/O profile —
+        # only the control-plane bench should ever ask for it.
         self.store = JobStore(
             persist_dir=self.state_dir / "jobs" if persist else None,
             events=self.events,
+            cache=cached_store,
         )
+        # Parallel reconcile phase (reference: controller.Run(threadiness)
+        # — the workqueue's N workers): steady-state jobs sync on a small
+        # thread pool; scheduling decisions stay serial (see sync_once).
+        self.parallel_sync = parallel_sync
+        self._sync_workers = sync_workers or min(8, os.cpu_count() or 2)
+        self._sync_pool = None
+        self._sync_pool_lock = threading.Lock()
+        # Incremental heartbeat reader for the per-job training gauges:
+        # remembers a byte offset per replica status file, so an idle
+        # pass costs one directory scan per job and zero reads.
+        self._progress = ProgressTailer()
         # Supervisor pass counter for the fault-injection pass hook
         # (kill_replica faults schedule against it).
         self._fault_pass = 0
@@ -301,10 +321,18 @@ class Supervisor:
     def sync_once(self, now: Optional[float] = None) -> bool:
         """One pass over all jobs; returns True if any job still active.
 
-        Jobs sync in priority order (higher ``scheduling_policy.priority``
-        first, FIFO by submit time within a class — the volcano
-        priorityClass analog), so under capacity pressure high-priority
-        gangs claim free slots before lower ones.
+        The pass is split in two phases. The SERIAL phase syncs — in
+        priority order (higher ``scheduling_policy.priority`` first, FIFO
+        by submit time within a class, the volcano priorityClass analog) —
+        every job whose sync may claim capacity or touch the pass-scoped
+        scheduling state (missing replicas, pending restarts/completions,
+        elastic jobs, suspend transitions), so under capacity pressure
+        high-priority gangs still claim free slots before lower ones. The
+        PARALLEL phase fans the remaining steady-state jobs (world
+        complete and live — the overwhelming majority at fleet scale)
+        across a bounded thread pool; the per-key reconcile locks keep
+        each job serialized with CLI-driven mutations. Process liveness is
+        polled ONCE for the whole pass (runner.sync), not once per job.
         """
         now = time.time() if now is None else now
         self._inject_pass_faults()
@@ -321,23 +349,86 @@ class Supervisor:
                 kj[1].status.submit_time or 0.0,
             )
         )
+        # One batched liveness poll for the whole pass, BEFORE the phase
+        # split (the partition reads the freshly observed phases).
+        self.runner.sync()
         # Reset the pass-scoped scheduling state (priority reservations,
         # queue-usage cache) before admitting in priority order; close the
         # pass afterwards so solo syncs never see its stale state.
         self.reconciler.begin_pass()
         try:
+            steady: List[str] = []
             for key, job in jobs:
                 if job.is_finished():
                     self._gc_ttl(job, key, now)
                     continue
-                if self.reconciler.sync(key, now=now):
-                    any_active = True
+                if not self.parallel_sync or self._needs_scheduling(key, job):
+                    if self.reconciler.sync(key, now=now):
+                        any_active = True
+                else:
+                    steady.append(key)
+            if steady:
+                for active in self._sync_parallel(steady, now):
+                    any_active = any_active or active
             if self.preempt_enabled:
                 self._maybe_preempt(jobs, now)
         finally:
             queue_usage = self.reconciler.end_pass()
         self._update_gauges(jobs, queue_usage)
         return any_active
+
+    def _needs_scheduling(self, key: str, job: TPUJob) -> bool:
+        """Must this job sync in the serial scheduling phase? True when
+        its sync may create replicas, claim capacity, or read/write the
+        pass-scoped reservation state — anything whose correctness
+        depends on priority ordering within the pass."""
+        if job.spec.elastic_policy is not None:
+            return True  # grow-back reads reservations/queue budgets
+        if job.get_condition(ConditionType.CREATED) is None:
+            return True  # first sync: creation + status-dir reset
+        if job.spec.run_policy.suspend or job.has_condition(
+            ConditionType.SUSPENDED
+        ):
+            return True  # teardown / resume-relaunch transitions
+        if not self.expectations.satisfied(key):
+            return True
+        handles = {h.name: h for h in self.runner.list_for_job(key)}
+        for rtype, rs in job.spec.replica_specs.items():
+            for index in range(rs.replicas or 0):
+                h = handles.get(replica_name(key, rtype, index))
+                if h is None or h.is_finished():
+                    # Missing replica (admission) or a finished one
+                    # (restart classification / job completion).
+                    return True
+        return False
+
+    def _sync_parallel(self, keys: List[str], now: float) -> List[bool]:
+        """Fan steady-state reconciles across the bounded pool, in chunks
+        so pool overhead stays O(workers), not O(jobs). Exceptions
+        propagate like the serial loop's (first one wins)."""
+        if len(keys) <= 1 or self._sync_workers <= 1:
+            return [self.reconciler.sync(k, now=now) for k in keys]
+        with self._sync_pool_lock:
+            if self._sync_pool is None:
+                self._sync_pool = ThreadPoolExecutor(
+                    max_workers=self._sync_workers,
+                    thread_name_prefix="tpujob-sync",
+                )
+            pool = self._sync_pool
+
+        def run_chunk(chunk: List[str]) -> List[bool]:
+            return [self.reconciler.sync(k, now=now) for k in chunk]
+
+        n_chunks = min(len(keys), 2 * self._sync_workers)
+        step = (len(keys) + n_chunks - 1) // n_chunks
+        futures = [
+            pool.submit(run_chunk, keys[i : i + step])
+            for i in range(0, len(keys), step)
+        ]
+        out: List[bool] = []
+        for f in futures:
+            out.extend(f.result())
+        return out
 
     def _inject_pass_faults(self) -> None:
         """The per-pass fault-injection hook: when a plan is armed
@@ -386,9 +477,8 @@ class Supervisor:
         (controller/progress.py) into the per-job training gauges — the
         SURVEY §5 "steps/sec + images/sec/chip meters" on /metrics.
         Cleared-and-rebuilt per pass so finished/deleted jobs don't
-        linger as stale series; tail-reads keep the cost O(1) per job."""
-        from .progress import read_latest_progress
-
+        linger as stale series; the incremental tailer reads only bytes
+        appended since the last pass (an idle job costs zero reads)."""
         m = self.metrics
         g_step, g_sps, g_tp, g_loss, g_age = (
             m.job_step, m.job_steps_per_sec, m.job_throughput, m.job_loss,
@@ -404,7 +494,7 @@ class Supervisor:
         for key, job in jobs:
             if job.is_finished():
                 continue
-            rec = read_latest_progress(job_status_dir(root, key))
+            rec = self._progress.latest(job_status_dir(root, key))
             if rec is None:
                 continue
             if rec.get("step") is not None:
@@ -591,6 +681,10 @@ class Supervisor:
         (self.state_dir / "metrics.prom").write_text(self.metrics.render_text())
 
     def shutdown(self) -> None:
+        with self._sync_pool_lock:
+            pool, self._sync_pool = self._sync_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
         if isinstance(self.runner, SubprocessRunner):
             self.runner.shutdown()
         if self.lease is not None:

@@ -193,3 +193,62 @@ class FaultPlan:
         return f"seed={self.seed} " + ", ".join(
             f.label() for f in self.faults
         )
+
+
+# Fault kinds whose ``target`` names a JOB KEY (or ``*``), not a replica.
+JOB_TARGET_KINDS = frozenset({"torn_state_write"})
+
+# Fault kinds whose target is ignored by the injection site (the serving
+# engine has no replica identity at the step hook).
+UNTARGETED_KINDS = frozenset({"fail_engine_step"})
+
+
+def validate_against_job(plan: "FaultPlan", job) -> List[str]:
+    """Lint a plan against a TPUJob spec: a fault whose ``target``
+    matches no replica the spec can ever run will silently never fire —
+    almost always a typo (``worker-3`` on a 2-worker job, ``Master-0``
+    instead of ``master-0``). Returns human-readable warnings; an empty
+    list means every fault can address something.
+
+    Replica-shaped targets are checked against every ``<type>-<index>``
+    the spec declares (elastic jobs are checked up to
+    ``max_replicas``); job-scoped kinds are checked against the job key.
+    Warnings, not errors: the same plan may be aimed at several jobs.
+    """
+    from .injector import FaultInjector
+
+    key = f"{job.metadata.namespace or 'default'}/{job.metadata.name}"
+    replica_ids: List[tuple] = []
+    for rtype, rs in job.spec.replica_specs.items():
+        count = rs.replicas or 0
+        if (
+            job.spec.elastic_policy is not None
+            and rtype.value.lower() == "worker"
+        ):
+            count = max(count, job.spec.elastic_policy.max_replicas)
+        for index in range(count):
+            replica_ids.append((rtype.value, index))
+    warnings: List[str] = []
+    for f in plan.faults:
+        if f.kind in UNTARGETED_KINDS or f.target == "*":
+            continue
+        if f.kind in JOB_TARGET_KINDS:
+            if f.target != key:
+                warnings.append(
+                    f"{f.label()}: target {f.target!r} does not match job "
+                    f"{key!r}; this fault will never fire."
+                )
+            continue
+        if not any(
+            FaultInjector.target_matches(f.target, rtype, index)
+            for rtype, index in replica_ids
+        ):
+            have = ", ".join(
+                f"{rt.lower()}-{i}" for rt, i in replica_ids[:8]
+            ) or "<no replicas>"
+            warnings.append(
+                f"{f.label()}: target {f.target!r} matches no replica of "
+                f"{key} (spec declares: {have}); this fault will never "
+                "fire."
+            )
+    return warnings

@@ -1,0 +1,277 @@
+"""Control-plane benchmark: supervisor pass latency + store I/O at scale.
+
+The reference operator scales because informer caches and a workqueue
+keep reconciles off the API server's hot path; this repo's file-backed
+analog must prove the same property with numbers. This bench drives N
+synthetic jobs (FakeRunner — no TPU, no subprocesses; pure control
+plane) through the full submit → run → finish churn, then measures the
+steady-state "idle pass" — every job RUNNING, nothing to reconcile —
+which is what a daemon supervising a large fleet spends its life doing.
+
+Two store modes run in the SAME harness:
+
+- ``cached``  — the production path: dirty-tracking persistence, one
+  scandir snapshot per pass, parallel steady-phase reconciles.
+- ``legacy``  — ``JobStore(cache=False)`` + serial pass: the pre-cache
+  behavior (every rescan re-reads every job file, every persist
+  rewrites, one glob per marker kind), kept in-tree precisely so this
+  comparison stays honest as the code moves.
+
+Each pass runs the daemon loop body (rescan + the four marker scans +
+sync_once), so the numbers measure what ``tpujob supervisor`` actually
+pays. Emitted artifact (``BENCH_ctrlplane.json``): per N and mode,
+pass-latency p50/p99 (ms) and per-pass store I/O (reads/writes/scans),
+plus churn throughput and cached-vs-legacy ratios.
+
+Usage:
+    python -m pytorch_operator_tpu.workloads.ctrlplane_bench \
+        [--jobs 10,100,1000] [--passes 30] [--out BENCH_ctrlplane.json]
+    tpujob bench-control-plane ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+
+def _percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, round(q * (len(xs) - 1))))
+    return xs[idx]
+
+
+def _make_job(i: int):
+    from ..api.types import (
+        ObjectMeta,
+        ProcessTemplate,
+        ReplicaSpec,
+        ReplicaType,
+        RestartPolicy,
+        TPUJob,
+        TPUJobSpec,
+    )
+
+    return TPUJob(
+        metadata=ObjectMeta(name=f"bench-{i:05d}"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.MASTER: ReplicaSpec(
+                    replicas=1,
+                    restart_policy=RestartPolicy.ON_FAILURE,
+                    template=ProcessTemplate(
+                        module="pytorch_operator_tpu.workloads.noop"
+                    ),
+                ),
+            },
+        ),
+    )
+
+
+def _io_delta(store, before: Dict[str, int]) -> Dict[str, int]:
+    after = store.io.snapshot()
+    return {k: after[k] - before[k] for k in after}
+
+
+def bench_mode(
+    n_jobs: int,
+    mode: str,
+    passes: int,
+    state_dir: Path,
+    log=print,
+) -> dict:
+    """One (N, mode) cell: build a supervisor, churn N jobs to RUNNING,
+    measure idle passes, then finish everything and measure the drain."""
+    from ..api.types import ReplicaPhase
+    from ..controller.runner import FakeRunner
+    from ..controller.supervisor import Supervisor
+
+    cached = mode == "cached"
+    sup = Supervisor(
+        state_dir=state_dir,
+        runner=FakeRunner(),
+        persist=True,
+        cached_store=cached,
+        parallel_sync=cached,
+    )
+
+    def daemon_pass() -> None:
+        # The tpujob-supervisor loop body, minus the sleep.
+        sup.store.rescan()
+        sup.process_deletion_markers()
+        sup.process_scale_markers()
+        sup.process_suspend_markers()
+        sup.process_apply_markers()
+        sup.sync_once()
+
+    try:
+        # ---- submit + launch churn ----
+        t0 = time.perf_counter()
+        for i in range(n_jobs):
+            sup.submit(_make_job(i))
+        submit_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        daemon_pass()  # creates every world
+        launch_pass_s = time.perf_counter() - t0
+        for h in sup.runner.list_all():
+            if h.phase == ReplicaPhase.PENDING:
+                sup.runner.set_phase(h.name, ReplicaPhase.RUNNING)
+        daemon_pass()  # observes RUNNING, sets conditions
+
+        # ---- steady-state idle passes (the headline) ----
+        latencies_ms: List[float] = []
+        io_per_pass: List[Dict[str, int]] = []
+        for _ in range(passes):
+            before = sup.store.io.snapshot()
+            t0 = time.perf_counter()
+            daemon_pass()
+            latencies_ms.append(1000 * (time.perf_counter() - t0))
+            io_per_pass.append(_io_delta(sup.store, before))
+
+        # ---- finish churn: every master succeeds, jobs complete ----
+        for h in sup.runner.list_all():
+            sup.runner.set_phase(h.name, ReplicaPhase.SUCCEEDED, exit_code=0)
+        t0 = time.perf_counter()
+        daemon_pass()
+        finish_pass_s = time.perf_counter() - t0
+        unfinished = sum(1 for j in sup.list_jobs() if not j.is_finished())
+
+        idle_reads = statistics.mean(p["reads"] for p in io_per_pass)
+        idle_writes = statistics.mean(p["writes"] for p in io_per_pass)
+        idle_scans = statistics.mean(p["scans"] for p in io_per_pass)
+        result = {
+            "mode": mode,
+            "jobs": n_jobs,
+            "passes": passes,
+            "pass_ms_p50": round(_percentile(latencies_ms, 0.50), 3),
+            "pass_ms_p99": round(_percentile(latencies_ms, 0.99), 3),
+            "pass_ms_mean": round(statistics.mean(latencies_ms), 3),
+            "idle_reads_per_pass": round(idle_reads, 2),
+            "idle_writes_per_pass": round(idle_writes, 2),
+            "idle_scans_per_pass": round(idle_scans, 2),
+            "submit_s": round(submit_s, 3),
+            "launch_pass_s": round(launch_pass_s, 3),
+            "finish_pass_s": round(finish_pass_s, 3),
+            "unfinished_after_drain": unfinished,
+        }
+        log(
+            f"[ctrlplane] N={n_jobs:5d} {mode:6s} "
+            f"pass p50={result['pass_ms_p50']:9.3f}ms "
+            f"p99={result['pass_ms_p99']:9.3f}ms "
+            f"idle reads/pass={idle_reads:8.1f} "
+            f"writes/pass={idle_writes:8.1f}"
+        )
+        return result
+    finally:
+        sup.shutdown()
+
+
+def run(
+    jobs: Optional[List[int]] = None,
+    passes: int = 30,
+    out: Optional[str] = None,
+    work_dir: Optional[str] = None,
+    log=print,
+) -> dict:
+    jobs = jobs or [10, 100, 1000]
+    cells: List[dict] = []
+    for n in jobs:
+        # Fewer legacy passes at large N: each one rewrites every job
+        # file; the distribution is tight, no need to burn minutes.
+        legacy_passes = min(passes, 10) if n >= 1000 else passes
+        for mode, n_passes in (("legacy", legacy_passes), ("cached", passes)):
+            with tempfile.TemporaryDirectory(
+                prefix=f"ctrlplane-{mode}-{n}-", dir=work_dir
+            ) as td:
+                cells.append(
+                    bench_mode(n, mode, n_passes, Path(td), log=log)
+                )
+
+    by = {(c["jobs"], c["mode"]): c for c in cells}
+    comparisons = []
+    for n in jobs:
+        legacy, cached = by.get((n, "legacy")), by.get((n, "cached"))
+        if not legacy or not cached:
+            continue
+        comparisons.append(
+            {
+                "jobs": n,
+                "pass_p50_speedup": round(
+                    legacy["pass_ms_p50"] / max(cached["pass_ms_p50"], 1e-9), 2
+                ),
+                "pass_p99_speedup": round(
+                    legacy["pass_ms_p99"] / max(cached["pass_ms_p99"], 1e-9), 2
+                ),
+                "idle_read_reduction": round(
+                    legacy["idle_reads_per_pass"]
+                    / max(cached["idle_reads_per_pass"], 1.0),
+                    2,
+                ),
+                "idle_write_reduction": round(
+                    legacy["idle_writes_per_pass"]
+                    / max(cached["idle_writes_per_pass"], 1.0),
+                    2,
+                ),
+            }
+        )
+    result = {
+        "bench": "control_plane",
+        "metric": "supervisor_pass_latency_ms",
+        "protocol": (
+            "N synthetic single-replica jobs on FakeRunner; full daemon "
+            "loop body per pass (rescan + 4 marker scans + sync_once); "
+            "idle = all jobs Running, no transitions. legacy = "
+            "JobStore(cache=False) + serial pass (pre-cache behavior); "
+            "cached = dirty-tracking store + scandir snapshot + parallel "
+            "steady phase."
+        ),
+        "cells": cells,
+        "comparisons": comparisons,
+    }
+    if out:
+        Path(out).write_text(json.dumps(result, indent=2) + "\n")
+        log(f"[ctrlplane] wrote {out}")
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--jobs",
+        default="10,100,1000",
+        help="comma-separated fleet sizes to measure",
+    )
+    p.add_argument(
+        "--passes", type=int, default=30, help="idle passes per cell"
+    )
+    p.add_argument("--out", default=None, help="artifact path (JSON)")
+    p.add_argument(
+        "--work-dir",
+        default=None,
+        help="where the throwaway state dirs live (default: system tmp)",
+    )
+    args = p.parse_args(argv)
+    try:
+        jobs = [int(x) for x in args.jobs.split(",") if x.strip()]
+    except ValueError:
+        print(f"--jobs must be comma-separated ints: {args.jobs!r}",
+              file=sys.stderr)
+        return 2
+    result = run(
+        jobs=jobs, passes=args.passes, out=args.out, work_dir=args.work_dir
+    )
+    print(json.dumps({"comparisons": result["comparisons"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,132 @@
+"""Chaos scenario: ``fail_engine_step`` through a full spool round trip.
+
+ROADMAP open item, scripted end-to-end: a REAL serve job (subprocess,
+jax on CPU) runs under ``tpujob chaos`` with a ``fail_engine_step``
+fault riding in via the env-threaded plan. A client drives the file
+spool exactly like ``tpujob serve-request`` while the engine takes the
+injected iteration fault mid-service. The contract under test is the
+serve loop's failure-path hardening at the SERVICE boundary:
+
+- the faulted iteration's in-flight requests get an error response
+  (nobody blocks a timeout on a reply nothing will write),
+- every submitted request gets EXACTLY ONE response,
+- the engine keeps serving — later requests complete normally,
+- no claims are stranded in the spool, and the job itself finishes
+  Succeeded with zero restarts (an engine fault is not a crash).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from pytorch_operator_tpu.serving import Spool
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+SERVE_JOB = """\
+api_version: tpujob.dev/v1
+kind: TPUJob
+metadata:
+  name: chaos-serve
+spec:
+  replica_specs:
+    Master:
+      replicas: 1
+      restart_policy: OnFailure
+      template:
+        module: pytorch_operator_tpu.workloads.serve
+        args: ["--spool", "{spool}", "--config", "tiny", "--slots", "2",
+               "--chunk", "16", "--block", "4", "--max-decode-len", "128",
+               "--max-requests", "3", "--idle-timeout", "120",
+               "--report-every", "1"]
+  run_policy:
+    backoff_limit: 2
+"""
+
+SERVE_PLAN = """\
+seed: 11
+faults:
+  - {kind: fail_engine_step, nth: 2}
+"""
+
+
+def test_fail_engine_step_full_spool_round_trip(tmp_path):
+    from pytorch_operator_tpu.client import cli
+
+    spool_dir = tmp_path / "spool"
+    state = tmp_path / "state"
+    job = tmp_path / "serve.yaml"
+    job.write_text(SERVE_JOB.format(spool=spool_dir))
+    plan = tmp_path / "plan.yaml"
+    plan.write_text(SERVE_PLAN)
+
+    result = {}
+
+    def run_chaos():
+        result["rc"] = cli.main(
+            [
+                "--state-dir", str(state),
+                "chaos", str(job),
+                "--plan", str(plan),
+                "--timeout", "600",
+            ]
+        )
+
+    supervisor = threading.Thread(target=run_chaos)
+    supervisor.start()
+    try:
+        # Client half of the service: keep submitting until THREE
+        # requests completed successfully (--max-requests 3 then ends
+        # the serve job). The injected fault costs some in-flight
+        # request an error response along the way; the client retries —
+        # exactly what a production spool client does.
+        spool = Spool(spool_dir)
+        responses = []
+        successes = 0
+        for _ in range(12):  # 3 successes + fault casualties, bounded
+            # 16 tokens at block=4 → each request spans several engine
+            # iterations, so the nth=2 fault always catches a request
+            # IN FLIGHT (a one-block request would finish inside its
+            # admission step and the fault would abort an empty batch).
+            rid = spool.submit(prompt_len=6, max_new_tokens=16)
+            resp = spool.wait_response(rid, timeout=420)
+            assert resp["id"] == rid
+            responses.append((rid, resp))
+            if "error" not in resp:
+                successes += 1
+                assert len(resp["tokens"]) >= 1
+                assert resp["ttft_ms"] >= 0
+            if successes >= 3:
+                break
+        assert successes == 3, responses
+    finally:
+        supervisor.join(timeout=600)
+    assert not supervisor.is_alive(), "chaos run did not finish"
+    assert result["rc"] == 0
+
+    # Exactly-once: one response file per submitted request, none extra.
+    ids = [rid for rid, _ in responses]
+    assert len(set(ids)) == len(ids)
+    response_files = {p.stem for p in (spool_dir / "responses").glob("*.json")}
+    assert response_files == set(ids)
+    # The injected fault surfaced as an error response on some request.
+    errors = [r for _, r in responses if "error" in r]
+    assert len(errors) == 1, responses
+    assert "engine fault" in errors[0]["error"]
+    # Recovery: a SUCCESSFUL response arrived after the faulted one —
+    # the engine kept serving through the casualty.
+    error_idx = next(i for i, (_, r) in enumerate(responses) if "error" in r)
+    assert any("error" not in r for _, r in responses[error_idx + 1 :])
+    # No stranded claims: the engine finished its drain cleanly.
+    assert list((spool_dir / "claimed").glob("*.json")) == []
+    assert list((spool_dir / "requests").glob("*.json")) == []
+
+    # The supervisor saw a healthy job end-to-end: Succeeded, zero
+    # restarts (the fault was absorbed by the serve loop, not a crash),
+    # and the failure forensics are in the replica log.
+    log = next((state / "logs").glob("*chaos-serve*master-0.log")).read_text()
+    assert "engine step fault" in log
+    assert "aborted" in log

@@ -1,0 +1,72 @@
+"""Control-plane bench smoke lane (``-m bench_smoke``, also tier-1).
+
+Runs the real harness at N=10 with few passes — small enough for the
+tier-1 time budget, real enough to catch hot-path regressions: a change
+that reintroduces per-pass re-reads, per-pass rewrites of idle jobs, or
+per-job directory globs shows up here as nonzero idle I/O, long before
+anyone reruns the full N=1000 artifact.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pytorch_operator_tpu.workloads import ctrlplane_bench
+
+pytestmark = pytest.mark.bench_smoke
+
+
+@pytest.fixture(scope="module")
+def smoke_result(tmp_path_factory):
+    td = tmp_path_factory.mktemp("ctrlplane")
+    return ctrlplane_bench.run(
+        jobs=[10], passes=5, work_dir=str(td), log=lambda *_: None
+    )
+
+
+def cell(result, mode):
+    return next(c for c in result["cells"] if c["mode"] == mode)
+
+
+class TestBenchSmoke:
+    def test_cached_idle_pass_does_zero_job_file_io(self, smoke_result):
+        cached = cell(smoke_result, "cached")
+        # THE hot-path guard: an idle pass over a cached store must not
+        # read or write a single job file. Any regression that puts
+        # file I/O back on the steady-state path trips this.
+        assert cached["idle_reads_per_pass"] == 0
+        assert cached["idle_writes_per_pass"] == 0
+        # One scandir snapshot serves rescan + all marker scans.
+        assert cached["idle_scans_per_pass"] <= 1.0
+
+    def test_legacy_mode_still_measures_the_old_profile(self, smoke_result):
+        legacy = cell(smoke_result, "legacy")
+        # The baseline must stay honest: N reads and N writes per idle
+        # pass (one per job), plus the per-kind marker globs — otherwise
+        # the artifact's comparison silently measures nothing.
+        assert legacy["idle_reads_per_pass"] == 10
+        assert legacy["idle_writes_per_pass"] == 10
+        assert legacy["idle_scans_per_pass"] >= 5
+
+    def test_churn_completes_all_jobs_in_both_modes(self, smoke_result):
+        for mode in ("cached", "legacy"):
+            assert cell(smoke_result, mode)["unfinished_after_drain"] == 0
+
+    def test_artifact_shape_is_committed_schema(self, smoke_result, tmp_path):
+        out = tmp_path / "bench.json"
+        ctrlplane_bench.run(
+            jobs=[10], passes=2, out=str(out),
+            work_dir=str(tmp_path), log=lambda *_: None,
+        )
+        data = json.loads(out.read_text())
+        assert data["bench"] == "control_plane"
+        assert data["comparisons"][0]["jobs"] == 10
+        for field in (
+            "pass_p50_speedup",
+            "pass_p99_speedup",
+            "idle_read_reduction",
+            "idle_write_reduction",
+        ):
+            assert field in data["comparisons"][0]

@@ -112,6 +112,96 @@ class TestFaultPlan:
         assert "kill_replica" in plan.summary()
 
 
+class TestPlanValidation:
+    """Plan lint against a job spec: targets that can never match warn."""
+
+    def _validate(self, plan, job):
+        from pytorch_operator_tpu.faults.plan import validate_against_job
+
+        return validate_against_job(plan, job)
+
+    def test_matching_targets_produce_no_warnings(self):
+        plan = FaultPlan(
+            faults=[
+                Fault(kind="crash_at_step", target="worker-1", at=3),
+                Fault(kind="kill_replica", target="master-*", at=2),
+                Fault(kind="stall_rendezvous", target="*"),
+            ]
+        )
+        assert self._validate(plan, new_job(workers=2)) == []
+
+    def test_out_of_range_index_warns(self):
+        plan = FaultPlan(
+            faults=[Fault(kind="crash_at_step", target="worker-3", at=1)]
+        )
+        warnings = self._validate(plan, new_job(workers=2))
+        assert len(warnings) == 1
+        assert "worker-3" in warnings[0]
+        assert "never fire" in warnings[0]
+
+    def test_wrong_type_name_warns(self):
+        plan = FaultPlan(
+            faults=[Fault(kind="kill_replica", target="wrker-0", at=1)]
+        )
+        assert len(self._validate(plan, new_job(workers=1))) == 1
+
+    def test_elastic_targets_validated_to_max_replicas(self):
+        from pytorch_operator_tpu.api.types import ElasticPolicy
+
+        job = new_job(
+            workers=1,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=4),
+        )
+        plan = FaultPlan(
+            faults=[Fault(kind="kill_replica", target="worker-3", at=1)]
+        )
+        # worker-3 may exist after an elastic grow: not a lint error.
+        assert self._validate(plan, job) == []
+        plan_bad = FaultPlan(
+            faults=[Fault(kind="kill_replica", target="worker-4", at=1)]
+        )
+        assert len(self._validate(plan_bad, job)) == 1
+
+    def test_job_scoped_target_checked_against_key(self):
+        job = new_job(name="torny")
+        ok = FaultPlan(
+            faults=[Fault(kind="torn_state_write", target="default/torny")]
+        )
+        assert self._validate(ok, job) == []
+        bad = FaultPlan(
+            faults=[Fault(kind="torn_state_write", target="default/other")]
+        )
+        assert len(self._validate(bad, job)) == 1
+
+    def test_untargeted_kinds_never_warn(self):
+        plan = FaultPlan(
+            faults=[Fault(kind="fail_engine_step", target="anything", nth=2)]
+        )
+        assert self._validate(plan, new_job(workers=0)) == []
+
+    def test_chaos_cli_prints_the_warning(self, tmp_path, capsys):
+        """`tpujob chaos` surfaces the lint on stderr before running."""
+        from pytorch_operator_tpu.client import cli
+
+        job = tmp_path / "job.yaml"
+        job.write_text(CHAOS_JOB)
+        plan = tmp_path / "plan.yaml"
+        plan.write_text(
+            "faults:\n  - {kind: crash_at_step, target: worker-9, at: 1}\n"
+        )
+        rc = cli.main(
+            [
+                "--state-dir", str(tmp_path / "state"),
+                "chaos", str(job),
+                "--plan", str(plan),
+                "--timeout", "60",
+            ]
+        )
+        err = capsys.readouterr().err
+        assert "warning: fault plan" in err and "worker-9" in err
+        assert rc == 0  # lint warns; the run itself proceeds
+
+
 # ---- injector semantics ----
 
 
@@ -258,9 +348,22 @@ class TestControllerSites:
         old = time.time() - 3600
         os.utime(stale, (old, old))
         events = EventRecorder()
-        JobStore(persist_dir=persist, events=events)
+        store = JobStore(persist_dir=persist, events=events)
         assert not stale.exists()
         assert "StaleTmpSwept" in reasons(events, "default/old")
+        # Off the every-pass path: a tmp file appearing later is NOT
+        # swept by the next rescan (the periodic interval gates it)...
+        late = persist / "default_late.json.99.tmp"
+        late.write_text("{")
+        os.utime(late, (old, old))
+        store.rescan()
+        assert late.exists()
+        # ...but a rescan after the interval elapses sweeps it, counting
+        # through the same event surface.
+        store._last_sweep = time.time() - 10_000
+        store.rescan()
+        assert not late.exists()
+        assert "StaleTmpSwept" in reasons(events, "default/late")
 
 
 # ---- worker-side sites ----

@@ -1,0 +1,166 @@
+"""The cached store's invalidation and exactly-once contracts.
+
+The dirty-tracking cache (controller/store.py) must never trade
+correctness for the I/O win: external edits are picked up via
+``rescan``/``reload`` with the cache armed, dirty writes still land,
+and the rename-claimed markers stay exactly-once under two supervisors
+sharing a state dir — with and without the scandir snapshot armed.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from pytorch_operator_tpu.controller.store import JobStore, key_to_fs
+from tests.testutil import new_job
+
+
+def job_path(d, key):
+    return d / (key_to_fs(key) + ".json")
+
+
+class TestDirtyTracking:
+    def test_idle_update_skips_the_write(self, tmp_path):
+        store = JobStore(persist_dir=tmp_path / "jobs")
+        key = store.add(new_job(name="idle"))
+        p = job_path(tmp_path / "jobs", key)
+        before = p.stat().st_mtime_ns, store.io.writes
+        for _ in range(5):
+            store.update(store.get(key))
+        assert (p.stat().st_mtime_ns, store.io.writes) == before
+        assert store.io.writes_skipped >= 5
+
+    def test_real_change_still_lands_on_disk(self, tmp_path):
+        store = JobStore(persist_dir=tmp_path / "jobs")
+        key = store.add(new_job(name="dirty"))
+        job = store.get(key)
+        job.status.restart_count = 7
+        store.update(job)
+        on_disk = json.loads(job_path(tmp_path / "jobs", key).read_text())
+        assert on_disk["status"]["restart_count"] == 7
+
+    def test_loaded_store_does_not_rewrite_clean_jobs(self, tmp_path):
+        store = JobStore(persist_dir=tmp_path / "jobs")
+        key = store.add(new_job(name="reload"))
+        # A fresh store over the same dir (daemon restart): its first
+        # no-op update must not touch the file.
+        store2 = JobStore(persist_dir=tmp_path / "jobs")
+        p = job_path(tmp_path / "jobs", key)
+        mtime = p.stat().st_mtime_ns
+        store2.update(store2.get(key))
+        assert p.stat().st_mtime_ns == mtime
+
+
+class TestExternalInvalidation:
+    def test_rescan_discovers_new_files_without_rereading_known(self, tmp_path):
+        d = tmp_path / "jobs"
+        owner = JobStore(persist_dir=d)
+        owner.add(new_job(name="known"))
+        # Another process (CLI submit) lands a new job file.
+        other = JobStore(persist_dir=d)
+        other.add(new_job(name="fresh"))
+        reads_before = owner.io.reads
+        new = owner.rescan()
+        assert new == ["default/fresh"]
+        # Exactly one file read: the unknown one. Known keys resolve by
+        # filename against the cache.
+        assert owner.io.reads == reads_before + 1
+
+    def test_reload_picks_up_external_edit_with_cache_armed(self, tmp_path):
+        d = tmp_path / "jobs"
+        observer = JobStore(persist_dir=d)
+        key = observer.add(new_job(name="watched"))
+        # External writer (the owning daemon in another process) bumps
+        # the restart count on disk.
+        writer = JobStore(persist_dir=d)
+        job = writer.get(key)
+        job.status.restart_count = 3
+        writer.update(job)
+        assert observer.get(key).status.restart_count == 0  # cached
+        assert observer.reload(key).status.restart_count == 3
+        # And the refreshed clean snapshot keeps dirty tracking truthful:
+        # a no-op update after reload must not rewrite the file.
+        mtime = job_path(d, key).stat().st_mtime_ns
+        observer.update(observer.get(key))
+        assert job_path(d, key).stat().st_mtime_ns == mtime
+
+    def test_update_after_reload_persists_new_changes(self, tmp_path):
+        d = tmp_path / "jobs"
+        store = JobStore(persist_dir=d)
+        key = store.add(new_job(name="evolve"))
+        store.reload(key)
+        job = store.get(key)
+        job.status.restart_count = 1
+        store.update(job)
+        assert (
+            json.loads(job_path(d, key).read_text())["status"]["restart_count"]
+            == 1
+        )
+
+
+class TestMarkerExactlyOnce:
+    @pytest.mark.parametrize("snapshot", [False, True])
+    def test_scale_marker_claimed_exactly_once_by_two_supervisors(
+        self, tmp_path, snapshot
+    ):
+        """Two stores over one dir race to claim the same scale marker;
+        rename-claim must hand it to exactly one — whether the candidate
+        list came from a fresh glob or the rescan snapshot (which may be
+        stale by claim time)."""
+        d = tmp_path / "jobs"
+        a, b = JobStore(persist_dir=d), JobStore(persist_dir=d)
+        for round_ in range(10):
+            key = f"default/race-{round_}"
+            a.mark_scale(key, 4)
+            if snapshot:
+                a.rescan()
+                b.rescan()
+            results = {}
+            barrier = threading.Barrier(2)
+
+            def claim(store, tag):
+                barrier.wait()
+                results[tag] = store.take_scale_markers()
+
+            ts = [
+                threading.Thread(target=claim, args=(a, "a")),
+                threading.Thread(target=claim, args=(b, "b")),
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(10)
+            claims = results["a"] + results["b"]
+            assert claims == [(key, 4)], claims
+
+    def test_marker_written_after_snapshot_survives_to_next_pass(self, tmp_path):
+        d = tmp_path / "jobs"
+        store = JobStore(persist_dir=d)
+        store.rescan()  # snapshot armed, no markers yet
+        store.mark_suspend("default/late", True)
+        # This pass's snapshot predates the marker: not claimed...
+        assert store.take_suspend_markers() == []
+        # ...but the next pass's snapshot picks it up — never lost.
+        store.rescan()
+        assert store.take_suspend_markers() == [("default/late", True)]
+
+    def test_take_without_rescan_still_globs(self, tmp_path):
+        store = JobStore(persist_dir=tmp_path / "jobs")
+        store.mark_scale("default/solo", 2)
+        assert store.take_scale_markers() == [("default/solo", 2)]
+
+
+class TestLegacyMode:
+    def test_cache_false_reproduces_precache_io(self, tmp_path):
+        d = tmp_path / "jobs"
+        store = JobStore(persist_dir=d, cache=False)
+        key = store.add(new_job(name="old-school"))
+        writes = store.io.writes
+        store.update(store.get(key))  # no-op update still writes
+        assert store.io.writes == writes + 1
+        reads = store.io.reads
+        store.rescan()  # re-reads every file
+        assert store.io.reads == reads + 1
