@@ -378,6 +378,41 @@ class ElasticPolicy:
 
 
 @dataclass
+class DataPlanePolicy:
+    """Host-I/O overlap knobs for the training data plane.
+
+    Threaded into every replica's environment (``TPUJOB_ASYNC_CHECKPOINT``
+    / ``TPUJOB_PREFETCH``, runtime/env.py) where the training workloads
+    read them as defaults for their ``--async-checkpoint`` / ``--prefetch``
+    flags — so a spec can take checkpoint commits and host→device
+    transfers off the step loop's critical path without per-workload
+    args plumbing.
+    """
+
+    # Overlap checkpoint commits with training steps (verified at commit
+    # — checkpoint/async_writer.py).
+    async_checkpoint: bool = False
+    # Device-feed lookahead depth (batches resident on device ahead of
+    # the step loop — data/device_prefetch.py). 0 = inline transfers.
+    prefetch: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.async_checkpoint:
+            d["async_checkpoint"] = True
+        if self.prefetch:
+            d["prefetch"] = self.prefetch
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "DataPlanePolicy":
+        return cls(
+            async_checkpoint=bool(d.get("async_checkpoint", False)),
+            prefetch=_parse_int(d.get("prefetch", 0), "data_plane.prefetch"),
+        )
+
+
+@dataclass
 class TPUJobSpec:
     """The TPUJob spec (reference: PyTorchJobSpec — RunPolicy + a map
     ReplicaType→ReplicaSpec with Master exactly-1)."""
@@ -385,6 +420,7 @@ class TPUJobSpec:
     replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     elastic_policy: Optional[ElasticPolicy] = None
+    data_plane: Optional[DataPlanePolicy] = None
     # Coordinator (rendezvous) port — the pytorchjob-port analog.
     port: Optional[int] = None  # defaulted to DEFAULT_PORT
 
@@ -400,6 +436,8 @@ class TPUJobSpec:
         }
         if self.elastic_policy is not None:
             d["elastic_policy"] = self.elastic_policy.to_dict()
+        if self.data_plane is not None and (dp := self.data_plane.to_dict()):
+            d["data_plane"] = dp
         if self.port is not None:
             d["port"] = self.port
         return d
@@ -419,6 +457,11 @@ class TPUJobSpec:
             elastic_policy=(
                 ElasticPolicy.from_dict(d["elastic_policy"])
                 if d.get("elastic_policy") is not None
+                else None
+            ),
+            data_plane=(
+                DataPlanePolicy.from_dict(d["data_plane"])
+                if d.get("data_plane") is not None
                 else None
             ),
             port=_parse_opt_int(d, "port", "spec.port"),
@@ -574,6 +617,23 @@ class TPUJob:
     api_version: str = API_VERSION
     kind: str = KIND
 
+    def __post_init__(self) -> None:
+        # In-memory generation counter (NOT a dataclass field: it must
+        # never serialize, reach the CRD schema, or survive a reload).
+        # Mutators bump it via touch(); JobStore._persist compares it
+        # against the generation last written to disk, making the
+        # clean-job check O(1) — no to_dict() per job per pass.
+        self.generation = 0
+
+    def touch(self) -> None:
+        """Mark this object dirty for persistence. Call after mutating
+        spec/status/metadata in place; :meth:`set_condition` and
+        ``controller.status.update_replica_statuses`` call it for you.
+        A missed touch means the change stays in-memory-only until the
+        next real transition — the store's dirty check trusts this
+        counter INSTEAD of serializing the job on every pass."""
+        self.generation += 1
+
     # ---- condition helpers (reference: status.go condition utilities) ----
 
     def get_condition(self, ctype: ConditionType) -> Optional[JobCondition]:
@@ -612,6 +672,7 @@ class TPUJob:
         - terminal conditions clear RUNNING/RESTARTING.
         """
         now = time.time() if now is None else now
+        self.touch()  # every set_condition changes last_update_time
         cond = self.get_condition(ctype)
         if cond is None:
             self.status.conditions.append(

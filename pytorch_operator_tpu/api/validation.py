@@ -120,6 +120,9 @@ def validate_spec(spec: TPUJobSpec) -> List[str]:
     if spec.elastic_policy is not None:
         errs.extend(_validate_elastic(spec.elastic_policy, spec))
 
+    if spec.data_plane is not None and spec.data_plane.prefetch < 0:
+        errs.append("spec.data_plane.prefetch: must be >= 0")
+
     return errs
 
 

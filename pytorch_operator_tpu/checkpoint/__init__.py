@@ -5,6 +5,12 @@ user script reloads its checkpoint"); this package supplies the workload half
 the reference left to user containers.
 """
 
+from .async_writer import AsyncCheckpointWriter, snapshot_to_host
 from .manager import CheckpointManager, job_checkpoint_dir
 
-__all__ = ["CheckpointManager", "job_checkpoint_dir"]
+__all__ = [
+    "AsyncCheckpointWriter",
+    "CheckpointManager",
+    "job_checkpoint_dir",
+    "snapshot_to_host",
+]

@@ -61,19 +61,54 @@ def sidecar_path(root, step: int) -> Path:
     return Path(root) / f"{int(step)}.digest"
 
 
+def inflight_path(root, step: int) -> Path:
+    return Path(root) / f"{int(step)}.inflight"
+
+
+def mark_inflight(root, step: int) -> Path:
+    """Fence a step whose async commit is in flight: until the sidecar
+    lands (which clears the fence), the step is NOT committed — a crash
+    mid-commit leaves the marker behind and the restore-side scan skips
+    the step no matter how complete its bytes look. Atomic for the same
+    reason sidecars are: a torn fence must still fence."""
+    path = inflight_path(root, step)
+    tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+    tmp.write_text("inflight\n")
+    tmp.replace(path)
+    return path
+
+
+def clear_inflight(root, step: int) -> None:
+    inflight_path(root, step).unlink(missing_ok=True)
+
+
 def write_sidecar(root, step: int) -> str:
     """Digest ``root/<step>`` and commit the sidecar atomically (a torn
-    SIDECAR must never condemn a good checkpoint). Returns the digest."""
+    SIDECAR must never condemn a good checkpoint). Returns the digest.
+
+    Also clears the step's inflight fence — the sidecar IS the commit
+    record, so a stale fence from a previous life's interrupted async
+    save must not condemn the step a new life just re-saved. Ordering
+    (sidecar first, then unfence) errs conservative: a crash between
+    the two leaves a good step fenced, and recovery falls back one
+    step rather than trusting an ambiguous one."""
     digest = step_digest(Path(root) / str(int(step)))
     path = sidecar_path(root, step)
     tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
     tmp.write_text(digest + "\n")
     tmp.replace(path)
+    clear_inflight(root, step)
     return digest
 
 
 def verify_step(root, step: int) -> Optional[bool]:
-    """True = verified good; False = corrupt; None = no sidecar."""
+    """True = verified good; False = corrupt/uncommitted; None = no
+    sidecar (legacy checkpoint — accepted by default)."""
+    if inflight_path(root, step).exists():
+        # An async commit started and never finished (the writer clears
+        # the fence when the sidecar lands): the step is uncommitted,
+        # whatever bytes the crash left behind.
+        return False
     path = sidecar_path(root, step)
     try:
         expected = path.read_text().strip()
@@ -123,12 +158,14 @@ def latest_verified_step(
 
 
 def prune_stale_sidecars(root) -> None:
-    """Drop sidecars whose step directory is gone (max_to_keep GC)."""
+    """Drop sidecars and inflight fences whose step directory is gone
+    (max_to_keep GC, or a commit that failed after cleanup)."""
     root = Path(root)
     live = {str(s) for s in list_steps(root)}
-    for p in root.glob("*.digest"):
-        if p.name[: -len(".digest")] not in live:
-            p.unlink(missing_ok=True)
+    for suffix in (".digest", ".inflight"):
+        for p in root.glob("*" + suffix):
+            if p.name[: -len(suffix)] not in live:
+                p.unlink(missing_ok=True)
 
 
 def corrupt_step(root, step: int, *, mode: str = "flip") -> Path:

@@ -63,62 +63,143 @@ class CheckpointManager:
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
+        # Lazily created on the first non-blocking save. One writer =
+        # one commit thread = async saves serialize in submission order.
+        self._writer = None
+
+    def _drain(self) -> None:
+        """Barrier: every async commit submitted so far is finished
+        (committed or failed-and-reported). All read-side entry points
+        and blocking saves pass through here, so orbax is only ever
+        touched from one thread at a time and no caller observes a
+        half-committed step."""
+        if self._writer is not None:
+            self._writer.wait()
 
     def latest_step(self) -> Optional[int]:
+        self._drain()
         return self._mgr.latest_step()
 
     def all_steps(self) -> list:
+        self._drain()
         return sorted(self._mgr.all_steps())
 
-    def save(self, step: int, state: Any, *, block: bool = True) -> None:
-        """Save ``state`` at ``step``. ``block=True`` waits for the commit —
-        the safe default for preemption-recovery tests; ``block=False``
-        overlaps the write with the next training steps.
+    def last_committed_step(self) -> Optional[int]:
+        """Newest step whose ASYNC commit (sidecar included) finished —
+        without draining; the live-telemetry peek."""
+        return None if self._writer is None else self._writer.last_committed_step()
+
+    def _commit_step(self, step: int, state: Any, fault) -> None:
+        """One durable, VERIFIED step commit — the shared tail of both
+        save paths (blocking on the caller's thread, async on the
+        writer's commit thread).
 
         Transient I/O failures are retried on the shared backoff
         schedule (a preempted NFS mount mid-save must not kill a
         training step the restart policy would happily replay); each
-        retry first clears the partial step so orbax starts clean.
-        Blocking saves commit a checksum sidecar afterwards — the
-        restore side's verified-good scan (integrity.py) keys off it.
-        Non-blocking saves skip the sidecar (the bytes are still in
-        flight); their steps verify as "unknown" and restore normally.
+        retry first clears the partial step so orbax starts clean, and
+        retry exhaustion (e.g. an ``enospc`` fault — persistent, every
+        attempt fails) cleans the partial step before re-raising so a
+        half-written directory can never be mistaken for a legacy
+        unverified checkpoint. The checksum sidecar commits LAST — for
+        async saves too, closing the old "non-blocking saves verify as
+        unknown" hole.
         """
-        from .. import faults
+        import shutil
+
         from ..backoff import Backoff, retry_call
         from . import integrity
-
-        fault = faults.checkpoint_write_fault()
 
         def attempt():
             nonlocal fault
             if fault == "fail":
                 fault = None  # transient: only the first attempt fails
                 raise OSError("injected transient checkpoint write failure")
+            if fault == "enospc":
+                # Persistent: EVERY attempt fails — disk-full does not
+                # heal on a retry schedule.
+                import errno
+
+                raise OSError(
+                    errno.ENOSPC, "injected: no space left on device"
+                )
             self._mgr.save(step, args=self._ocp.args.StandardSave(state))
-            if block:
-                self._mgr.wait_until_finished()
+            self._mgr.wait_until_finished()
 
         def clear_partial(_exc, _attempt):
-            import shutil
-
             shutil.rmtree(self.directory / str(step), ignore_errors=True)
 
-        retry_call(
-            attempt,
-            backoff=Backoff(base_s=0.05, cap_s=2.0, seed=step),
-            attempts=3,
-            retry_on=(OSError,),
-            on_retry=clear_partial,
+        try:
+            retry_call(
+                attempt,
+                backoff=Backoff(base_s=0.05, cap_s=2.0, seed=step),
+                attempts=3,
+                retry_on=(OSError,),
+                on_retry=clear_partial,
+            )
+        except OSError:
+            # Final failure: leave NO partial step behind (a sidecar-less
+            # directory would restore as a legacy "unknown" step) and let
+            # the caller decide whether the loop survives.
+            clear_partial(None, None)
+            raise
+        integrity.write_sidecar(self.directory, step)
+        if fault == "torn":
+            # Damage the committed bytes UNDER the fresh sidecar —
+            # the deterministic stand-in for a torn write that the
+            # verified-good restore scan must catch and skip.
+            integrity.corrupt_step(self.directory, step)
+        integrity.prune_stale_sidecars(self.directory)
+
+    def _report_save_failed(self, step: int, err) -> None:
+        from ..runtime.rendezvous import report
+
+        print(
+            f"[tpujob] warning: checkpoint save of step {step} failed "
+            f"after retries ({err}); training continues, recovery will "
+            "fall back to the last verified step",
+            flush=True,
         )
+        report("checkpoint_save_failed", step=step, error=str(err))
+
+    def save(self, step: int, state: Any, *, block: bool = True) -> None:
+        """Save ``state`` at ``step``. ``block=True`` waits for the commit —
+        the safe default for preemption-recovery tests; ``block=False``
+        snapshots the state to host and returns, committing (checksum
+        sidecar included) on the async writer's single background
+        thread. Both paths produce VERIFIED steps; the only difference
+        is where the wait happens.
+
+        The fault-injection decision (``checkpoint_write_fault``) is
+        evaluated HERE, in call order, so a replayed plan fires the
+        identical saves on either path; the fault's effect lands inside
+        the commit itself. An async commit that exhausts its retries is
+        reported (``checkpoint_save_failed``) and recorded on the
+        writer, never raised into the step loop.
+        """
+        from .. import faults
+
+        fault = faults.checkpoint_write_fault()
         if block:
-            integrity.write_sidecar(self.directory, step)
-            if fault == "torn":
-                # Damage the committed bytes UNDER the fresh sidecar —
-                # the deterministic stand-in for a torn write that the
-                # verified-good restore scan must catch and skip.
-                integrity.corrupt_step(self.directory, step)
-            integrity.prune_stale_sidecars(self.directory)
+            self._drain()  # commits stay in submission order
+            self._commit_step(step, state, fault)
+            return
+        from .async_writer import AsyncCheckpointWriter, snapshot_to_host
+
+        if self._writer is None:
+            self._writer = AsyncCheckpointWriter(
+                self._commit_step,
+                root=self.directory,
+                on_error=self._report_save_failed,
+            )
+        # The host snapshot is the ONLY stall the step loop pays: after
+        # this line the caller may donate/overwrite the live state.
+        self._writer.submit(step, snapshot_to_host(state), fault)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Public barrier: drain pending async commits."""
+        if self._writer is not None:
+            self._writer.wait(timeout)
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore onto the structure/shardings of ``state_like`` (pass the
@@ -131,6 +212,7 @@ class CheckpointManager:
         world-size-change case preemption recovery exists for
         (tests/test_checkpoint.py::test_restore_reshards_across_mesh_shapes
         and the shrink e2e in test_elastic_e2e.py pin this)."""
+        self._drain()
         return self._mgr.restore(
             self._resolve_step(step),
             args=self._ocp.args.StandardRestore(state_like),
@@ -149,6 +231,7 @@ class CheckpointManager:
         host memory is the FULL state's bytes, so serve-side loading of
         one subtree should use :meth:`restore_subtree` instead (the
         generate workload does). Returns ``(step, tree)``."""
+        self._drain()
         step = self._resolve_step(step)
         return step, self._mgr.restore(step)
 
@@ -174,6 +257,7 @@ class CheckpointManager:
         import jax
         import numpy as np
 
+        self._drain()
         step = self._resolve_step(step)
         step_dir = self.directory / str(step) / "default"
         with self._ocp.Checkpointer(
@@ -290,6 +374,10 @@ class CheckpointManager:
         return None
 
     def close(self) -> None:
+        # Workload exit drains through here: every async save submitted
+        # before close is durable (or reported failed) when this returns.
+        if self._writer is not None:
+            self._writer.close()
         self._mgr.close()
 
     def __enter__(self) -> "CheckpointManager":

@@ -843,6 +843,22 @@ def cmd_bench_control_plane(args) -> int:
     return ctrlplane_bench.main(argv)
 
 
+def cmd_bench_data_plane(args) -> int:
+    """Data-plane benchmark: checkpoint stall + step throughput across
+    {blocking, async} saves x {inline, prefetched} device feeds
+    (workloads/dataplane_bench)."""
+    from pytorch_operator_tpu.workloads import dataplane_bench
+
+    argv = [
+        "--steps", str(args.steps),
+        "--checkpoint-every", str(args.checkpoint_every),
+        "--dim", str(args.dim),
+    ]
+    if args.out:
+        argv += ["--out", args.out]
+    return dataplane_bench.main(argv)
+
+
 def cmd_manifests(args) -> int:
     # Deploy-manifest generation (SURVEY.md §1 layer 6): the CRD schema is
     # introspected from api/types.py so it cannot drift (api/crdgen.py).
@@ -1045,6 +1061,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="write the full artifact here (e.g. BENCH_ctrlplane.json)",
     )
     sp.set_defaults(func=cmd_bench_control_plane)
+
+    sp = sub.add_parser(
+        "bench-data-plane",
+        help="measure training-step checkpoint stalls + device-feed "
+        "overlap ({blocking, async} saves x {inline, prefetched} "
+        "feeds); emits a JSON artifact",
+    )
+    sp.add_argument("--steps", type=int, default=40, help="timed steps/cell")
+    sp.add_argument(
+        "--checkpoint-every", type=int, default=5, help="save cadence"
+    )
+    sp.add_argument(
+        "--dim", type=int, default=256,
+        help="bench model width (state bytes ~ 96*dim^2)",
+    )
+    sp.add_argument(
+        "--out", default=None,
+        help="write the full artifact here (e.g. BENCH_dataplane.json)",
+    )
+    sp.set_defaults(func=cmd_bench_data_plane)
 
     sp = sub.add_parser(
         "serve-request",

@@ -235,7 +235,9 @@ class Reconciler:
                 message=f"TPUJob {key} is suspended.", now=now,
             )
             self.events.normal(key, "TPUJobSuspended", f"TPUJob {key} is suspended.")
-        job.status.start_time = None
+        if job.status.start_time is not None:
+            job.status.start_time = None
+            job.touch()
         update_replica_statuses(job, self.runner.list_for_job(key))
         self.store.update(job)
         return True
@@ -441,6 +443,7 @@ class Reconciler:
                     )
         if earliest is not None and job.status.first_step_time is None:
             job.status.first_step_time = earliest
+            job.touch()
 
     # ---- the core sync ----
 
@@ -721,6 +724,7 @@ class Reconciler:
                     job.spec.elastic_policy.min_replicas
                 ):
                     workers.replicas = n_admit - 1  # master admitted first
+                    job.touch()
                     msg = (
                         f"elastic launch shrunk to {workers.replicas} "
                         f"worker(s) to fit available capacity (target "
@@ -758,6 +762,7 @@ class Reconciler:
                 from .supervisor import _find_free_port
 
                 job.spec.port = _find_free_port()
+                job.touch()
             status_dir = self._status_dir(key)
             checkpoint_dir = self._checkpoint_dir(key)
             cache_dir = None
@@ -953,6 +958,7 @@ class Reconciler:
         if grow <= 0:
             return False
         workers.replicas = cur + grow
+        job.touch()
         msg = (
             f"elastic grow-back to {workers.replicas} worker(s) toward "
             f"target {target} (restart #{job.status.restart_count + 1})."

@@ -80,4 +80,10 @@ def update_replica_statuses(job: TPUJob, handles: Iterable[ReplicaHandle]) -> No
     # all replica types in status).
     for rtype in job.spec.replica_specs:
         statuses.setdefault(rtype, ReplicaStatus())
+    if statuses != job.status.replica_statuses:
+        # touch() only on a real change: this runs on EVERY sync pass,
+        # and an unconditional bump would mark every idle job dirty —
+        # re-serializing the fleet per pass, exactly the cost the
+        # generation counter exists to remove.
+        job.touch()
     job.status.replica_statuses = statuses

@@ -8,8 +8,11 @@ The persistence layer is a CACHE, informer-style: the in-memory object is
 authoritative for the owning supervisor, and disk I/O happens only on real
 transitions. Concretely (the control-plane hot path at thousands of jobs):
 
-- ``_persist`` dirty-tracks the serialized form per key and skips the
-  write when nothing changed — an idle job costs zero write I/O per pass.
+- ``_persist`` dirty-tracks per key in two tiers: an O(1) generation
+  compare (``TPUJob.touch()`` bumps it at every mutation site) decides
+  clean-vs-dirty WITHOUT serializing, and the serialized-form compare
+  behind it dedupes touches that changed nothing — an idle job costs
+  zero write I/O and zero ``to_dict()`` per pass.
 - ``rescan`` takes ONE ``scandir`` snapshot of the state dir per call:
   job files are recognized by filename (keys derive from the name, so
   known jobs are never re-read), and the same snapshot serves all four
@@ -44,16 +47,19 @@ _MARKER_KINDS = ("delete", "apply", "suspend", "scale")
 
 class StoreIOCounters:
     """Per-store file-I/O accounting for the control-plane bench: how many
-    job/marker files were read, written, or skipped-as-clean, and how many
-    directory scans ran. Monotonic; read deltas per pass."""
+    job/marker files were read, written, or skipped-as-clean, how many
+    directory scans ran, and how many full job serializations
+    (``to_dict``) the persistence layer paid. Monotonic; read deltas per
+    pass."""
 
-    __slots__ = ("reads", "writes", "writes_skipped", "scans")
+    __slots__ = ("reads", "writes", "writes_skipped", "scans", "serializations")
 
     def __init__(self) -> None:
         self.reads = 0
         self.writes = 0
         self.writes_skipped = 0
         self.scans = 0
+        self.serializations = 0
 
     def snapshot(self) -> dict:
         return {
@@ -61,6 +67,7 @@ class StoreIOCounters:
             "writes": self.writes,
             "writes_skipped": self.writes_skipped,
             "scans": self.scans,
+            "serializations": self.serializations,
         }
 
 
@@ -98,10 +105,19 @@ class JobStore:
         # cache=False: pre-cache behavior (always write, always re-read on
         # rescan, glob per marker scan) — the bench baseline.
         self._cache_enabled = cache
-        # Dirty tracking: key -> the to_dict() form last written to (or
-        # loaded from) disk. _persist compares against it and skips clean
-        # writes; reload/rescan refresh it so external edits invalidate.
+        # Dirty tracking, two tiers:
+        # - _clean_gen: key -> TPUJob.generation at the last persist/load.
+        #   The O(1) fast path — an idle job's update() costs ONE int
+        #   compare, no to_dict() (mutators bump generation via
+        #   job.touch(); set_condition/update_replica_statuses do it
+        #   centrally).
+        # - _clean: key -> the to_dict() form last written to (or loaded
+        #   from) disk. The content check behind the generation gate: a
+        #   touch that produced no serialized change still skips the
+        #   WRITE (it pays one serialization).
+        # reload/rescan refresh both so external edits invalidate.
         self._clean: Dict[str, dict] = {}
+        self._clean_gen: Dict[str, int] = {}
         # The marker lists collected by the last rescan snapshot; each
         # take_*/deletion_markers call consumes its kind once, then falls
         # back to a fresh glob (standalone callers never see stale lists).
@@ -192,7 +208,9 @@ class JobStore:
         if key not in self._jobs:
             # Known keys keep their dirty state: the in-memory object is
             # authoritative and may have an unwritten change pending.
+            self.io.serializations += 1
             self._clean[key] = job.to_dict()
+            self._clean_gen[key] = job.generation
         return job
 
     def _load_all(self) -> None:
@@ -209,13 +227,27 @@ class JobStore:
         path = self._path_for(key)
         if job is None:
             self._clean.pop(key, None)
+            self._clean_gen.pop(key, None)
             path.unlink(missing_ok=True)
         else:
+            if (
+                self._cache_enabled
+                and key in self._clean
+                and self._clean_gen.get(key) == job.generation
+            ):
+                # O(1) clean check: no mutator touched the job since the
+                # last persist, so the disk form is current — no
+                # serialization, no write, ONE integer compare per job
+                # per pass.
+                self.io.writes_skipped += 1
+                return
+            self.io.serializations += 1
             d = job.to_dict()
             if self._cache_enabled and d == self._clean.get(key):
-                # Dirty tracking: the serialized form is unchanged, so the
-                # file on disk (which we wrote) is already current — an
-                # idle job costs zero write I/O per pass.
+                # Touched but serialized-identical (defensive touch):
+                # the file on disk is already current — record the new
+                # generation so the next pass takes the O(1) path.
+                self._clean_gen[key] = job.generation
                 self.io.writes_skipped += 1
                 return
             text = json.dumps(d, indent=2)
@@ -237,6 +269,7 @@ class JobStore:
             tmp.replace(path)
             self.io.writes += 1
             self._clean[key] = d
+            self._clean_gen[key] = job.generation
 
     # ---- CRUD ----
 
@@ -261,8 +294,16 @@ class JobStore:
             return self._jobs.get(key)
 
     def update(self, job: TPUJob) -> None:
+        """Persist ``job`` if it changed. The clean check is O(1): callers
+        that mutate a stored job in place must ``job.touch()`` (the
+        condition/status helpers do it centrally); handing in a NEW
+        object for an existing key always falls through to the content
+        check — a fresh object's generation proves nothing about what is
+        on disk."""
         key = job_key(job)
         with self._lock:
+            if self._jobs.get(key) is not job:
+                self._clean_gen.pop(key, None)
             self._jobs[key] = job
             self._persist(key)
 
@@ -361,6 +402,7 @@ class JobStore:
             except OSError:
                 self._jobs.pop(key, None)
                 self._clean.pop(key, None)
+                self._clean_gen.pop(key, None)
                 return None
             except (ValueError, KeyError):
                 return self._jobs.get(key)
@@ -369,7 +411,9 @@ class JobStore:
             # snapshot so dirty tracking compares against what is REALLY
             # on disk (an external edit must not be masked by a stale
             # clean form from before the edit).
+            self.io.serializations += 1
             self._clean[key] = job.to_dict()
+            self._clean_gen[key] = job.generation
             return job
 
     def _marker_path(self, key: str, kind: str) -> Path:

@@ -227,6 +227,7 @@ class Supervisor:
             if both_auto:
                 job.spec.port = cur.spec.port  # keep the live probed port
             cur.spec = job.spec
+            cur.touch()
             # New metadata wins; system identity (uid/creation/submit) stays.
             cur.metadata.labels.update(job.metadata.labels)
             cur.metadata.annotations.update(job.metadata.annotations)
@@ -298,6 +299,7 @@ class Supervisor:
             # operator's explicit choice must not be undone by the
             # reconciler growing back to the original submit-time count.
             job.metadata.annotations[ELASTIC_TARGET_ANNOTATION] = str(worker_replicas)
+            job.touch()
             if workers.replicas == worker_replicas:
                 self.store.update(job)
                 return job
@@ -664,6 +666,7 @@ class Supervisor:
                     continue
                 if job.spec.run_policy.suspend != flag:
                     job.spec.run_policy.suspend = flag
+                    job.touch()
                     self.store.update(job)
 
     def process_scale_markers(self) -> None:

@@ -4,12 +4,16 @@ Reference analog: the operator repo itself has no input pipeline — examples
 lean on torch's DataLoader, whose prefetch workers are PyTorch's native C++
 layer inside the user container (SURVEY.md §2, component-inventory preamble).
 This package is the TPU-native equivalent: a packed record file format
-(:mod:`array_file`) plus a C++ background-prefetch loader
+(:mod:`array_file`), a C++ background-prefetch loader
 (:mod:`native_loader`, ``native/loader.cc``) that keeps host-side gather off
-the training loop's critical path.
+the training loop's critical path, and a double-buffered device feed
+(:mod:`device_prefetch`) that keeps the host→device transfer off it too —
+``prefetch_to_device(loader, depth=2)`` overlaps ``device_put`` of batch
+N+1 with step N on a background thread.
 """
 
 from .array_file import ArrayFileMeta, field_max, field_range, pack_arrays, read_meta
+from .device_prefetch import DevicePrefetcher, PrefetchedLoader, prefetch_to_device
 from .native_loader import (
     LoaderDataError,
     LoaderUnavailable,
@@ -30,9 +34,12 @@ def open_training_loader(path, batch: int, *, seed: int = 0, processes: int = 1)
 
 __all__ = [
     "ArrayFileMeta",
+    "DevicePrefetcher",
+    "PrefetchedLoader",
     "field_max",
     "field_range",
     "pack_arrays",
+    "prefetch_to_device",
     "read_meta",
     "LoaderDataError",
     "LoaderUnavailable",

@@ -1,0 +1,181 @@
+"""Double-buffered device feed: the native loader's prefetch thread gets
+an on-device counterpart.
+
+The C++ loader (``native_loader``) overlaps the host-side gather with
+training, but every workload still paid the host→device transfer INLINE
+with the step: ``device_put`` of batch N sat between step N-1 and step N
+on the critical path. "Exploring the limits of Concurrency in ML
+Training on Google TPUs" (PAPERS.md) identifies exactly this
+input-pipeline/step overlap as where pod-scale step time goes.
+
+:class:`DevicePrefetcher` moves the transfer onto a background thread
+with a bounded lookahead queue (``depth`` batches resident on device
+ahead of the consumer — double-buffered at the default ``depth=2``):
+while step N runs, the feed thread is already copying batch N+1 out of
+the loader's borrowed slot and dispatching its ``device_put``. The step
+path does ZERO transfers — it pops ready device arrays.
+
+Two entry points:
+
+- :class:`DevicePrefetcher` — generic: ``produce()`` returns a host
+  batch (any pytree), ``put()`` maps it to device. Synthetic feeds and
+  the chunk-stacking image feed use this directly.
+- :func:`prefetch_to_device` — the loader wrapper: drop-in for a
+  ``NativeLoader``/``PyLoader`` (same ``next_batch()`` contract,
+  ``batches_per_epoch`` passthrough), COPYING the borrowed slot before
+  it leaves the feed thread (the loader recycles the slot on its next
+  ``next_batch`` — a zero-copy view handed across threads would read
+  recycled memory).
+
+Ordering is strictly FIFO — batch order is identical to the inline
+feed, so determinism contracts (seeded shuffles, resume fast-forward)
+are unaffected; a crash merely re-reads the up-to-``depth`` batches
+that were prefetched but never consumed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+_SENTINEL = object()
+
+
+def _default_put(tree: Any) -> Any:
+    import jax
+
+    return jax.device_put(tree)
+
+
+class DevicePrefetcher:
+    """Background-thread device feed over an arbitrary host-batch source.
+
+    ``produce()`` and ``put()`` both run on the feed thread; ``get()``
+    (the step path) only pops ready device batches. The queue holds at
+    most ``depth`` put batches — bounded device-memory lookahead, and
+    backpressure on the producer when the consumer falls behind.
+
+    A ``produce``/``put`` exception is re-raised from the consumer's
+    next ``get()`` — errors are not swallowed, just deferred to the
+    thread that can act on them.
+    """
+
+    def __init__(
+        self,
+        produce: Callable[[], Any],
+        *,
+        put: Optional[Callable[[Any], Any]] = None,
+        depth: int = 2,
+        name: str = "device-prefetch",
+    ):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._produce = produce
+        self._put = put or _default_put
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._err: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._fill, name=name, daemon=True)
+        self._thread.start()
+
+    def _fill(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._put(self._produce())
+            except BaseException as e:  # noqa: BLE001 — deliver to consumer
+                self._err = e
+                item = _SENTINEL
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if item is _SENTINEL:
+                return
+
+    def get(self) -> Any:
+        """Next device batch, in production order. Blocks only when the
+        feed thread has fallen behind the step loop."""
+        if self._stop.is_set():
+            raise RuntimeError("prefetcher is closed")
+        item = self._q.get()
+        if item is _SENTINEL:
+            raise self._err
+        return item
+
+    def close(self) -> None:
+        """Stop the feed thread and drop queued batches. Idempotent."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        # Unblock a producer stuck on a full queue.
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PrefetchedLoader:
+    """Loader-contract facade over :class:`DevicePrefetcher` — see
+    :func:`prefetch_to_device`."""
+
+    def __init__(self, loader, depth: int = 2, *, put=None):
+        self.loader = loader
+
+        def produce():
+            epoch, index, fields = loader.next_batch()
+            # COPY the borrowed slot on the feed thread, before the next
+            # next_batch() recycles it (the loader's borrow contract).
+            return epoch, index, {
+                k: np.array(v, copy=True) for k, v in fields.items()
+            }
+
+        apply_put = put or _default_put
+        self._pf = DevicePrefetcher(
+            produce,
+            put=lambda item: (item[0], item[1], apply_put(item[2])),
+            depth=depth,
+        )
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self.loader.batches_per_epoch
+
+    def next_batch(self):
+        """Same contract as the wrapped loader, but ``fields`` is the
+        device-resident result of ``put`` — already transferred, owned
+        by the caller (no borrow to respect)."""
+        return self._pf.get()
+
+    def close(self) -> None:
+        self._pf.close()
+        self.loader.close()
+
+    def __enter__(self) -> "PrefetchedLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def prefetch_to_device(loader, depth: int = 2, *, put=None) -> PrefetchedLoader:
+    """Wrap a batch loader in a double-buffered device feed.
+
+    ``put(fields_dict) -> device_batch`` defaults to ``jax.device_put``
+    of the whole dict; sharded workloads pass their ``put_global``
+    closure. The wrapper owns the loader: ``close()`` closes both.
+    """
+    return PrefetchedLoader(loader, depth, put=put)

@@ -142,22 +142,30 @@ class FaultInjector:
                     return f
         return None
 
+    _CHECKPOINT_WRITE_MODES = {
+        "fail_checkpoint_write": "fail",
+        "torn_checkpoint_write": "torn",
+        "enospc_checkpoint_write": "enospc",
+    }
+
     def checkpoint_write_fault(
         self, rtype=None, index=None, restart: Optional[int] = None
     ) -> Optional[str]:
-        """The ``nth``-save checkpoint faults: ``"fail"`` (raise, retry
-        recovers), ``"torn"`` (corrupt bytes under a stale checksum), or
-        None. One save call = one occurrence, shared by both kinds so a
-        plan can say "write 2 fails transiently, write 3 lands torn"."""
+        """The ``nth``-save checkpoint faults: ``"fail"`` (raise once,
+        retry recovers), ``"torn"`` (corrupt bytes under a stale
+        checksum), ``"enospc"`` (persistent OSError — every retry
+        attempt fails, the save is lost), or None. One save call = one
+        occurrence, shared by all kinds so a plan can say "write 2 fails
+        transiently, write 3 lands torn"."""
         with self._lock:
             n = self._occurrence("checkpoint_write")
-            for kind in ("fail_checkpoint_write", "torn_checkpoint_write"):
+            for kind, mode in self._CHECKPOINT_WRITE_MODES.items():
                 for i, f in self._candidates(kind, rtype, index):
                     if f.nth <= n < f.nth + f.times and self._restart_ok(
                         f, restart
                     ):
                         self._consume(i, f)
-                        return "fail" if kind == "fail_checkpoint_write" else "torn"
+                        return mode
         return None
 
     # ---- controller-side sites ----

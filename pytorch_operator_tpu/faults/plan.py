@@ -29,6 +29,13 @@ Fault kinds (``Fault.kind``):
                              lands corrupt under a stale checksum sidecar
                              (restore must fall back to the previous
                              verified-good step)
+- ``enospc_checkpoint_write`` worker-side: the ``nth`` checkpoint save
+                             fails PERSISTENTLY (OSError ENOSPC on every
+                             retry attempt — disk-full does not heal on
+                             a backoff schedule); the save fails after
+                             retries, the step loop must survive, and
+                             restore falls back to the last verified
+                             step
 - ``kill_replica``           controller-side: SIGKILL the target replica
                              at supervisor pass ``at`` (preemption model)
 - ``fail_spawn``             controller-side: the ``nth`` spawn of the
@@ -62,6 +69,7 @@ KINDS = frozenset(
         "drop_heartbeat",
         "fail_checkpoint_write",
         "torn_checkpoint_write",
+        "enospc_checkpoint_write",
         "kill_replica",
         "fail_spawn",
         "torn_state_write",
@@ -75,6 +83,7 @@ NTH_KINDS = frozenset(
     {
         "fail_checkpoint_write",
         "torn_checkpoint_write",
+        "enospc_checkpoint_write",
         "fail_spawn",
         "fail_engine_step",
     }

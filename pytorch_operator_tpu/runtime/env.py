@@ -89,6 +89,15 @@ def build_cluster_env(
         env["TPUJOB_STATUS_DIR"] = status_dir
     if checkpoint_dir is not None:
         env["TPUJOB_CHECKPOINT_DIR"] = checkpoint_dir
+    # Data-plane policy (spec.data_plane): workloads read these as the
+    # defaults for --async-checkpoint / --prefetch, so host-I/O overlap
+    # is a SPEC property, not per-workload args plumbing.
+    dp = job.spec.data_plane
+    if dp is not None:
+        if dp.async_checkpoint:
+            env["TPUJOB_ASYNC_CHECKPOINT"] = "1"
+        if dp.prefetch > 0:
+            env["TPUJOB_PREFETCH"] = str(dp.prefetch)
     # Persistent XLA compilation cache, shared across the state dir: a
     # resubmitted/restarted job skips its ~30s cold compile, which is most
     # of schedule-to-first-step on TPU (BASELINE.md). Template env wins —

@@ -47,6 +47,7 @@ def run(
     lr_warmup_steps: int = 0,
     grad_clip: float | None = None,
     num_classes: int = 2,
+    prefetch: int = 0,
     profile_dir: str | None = None,
     log=print,
 ) -> dict:
@@ -112,20 +113,51 @@ def run(
     tok_sharding = named_sharding(mesh, "batch", "seq")
     lbl_sharding = named_sharding(mesh, "batch")
 
-    def batches(step: int):
-        toks, labels = synthetic_topic_batch(
+    def host_batch(step: int):
+        return synthetic_topic_batch(
             batch, seq_len, cfg.vocab_size, step, num_classes
         )
+
+    def put_batch(toks_labels):
+        toks, labels = toks_labels
         return (
             put_global(toks, tok_sharding),
             put_global(labels, lbl_sharding),
         )
 
-    with mesh:
-        state, (final_loss, final_acc), steps_per_sec, end_step = _loop(
-            train_step, state, batches, steps, warmup, log, profile_dir,
-            seqs_per_step_per_chip=batch / n_dev,
+    prefetcher = None
+    if prefetch > 0:
+        # Double-buffered device feed: batch N+1 transfers on the feed
+        # thread while step N runs (data/device_prefetch.py). Same batch
+        # order as inline — the producer counts the same step sequence
+        # the loop would pass.
+        import itertools
+
+        from ..data.device_prefetch import DevicePrefetcher
+
+        _feed_steps = itertools.count(0)
+        prefetcher = DevicePrefetcher(
+            lambda: host_batch(next(_feed_steps)), put=put_batch,
+            depth=prefetch,
         )
+
+        def batches(step: int):
+            return prefetcher.get()
+
+    else:
+
+        def batches(step: int):
+            return put_batch(host_batch(step))
+
+    try:
+        with mesh:
+            state, (final_loss, final_acc), steps_per_sec, end_step = _loop(
+                train_step, state, batches, steps, warmup, log, profile_dir,
+                seqs_per_step_per_chip=batch / n_dev,
+            )
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
 
     seqs_per_sec = steps_per_sec * batch
     per_chip = seqs_per_sec / n_dev
@@ -211,12 +243,21 @@ def main(argv=None) -> int:
         help="clip gradients to this global norm",
     )
     p.add_argument(
+        "--prefetch", type=int, default=None, metavar="DEPTH",
+        help="double-buffered device feed: keep DEPTH batches device-"
+        "resident ahead of the step loop (0 = inline transfers). "
+        "Default: spec.data_plane / TPUJOB_PREFETCH",
+    )
+    p.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace of the timed window here",
     )
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
+    from .trainer import data_plane_env_defaults
+
+    _, env_prefetch = data_plane_env_defaults()
     world = rendezvous.initialize_from_env()
     result = run(
         bert_base=args.bert_base,
@@ -228,6 +269,7 @@ def main(argv=None) -> int:
         lr=args.lr,
         lr_warmup_steps=args.lr_warmup_steps,
         grad_clip=args.grad_clip,
+        prefetch=args.prefetch if args.prefetch is not None else env_prefetch,
         profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"

@@ -148,6 +148,9 @@ def bench_mode(
         idle_reads = statistics.mean(p["reads"] for p in io_per_pass)
         idle_writes = statistics.mean(p["writes"] for p in io_per_pass)
         idle_scans = statistics.mean(p["scans"] for p in io_per_pass)
+        idle_serializations = statistics.mean(
+            p["serializations"] for p in io_per_pass
+        )
         result = {
             "mode": mode,
             "jobs": n_jobs,
@@ -158,6 +161,7 @@ def bench_mode(
             "idle_reads_per_pass": round(idle_reads, 2),
             "idle_writes_per_pass": round(idle_writes, 2),
             "idle_scans_per_pass": round(idle_scans, 2),
+            "idle_serializations_per_pass": round(idle_serializations, 2),
             "submit_s": round(submit_s, 3),
             "launch_pass_s": round(launch_pass_s, 3),
             "finish_pass_s": round(finish_pass_s, 3),

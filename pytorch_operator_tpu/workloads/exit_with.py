@@ -10,9 +10,17 @@ immediately: each step heartbeats via rendezvous.report_progress and
 checksum sidecar; on restart the loop resumes after the last
 VERIFIED-GOOD step. Combined with a ``TPUJOB_FAULT_PLAN`` (faults/) this
 gives e2e chaos tests a real subprocess casualty — crash at an exact
-step, stalled rendezvous, failed/torn checkpoint writes — with no jax
-import and no mocks.
+step, stalled rendezvous, failed/torn/disk-full checkpoint writes — with
+no jax import and no mocks.
 ``--step-time S`` — sleep per step (keeps incarnations observable).
+``--async-checkpoint`` — commit step checkpoints through the shared
+AsyncCheckpointWriter (checkpoint/async_writer.py): inflight fence at
+submit, sidecar at commit, exit drains. The crash-consistency chaos
+tests kill this process mid-commit and assert the restart resumes from
+the last sidecar-verified step.
+``--commit-time S`` — sleep inside each commit BETWEEN the state write
+and the sidecar (async mode): widens the mid-commit window so a kill
+deterministically lands while a step is fenced-but-uncommitted.
 """
 
 import argparse
@@ -28,31 +36,59 @@ from ..checkpoint import integrity
 from ..runtime import rendezvous
 
 
-def _save_step_checkpoint(root: Path, step: int) -> None:
+def _commit_step_checkpoint(
+    root: Path, step: int, fault, commit_time: float = 0.0
+) -> None:
     """Commit ``root/<step>/state.json`` + sidecar, honoring the
     checkpoint-write faults exactly like the orbax manager does: a
-    transient failure is retried on the shared backoff, a torn write
-    lands corrupt bytes under a stale sidecar."""
-    fault = faults.checkpoint_write_fault()
+    transient failure is retried on the shared backoff, an enospc
+    failure persists through every retry (the partial step is cleaned
+    before the error propagates), a torn write lands corrupt bytes
+    under a stale sidecar. Shared by the sync path (caller thread) and
+    the async path (writer commit thread)."""
+    import shutil
 
     def attempt():
         nonlocal fault
         if fault == "fail":
             fault = None  # transient: only the first attempt fails
             raise OSError("injected transient checkpoint write failure")
+        if fault == "enospc":
+            import errno
+
+            raise OSError(errno.ENOSPC, "injected: no space left on device")
         d = root / str(step)
         d.mkdir(parents=True, exist_ok=True)
         (d / "state.json").write_text(json.dumps({"step": step}))
 
-    retry_call(
-        attempt,
-        backoff=Backoff(base_s=0.01, cap_s=0.1, seed=step),
-        attempts=3,
-        retry_on=(OSError,),
-    )
+    try:
+        retry_call(
+            attempt,
+            backoff=Backoff(base_s=0.01, cap_s=0.1, seed=step),
+            attempts=3,
+            retry_on=(OSError,),
+        )
+    except OSError:
+        # Retries exhausted: no partial step may survive (a sidecar-less
+        # directory would restore as a legacy "unknown" step).
+        shutil.rmtree(root / str(step), ignore_errors=True)
+        raise
+    if commit_time:
+        # Mid-commit window for the kill-mid-async-commit chaos test:
+        # state written, sidecar not yet — the step is fenced inflight.
+        time.sleep(commit_time)
     integrity.write_sidecar(root, step)
     if fault == "torn":
         integrity.corrupt_step(root, step, mode="truncate")
+
+
+def _report_save_failed(step: int, err) -> None:
+    print(
+        f"[exit_with] checkpoint save of step {step} failed after "
+        f"retries ({err}); continuing",
+        flush=True,
+    )
+    rendezvous.report("checkpoint_save_failed", step=step, error=str(err))
 
 
 def _restore_step(root: Path) -> int:
@@ -77,19 +113,47 @@ def _restore_step(root: Path) -> int:
     return 0
 
 
-def _run_steps(steps: int, step_time: float) -> int:
+def _run_steps(
+    steps: int,
+    step_time: float,
+    async_checkpoint: bool = False,
+    commit_time: float = 0.0,
+) -> int:
     rendezvous.fault_stall_if_armed()  # the rendezvous-join stand-in
     ckpt = os.environ.get("TPUJOB_CHECKPOINT_DIR")
     root = Path(ckpt) if ckpt else None
     start = _restore_step(root) if root is not None else 0
+    writer = None
+    if async_checkpoint and root is not None:
+        from ..checkpoint.async_writer import AsyncCheckpointWriter
+
+        writer = AsyncCheckpointWriter(
+            lambda s, _payload, fault: _commit_step_checkpoint(
+                root, s, fault, commit_time
+            ),
+            root=root,
+            on_error=_report_save_failed,
+        )
     rendezvous.report_first_step(start + 1)
     for step in range(start + 1, steps + 1):
         rendezvous.report_progress(step, steps_per_sec=1.0 / max(step_time, 1e-6))
         faults.crash_if_due(step)
         if root is not None:
-            _save_step_checkpoint(root, step)
+            fault = faults.checkpoint_write_fault()
+            if writer is not None:
+                writer.submit(step, None, fault)
+            else:
+                try:
+                    _commit_step_checkpoint(root, step, fault)
+                except OSError as e:
+                    # Disk-full (enospc) after retries: the step loop
+                    # survives — recovery falls back to the last
+                    # verified step.
+                    _report_save_failed(step, e)
         if step_time:
             time.sleep(step_time)
+    if writer is not None:
+        writer.close()  # exit drains: every submitted save is decided
     print(f"[exit_with] completed {steps} steps (resumed from {start})", flush=True)
     return 0
 
@@ -101,11 +165,18 @@ def main() -> int:
     p.add_argument("--sleep", type=float, default=0.0)
     p.add_argument("--steps", type=int, default=0)
     p.add_argument("--step-time", type=float, default=0.0)
+    p.add_argument("--async-checkpoint", action="store_true")
+    p.add_argument("--commit-time", type=float, default=0.0)
     args = p.parse_args()
     if args.sleep:
         time.sleep(args.sleep)
     if args.steps:
-        rc = _run_steps(args.steps, args.step_time)
+        rc = _run_steps(
+            args.steps,
+            args.step_time,
+            async_checkpoint=args.async_checkpoint,
+            commit_time=args.commit_time,
+        )
         sys.stdout.flush()
         return rc
     restart = int(os.environ.get("TPUJOB_RESTART_COUNT", "0"))

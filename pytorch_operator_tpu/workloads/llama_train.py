@@ -65,6 +65,7 @@ def run(
     eval_batches: int = 8,
     checkpoint_every: int = 0,
     async_checkpoint: bool = False,
+    prefetch: int = 0,
     max_steps: int | None = None,
     remat: bool | None = None,
     remat_policy: str | None = None,
@@ -230,16 +231,12 @@ def run(
     log(f"[llama] {n_params/1e6:.1f}M params, sharded init +{time.time()-t_init:.1f}s")
 
     # Donate the train state into the step (in-place update, ~one state
-    # copy of HBM freed) unless async checkpointing needs the returned
-    # state alive under an in-flight save.
+    # copy of HBM freed). Safe WITH --async-checkpoint too: save()
+    # snapshots the state to host before returning, so the in-flight
+    # commit reads its own copy while the next step donates the
+    # original (checkpoint/async_writer.py).
     if donate is None:
-        donate = not async_checkpoint
-    elif donate and async_checkpoint:
-        raise ValueError(
-            "--donate is incompatible with --async-checkpoint: the "
-            "overlapped orbax save reads the state the next step would "
-            "donate (write into in place)"
-        )
+        donate = True
     train_step = make_lm_train_step(
         model, tx, mesh, microbatches=pp_microbatches,
         pp_schedule=pp_schedule, donate=donate, grad_accum=grad_accum,
@@ -336,19 +333,15 @@ def run(
     if data_file:
         loader, _ = open_token_file(data_file, "--data-file", seed=0)
 
-        def batches(step: int):
-            maybe_preempt(step)
-            return put_global(next_tokens(loader), batch_sharding)
+        def host_batch(step: int):
+            return next_tokens(loader)  # ascontiguousarray = slot copy
 
     else:
 
-        def batches(step: int):
-            maybe_preempt(step)
-            return put_global(
-                synthetic_bigram_batch(batch, seq_len, cfg.vocab_size, step),
-                batch_sharding,
-            )
+        def host_batch(step: int):
+            return synthetic_bigram_batch(batch, seq_len, cfg.vocab_size, step)
 
+    prefetcher = None
     # The try spans everything from here: a failure anywhere before or
     # during the loop (corrupt checkpoint, trainer validation) must not
     # leak the native loader's prefetch thread/mmap.
@@ -397,6 +390,34 @@ def run(
         if max_steps is not None:
             steps = max(min(steps, max_steps - start_step - max(warmup, 1)), 0)
 
+        # The device feed is built AFTER resume: the prefetcher's step
+        # counter starts where the loop will (start_step), and the
+        # data-file fast-forward above must finish before a background
+        # thread starts pulling the loader.
+        if prefetch > 0:
+            import itertools
+
+            from ..data.device_prefetch import DevicePrefetcher
+
+            _feed_steps = itertools.count(start_step)
+            prefetcher = DevicePrefetcher(
+                lambda: host_batch(next(_feed_steps)),
+                put=lambda toks: put_global(toks, batch_sharding),
+                depth=prefetch,
+            )
+
+            def batches(step: int):
+                maybe_preempt(step)
+                # Already device-resident: batch step+prefetch is being
+                # transferred on the feed thread while this step runs.
+                return prefetcher.get()
+
+        else:
+
+            def batches(step: int):
+                maybe_preempt(step)
+                return put_global(host_batch(step), batch_sharding)
+
         def on_first():
             rendezvous.report_first_step(start_step)
 
@@ -440,6 +461,8 @@ def run(
                 ),
             )
     finally:
+        if prefetcher is not None:
+            prefetcher.close()
         if loader is not None:
             loader.close()
     if mgr is not None:
@@ -578,9 +601,19 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument(
         "--async-checkpoint", action="store_true",
-        help="overlap orbax saves with training (committed by job end; a "
-        "preemption may lose the in-flight save and resume one interval "
-        "earlier)",
+        help="overlap checkpoint commits with training: the step loop "
+        "pays only the host snapshot; the write + checksum sidecar land "
+        "on a background commit thread (verified at commit). Committed "
+        "by job end; a preemption may lose the in-flight save and "
+        "resume one interval earlier. Default: spec.data_plane / "
+        "TPUJOB_ASYNC_CHECKPOINT",
+    )
+    p.add_argument(
+        "--prefetch", type=int, default=None, metavar="DEPTH",
+        help="double-buffered device feed: keep DEPTH batches "
+        "device-resident ahead of the step loop (host→device transfer "
+        "overlaps compute on a feed thread; 0 = inline). Default: "
+        "spec.data_plane / TPUJOB_PREFETCH",
     )
     p.add_argument("--max-steps", type=int, default=None)
     p.add_argument(
@@ -604,9 +637,9 @@ def main(argv=None) -> int:
     p.add_argument(
         "--donate", action=argparse.BooleanOptionalAction, default=None,
         help="donate the train state into the jitted step (in-place "
-        "update, ~one state copy of HBM freed). Default: on unless "
-        "--async-checkpoint (whose overlapped save needs the old "
-        "buffers intact)",
+        "update, ~one state copy of HBM freed). Default: on — safe "
+        "even with --async-checkpoint, whose save snapshots the state "
+        "to host before the next step can donate it",
     )
     p.add_argument(
         "--attn-impl", choices=("dense", "flash", "ring", "ulysses"),
@@ -683,6 +716,9 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
+    from .trainer import data_plane_env_defaults
+
+    env_async, env_prefetch = data_plane_env_defaults()
     world = rendezvous.initialize_from_env()
     result = run(
         config=args.config,
@@ -701,7 +737,8 @@ def main(argv=None) -> int:
         eval_file=args.eval_file,
         eval_batches=args.eval_batches,
         checkpoint_every=args.checkpoint_every,
-        async_checkpoint=args.async_checkpoint,
+        async_checkpoint=args.async_checkpoint or env_async,
+        prefetch=args.prefetch if args.prefetch is not None else env_prefetch,
         max_steps=args.max_steps,
         remat=True if args.remat else None,
         remat_policy=args.remat_policy,

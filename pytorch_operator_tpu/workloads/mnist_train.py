@@ -37,7 +37,17 @@ def main(argv=None) -> int:
         "prefetch loader (see pytorch_operator_tpu.data.pack) instead of "
         "the in-memory dataset",
     )
+    p.add_argument(
+        "--prefetch", type=int, default=None, metavar="DEPTH",
+        help="with --data-file: double-buffered device feed — keep DEPTH "
+        "batches device-resident ahead of the step loop (0 = inline "
+        "transfers). Default: spec.data_plane / TPUJOB_PREFETCH",
+    )
     args = p.parse_args(argv)
+    from .trainer import data_plane_env_defaults
+
+    _, env_prefetch = data_plane_env_defaults()
+    prefetch = args.prefetch if args.prefetch is not None else env_prefetch
 
     world = rendezvous.initialize_from_env()
 
@@ -121,7 +131,11 @@ def main(argv=None) -> int:
 
     # Train-batch source: in-memory shuffle, or the native prefetch loader
     # streaming from a packed array file (the gather then overlaps device
-    # compute on a background C++ thread).
+    # compute on a background C++ thread). epoch_iter yields DEVICE
+    # global batches either way, so the step loop below is feed-agnostic.
+    def put_xy(x, y):
+        return global_batch(x, mesh), global_batch(y, mesh)
+
     loader = None
     if args.data_file:
         from ..data import open_training_loader
@@ -138,16 +152,36 @@ def main(argv=None) -> int:
             )
             loader.close()
             return 1
+        if prefetch > 0:
+            # Double-buffered device feed: the slot copy AND the
+            # host→device transfer ride the feed thread; the step loop
+            # pops ready device arrays (data/device_prefetch.py).
+            from ..data import prefetch_to_device
 
-        def epoch_iter(epoch):
-            for _ in range(loader.batches_per_epoch):
-                _, _, fields = loader.next_batch()
-                yield fields["x"], fields["y"]
+            loader = prefetch_to_device(
+                loader, depth=prefetch,
+                put=lambda f: put_xy(f["x"], f["y"]),
+            )
+
+            def epoch_iter(epoch):
+                for _ in range(loader.batches_per_epoch):
+                    _, _, dev = loader.next_batch()
+                    yield dev
+
+        else:
+
+            def epoch_iter(epoch):
+                for _ in range(loader.batches_per_epoch):
+                    _, _, fields = loader.next_batch()
+                    yield put_xy(fields["x"], fields["y"])
 
     else:
 
         def epoch_iter(epoch):
-            yield from epoch_batches(x_train, y_train, batch, seed=args.seed + epoch)
+            for bx, by in epoch_batches(
+                x_train, y_train, batch, seed=args.seed + epoch
+            ):
+                yield put_xy(bx, by)
 
     from .trainer import ProgressHeartbeat
 
@@ -168,9 +202,7 @@ def main(argv=None) -> int:
     )
     try:
         for epoch in range(args.epochs):
-            for bx, by in epoch_iter(epoch):
-                gx = global_batch(bx, mesh)
-                gy = global_batch(by, mesh)
+            for gx, gy in epoch_iter(epoch):
                 params, opt_state, loss = train_step(params, opt_state, gx, gy)
                 if step == 0:
                     float(jax.device_get(loss))  # real fence (not block_until_ready)

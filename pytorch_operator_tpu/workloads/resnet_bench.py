@@ -156,6 +156,7 @@ def run_benchmark(
     momentum: float = 0.9,
     windows: int = 1,
     data_file: str | None = None,
+    prefetch: int = 0,
     profile_dir: str | None = None,
     bn_f32_stats: bool = True,
     s2d_stem: bool = False,
@@ -248,7 +249,7 @@ def run_benchmark(
 
         next_batches, loader = open_image_feed(
             data_file, batch=batch, chunk=chunk, classes=classes, mesh=mesh,
-            meta=file_meta,
+            meta=file_meta, prefetch=prefetch,
         )
         train_chunk = make_train_chunk_fed(model, tx)
     else:
@@ -385,12 +386,22 @@ def main(argv=None) -> int:
         "then includes the input pipeline.",
     )
     p.add_argument(
+        "--prefetch", type=int, default=None, metavar="DEPTH",
+        help="with --data-file: double-buffered device feed — keep DEPTH "
+        "stacked chunks device-resident ahead of the step loop (loader "
+        "pulls, stacking copy and device_put all ride a feed thread; "
+        "0 = inline). Default: spec.data_plane / TPUJOB_PREFETCH",
+    )
+    p.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace of the timed window here",
     )
     p.add_argument("--json", action="store_true", help="print a JSON result line")
     args = p.parse_args(argv)
 
+    from .trainer import data_plane_env_defaults
+
+    _, env_prefetch = data_plane_env_defaults()
     world = rendezvous.initialize_from_env()
     result = run_benchmark(
         depth=args.depth,
@@ -403,6 +414,7 @@ def main(argv=None) -> int:
         momentum=args.momentum,
         windows=args.windows,
         data_file=args.data_file,
+        prefetch=args.prefetch if args.prefetch is not None else env_prefetch,
         profile_dir=args.profile_dir,
         bn_f32_stats=not args.bn_bf16_stats,
         s2d_stem=args.s2d_stem,

@@ -56,6 +56,23 @@ def init_sharded_train_state(model_init: Callable, tx, mesh):
     return init_sharded(init_state, mesh, jax.random.key(int(os.environ.get("TPUJOB_SEED", "0"))))
 
 
+def data_plane_env_defaults() -> tuple:
+    """``(async_checkpoint, prefetch)`` defaults from the supervisor-
+    injected ``spec.data_plane`` env (``TPUJOB_ASYNC_CHECKPOINT`` /
+    ``TPUJOB_PREFETCH``, runtime/env.py) — the one place every workload's
+    ``--async-checkpoint`` / ``--prefetch`` flags read the spec knobs, so
+    the env contract cannot drift per workload. Explicit flags win."""
+    async_ckpt = os.environ.get("TPUJOB_ASYNC_CHECKPOINT", "").lower() in (
+        "1",
+        "true",
+    )
+    try:
+        prefetch = int(os.environ.get("TPUJOB_PREFETCH", "0"))
+    except ValueError:
+        prefetch = 0
+    return async_ckpt, max(prefetch, 0)
+
+
 def probe_image_file(data_file: str):
     """Pre-model geometry probe: ``(meta, x_field_or_None)`` — the one
     place both benches read image shape from a packed file (full
@@ -77,6 +94,7 @@ def open_image_feed(
     square: bool = False,
     seed: int = 0,
     meta=None,
+    prefetch: int = 0,
 ):
     """Validate + open a packed image file and return ``(next_batches,
     loader)`` — the real-data feed both image benches share (one
@@ -92,7 +110,15 @@ def open_image_feed(
     rows and silently deflate the loss (the same gap the token path's
     field_range scan closes). ``square=True`` additionally requires
     H == W (ViT's position embeddings; ResNet is
-    spatial-size-independent). Caller owns ``loader.close()``.
+    spatial-size-independent). Caller owns ``loader.close()`` —
+    with ``prefetch > 0`` the returned "loader" is the device
+    prefetcher facade (closing it closes the real loader too).
+
+    ``prefetch=N`` moves the whole host side — loader pulls, stacking
+    copy, and the ``device_put`` — onto a background feed thread with N
+    stacked chunks of device lookahead (data/device_prefetch.py):
+    ``next_batches()`` then just pops ready device arrays, zero
+    transfers on the step path.
     """
     import jax
     import jax.numpy as jnp
@@ -136,14 +162,35 @@ def open_image_feed(
     )
     x_sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
 
-    def next_batches():
+    def host_batches():
         sx = np.empty((chunk, batch) + field_x.shape, jnp.bfloat16)
         sy = np.empty((chunk, batch), np.int32)
         for i in range(chunk):
             _, _, fields = loader.next_batch()
-            sx[i] = fields["x"]  # casts f32 → bf16 in place
-            sy[i] = fields["y"]
+            sx[i] = fields["x"]  # casts f32 → bf16 in place (a copy —
+            sy[i] = fields["y"]  # the borrowed slot never escapes)
+        return sx, sy
+
+    def put_pair(pair):
+        sx, sy = pair
         return put_global(sx, x_sh), put_global(sy, x_sh)
+
+    if prefetch > 0:
+        from ..data.device_prefetch import DevicePrefetcher
+
+        pf = DevicePrefetcher(host_batches, put=put_pair, depth=prefetch)
+
+        class _Feed:
+            """Caller-owned close handle: prefetcher first, then loader."""
+
+            def close(self):
+                pf.close()
+                loader.close()
+
+        return pf.get, _Feed()
+
+    def next_batches():
+        return put_pair(host_batches())
 
     return next_batches, loader
 
@@ -299,12 +346,12 @@ def make_lm_train_step(
 
     ``donate=True`` donates the state (params + optimizer) into the step,
     letting XLA update it in place instead of holding a second copy —
-    for the 0.3b config that is ~3.8 GB of HBM freed for batch. It is
-    UNSAFE with async checkpointing (llama_train --async-checkpoint
-    hands the returned state to an in-flight orbax save while the next
-    step runs; donation would invalidate the buffers mid-write), so
-    callers must pass donate=False whenever saves overlap steps —
-    blocking saves are fine (they complete before the next step call).
+    for the 0.3b config that is ~3.8 GB of HBM freed for batch. Safe
+    with async checkpointing too: ``CheckpointManager.save(block=False)``
+    snapshots the state to host BEFORE returning (async_writer.py), so
+    the in-flight commit owns its own copy while the next step donates
+    the original. (Callers driving orbax's own async machinery directly
+    — without the snapshot — must still keep donation off.)
 
     ``grad_accum=N`` splits the global batch into N sequential
     microbatches inside ONE jitted step (``lax.scan`` over the leading

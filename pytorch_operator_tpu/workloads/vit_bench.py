@@ -100,6 +100,7 @@ def run_benchmark(
     remat: bool = False,
     remat_policy: str = "full",
     data_file: str | None = None,
+    prefetch: int = 0,
     profile_dir: str | None = None,
     log=print,
 ) -> dict:
@@ -171,7 +172,7 @@ def run_benchmark(
 
         next_batches, loader = open_image_feed(
             data_file, batch=batch, chunk=chunk, classes=classes, mesh=mesh,
-            square=True, meta=file_meta,
+            square=True, meta=file_meta, prefetch=prefetch,
         )
         train_chunk = make_train_chunk_fed(model, tx)
     else:
@@ -290,10 +291,20 @@ def main(argv=None) -> int:
         "(pack with pytorch_operator_tpu.data.pack); image geometry "
         "comes from the file, throughput includes the input pipeline",
     )
+    p.add_argument(
+        "--prefetch", type=int, default=None, metavar="DEPTH",
+        help="with --data-file: double-buffered device feed — keep DEPTH "
+        "stacked chunks device-resident ahead of the step loop (loader "
+        "pulls, stacking copy and device_put all ride a feed thread; "
+        "0 = inline). Default: spec.data_plane / TPUJOB_PREFETCH",
+    )
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
+    from .trainer import data_plane_env_defaults
+
+    _, env_prefetch = data_plane_env_defaults()
     world = rendezvous.initialize_from_env()
     result = run_benchmark(
         variant=args.variant,
@@ -308,6 +319,7 @@ def main(argv=None) -> int:
         remat=args.remat,
         remat_policy=args.remat_policy,
         data_file=args.data_file,
+        prefetch=args.prefetch if args.prefetch is not None else env_prefetch,
         profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"

@@ -44,6 +44,7 @@ def _flip_then_interrupt(state, mutate, delay=1.2):
             store = JobStore(persist_dir=state / "jobs")
             job = store.reload("default/cli-job")
             mutate(job)
+            job.touch()  # mutate-then-touch: the store's dirty contract
             store.update(job)
         finally:
             _time.sleep(delay)

@@ -38,6 +38,9 @@ class TestBenchSmoke:
         # file I/O back on the steady-state path trips this.
         assert cached["idle_reads_per_pass"] == 0
         assert cached["idle_writes_per_pass"] == 0
+        # O(1) clean check (TPUJob generation counter): the idle pass
+        # does not even SERIALIZE a job to discover it is clean.
+        assert cached["idle_serializations_per_pass"] == 0
         # One scandir snapshot serves rescan + all marker scans.
         assert cached["idle_scans_per_pass"] <= 1.0
 

@@ -1,0 +1,89 @@
+"""Data-plane bench smoke lane (``-m bench_smoke``, also tier-1).
+
+Runs the real harness at a small size — few steps, small model, real
+orbax saves — pinning the two data-plane invariants long before anyone
+reruns the full BENCH_dataplane.json artifact:
+
+- an ASYNC save stalls the step loop LESS than a blocking save of the
+  same state (the whole point of the async writer), while still ending
+  sidecar-verified;
+- a PREFETCHED loop issues ZERO ``device_put`` calls on the step path
+  (the transfers all ride the feed thread).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import tests.jaxenv  # noqa: F401  (forces CPU backend with 8 devices)
+
+from pytorch_operator_tpu.workloads import dataplane_bench
+
+pytestmark = pytest.mark.bench_smoke
+
+
+@pytest.fixture(scope="module")
+def smoke_result(tmp_path_factory):
+    td = tmp_path_factory.mktemp("dataplane")
+    # Small but real: 15 steps, 3 timed saves per cell, ~1.5 MB state.
+    return dataplane_bench.run(
+        steps=15, checkpoint_every=5, dim=128, batch=128,
+        work_dir=str(td), log=lambda *_: None,
+    )
+
+
+def cell(result, ckpt, feed):
+    return next(
+        c for c in result["cells"] if c["ckpt"] == ckpt and c["feed"] == feed
+    )
+
+
+class TestDataPlaneSmoke:
+    def test_async_save_stalls_less_than_blocking(self, smoke_result):
+        blocking = cell(smoke_result, "blocking", "inline")
+        async_ = cell(smoke_result, "async", "inline")
+        # THE tier-1 invariant: on the same state, the async save's
+        # step-loop stall must undercut the blocking save's. (The full
+        # artifact pins the >=5x ratio; smoke sizes only guarantee the
+        # ordering.)
+        assert async_["stall_ms_p50"] < blocking["stall_ms_p50"], (
+            async_,
+            blocking,
+        )
+        assert blocking["stall_ms_p50"] > 0
+
+    def test_prefetched_loop_zero_inline_device_puts(self, smoke_result):
+        for ckpt in ("blocking", "async"):
+            pf = cell(smoke_result, ckpt, "prefetched")
+            inline = cell(smoke_result, ckpt, "inline")
+            # Zero transfers on the step path vs one per step inline.
+            assert pf["step_thread_device_puts"] == 0, pf
+            assert inline["step_thread_device_puts"] == inline["steps"]
+
+    def test_every_cell_ends_sidecar_verified(self, smoke_result):
+        # Async saves are first-class VERIFIED checkpoints: the newest
+        # verified step equals the newest saved step in every cell.
+        for c in smoke_result["cells"]:
+            assert c["all_saves_verified"], c
+            assert c["last_verified_step"] == c["steps"]
+
+    def test_artifact_shape_is_committed_schema(self, smoke_result, tmp_path):
+        out = tmp_path / "bench.json"
+        dataplane_bench.run(
+            steps=6, checkpoint_every=3, dim=64, batch=32,
+            out=str(out), work_dir=str(tmp_path), log=lambda *_: None,
+        )
+        data = json.loads(out.read_text())
+        assert data["bench"] == "data_plane"
+        comp = data["comparisons"]
+        for field in (
+            "ckpt_stall_p50_reduction",
+            "ckpt_stall_p99_reduction",
+            "steps_per_sec_speedup_async",
+            "prefetched_step_thread_puts",
+            "async_saves_verified",
+        ):
+            assert field in comp
+        assert comp["async_saves_verified"] is True

@@ -38,9 +38,57 @@ class TestDirtyTracking:
         key = store.add(new_job(name="dirty"))
         job = store.get(key)
         job.status.restart_count = 7
+        job.touch()  # the mutator contract (set_condition does this)
         store.update(job)
         on_disk = json.loads(job_path(tmp_path / "jobs", key).read_text())
         assert on_disk["status"]["restart_count"] == 7
+
+    def test_set_condition_marks_dirty_without_explicit_touch(self, tmp_path):
+        """The central mutators bump the generation themselves — the
+        reconciler never calls touch() around set_condition."""
+        from pytorch_operator_tpu.api.types import ConditionType
+
+        store = JobStore(persist_dir=tmp_path / "jobs")
+        key = store.add(new_job(name="cond"))
+        job = store.get(key)
+        job.set_condition(ConditionType.RUNNING, reason="T", message="t")
+        store.update(job)
+        on_disk = json.loads(job_path(tmp_path / "jobs", key).read_text())
+        assert on_disk["status"]["conditions"], "condition change not persisted"
+
+    def test_clean_check_is_o1_no_serialization(self, tmp_path):
+        """THE control-plane follow-on pin (ROADMAP): an idle update must
+        not even call to_dict() — the clean check is one generation
+        compare, so a 10k-job fleet's steady pass serializes nothing."""
+        store = JobStore(persist_dir=tmp_path / "jobs")
+        key = store.add(new_job(name="o1"))
+        base = store.io.serializations
+        for _ in range(25):
+            store.update(store.get(key))
+        assert store.io.serializations == base
+        assert store.io.writes_skipped >= 25
+        # A touched-but-identical job pays ONE serialization (content
+        # dedupe), then returns to the O(1) path.
+        job = store.get(key)
+        job.touch()
+        store.update(job)
+        assert store.io.serializations == base + 1
+        store.update(store.get(key))
+        assert store.io.serializations == base + 1
+
+    def test_new_object_for_known_key_bypasses_generation_gate(self, tmp_path):
+        """A FRESH object handed to update() (apply/failover flows) must
+        not be mistaken for clean just because its generation matches
+        the recorded one."""
+        from tests.testutil import new_job as make
+
+        store = JobStore(persist_dir=tmp_path / "jobs")
+        key = store.add(make(name="swap"))
+        replacement = make(name="swap")
+        replacement.status.restart_count = 9  # same generation (0), new bytes
+        store.update(replacement)
+        on_disk = json.loads(job_path(tmp_path / "jobs", key).read_text())
+        assert on_disk["status"]["restart_count"] == 9
 
     def test_loaded_store_does_not_rewrite_clean_jobs(self, tmp_path):
         store = JobStore(persist_dir=tmp_path / "jobs")
@@ -78,6 +126,7 @@ class TestExternalInvalidation:
         writer = JobStore(persist_dir=d)
         job = writer.get(key)
         job.status.restart_count = 3
+        job.touch()
         writer.update(job)
         assert observer.get(key).status.restart_count == 0  # cached
         assert observer.reload(key).status.restart_count == 3
@@ -94,6 +143,7 @@ class TestExternalInvalidation:
         store.reload(key)
         job = store.get(key)
         job.status.restart_count = 1
+        job.touch()
         store.update(job)
         assert (
             json.loads(job_path(d, key).read_text())["status"]["restart_count"]

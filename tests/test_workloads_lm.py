@@ -141,14 +141,41 @@ def test_remat_policy_without_remat_refused():
         )
 
 
-def test_donate_with_async_checkpoint_refused(tmp_path, monkeypatch):
+def test_donate_composes_with_async_checkpoint(tmp_path, monkeypatch):
+    """save(block=False) snapshots the state to host BEFORE returning
+    (checkpoint/async_writer.py), so donation no longer tears in-flight
+    commits: the donated run's async-saved steps must all verify."""
+    from pytorch_operator_tpu.checkpoint import CheckpointManager
+
     monkeypatch.setenv("TPUJOB_CHECKPOINT_DIR", str(tmp_path))
-    with pytest.raises(ValueError, match="donate.*async"):
-        llama_train.run(
-            config="tiny", batch_size=2, seq_len=16, steps=2,
-            checkpoint_every=1, async_checkpoint=True, donate=True,
-            log=lambda *_: None,
-        )
+    llama_train.run(
+        config="tiny", batch_size=2, seq_len=16, steps=3, warmup=1,
+        checkpoint_every=2, async_checkpoint=True, donate=True,
+        log=lambda *_: None,
+    )
+    with CheckpointManager(tmp_path, create=False) as mgr:
+        steps = mgr.all_steps()
+        assert steps, "async run committed no checkpoints"
+        # Sidecar-at-commit: the newest VERIFIED step is the newest step.
+        assert mgr.latest_verified_step() == steps[-1]
+
+
+def test_prefetched_feed_is_batch_for_batch_identical(tmp_path):
+    """--prefetch must not change WHAT trains, only WHERE the transfer
+    happens: the double-buffered feed produces the same batch sequence
+    as the inline path, so two same-seed runs land the same final
+    loss."""
+    from pytorch_operator_tpu.workloads import llama_train
+
+    kw = dict(
+        config="tiny", mesh_spec="dp=8", batch_size=8, seq_len=32,
+        steps=3, warmup=1, log=lambda *_: None,
+    )
+    inline = llama_train.run(**kw)
+    prefetched = llama_train.run(prefetch=2, **kw)
+    assert prefetched["final_loss"] == pytest.approx(
+        inline["final_loss"], abs=1e-5
+    )
 
 
 def test_llama_trains_from_packed_text_file(tmp_path):
