@@ -15,6 +15,7 @@ from .types import (  # noqa: F401
     ElasticPolicy,
     JobCondition,
     ObjectMeta,
+    ObservabilityPolicy,
     ProcessTemplate,
     ReplicaPhase,
     ReplicaSpec,

@@ -413,6 +413,29 @@ class DataPlanePolicy:
 
 
 @dataclass
+class ObservabilityPolicy:
+    """Flight-recorder knobs (obs/).
+
+    ``trace: true`` makes the supervisor inject a per-job
+    ``TPUJOB_TRACE_DIR`` into every replica (runtime/env.py), so the
+    step loop, device feed, rendezvous join, and async checkpoint
+    commits record spans to per-process ring files that ``tpujob trace
+    <job>`` merges into one Chrome-trace/Perfetto JSON. Off (the
+    default) the span helpers are a cached None check — zero step-path
+    overhead, pinned by the bench_smoke lane.
+    """
+
+    trace: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"trace": True} if self.trace else {}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ObservabilityPolicy":
+        return cls(trace=bool(d.get("trace", False)))
+
+
+@dataclass
 class TPUJobSpec:
     """The TPUJob spec (reference: PyTorchJobSpec — RunPolicy + a map
     ReplicaType→ReplicaSpec with Master exactly-1)."""
@@ -421,6 +444,7 @@ class TPUJobSpec:
     run_policy: RunPolicy = field(default_factory=RunPolicy)
     elastic_policy: Optional[ElasticPolicy] = None
     data_plane: Optional[DataPlanePolicy] = None
+    observability: Optional[ObservabilityPolicy] = None
     # Coordinator (rendezvous) port — the pytorchjob-port analog.
     port: Optional[int] = None  # defaulted to DEFAULT_PORT
 
@@ -438,6 +462,10 @@ class TPUJobSpec:
             d["elastic_policy"] = self.elastic_policy.to_dict()
         if self.data_plane is not None and (dp := self.data_plane.to_dict()):
             d["data_plane"] = dp
+        if self.observability is not None and (
+            ob := self.observability.to_dict()
+        ):
+            d["observability"] = ob
         if self.port is not None:
             d["port"] = self.port
         return d
@@ -462,6 +490,11 @@ class TPUJobSpec:
             data_plane=(
                 DataPlanePolicy.from_dict(d["data_plane"])
                 if d.get("data_plane") is not None
+                else None
+            ),
+            observability=(
+                ObservabilityPolicy.from_dict(d["observability"])
+                if d.get("observability") is not None
                 else None
             ),
             port=_parse_opt_int(d, "port", "spec.port"),

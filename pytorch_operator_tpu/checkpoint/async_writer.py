@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, List, Optional, Tuple
 
 
@@ -123,12 +124,21 @@ class AsyncCheckpointWriter:
         root=None,
         max_pending: int = 2,
         on_error: Optional[Callable[[int, BaseException], None]] = None,
+        on_commit: Optional[Callable[[int, float, int, float], None]] = None,
     ):
         if max_pending < 1:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         self._commit = commit
         self._root = root
         self._on_error = on_error
+        # Commit-telemetry hook: (step, commit_seconds, queue_depth_after,
+        # oldest_inflight_age_seconds) after each successful commit — the
+        # manager and exit_with report it on the status channel so the
+        # supervisor's checkpoint-lag/queue surfaces stay live.
+        self._on_commit = on_commit
+        # step -> submit wall time of in-flight (submitted, undecided)
+        # commits; drives the oldest-inflight-age gauge.
+        self._inflight_ts: dict = {}
         self._slots = threading.Semaphore(max_pending)
         self._q: "queue.Queue" = queue.Queue()
         self._idle = threading.Event()
@@ -149,7 +159,20 @@ class AsyncCheckpointWriter:
         disk before this returns."""
         if self._closed:
             raise RuntimeError("writer is closed")
+        from .. import obs
+
+        t0 = time.perf_counter()
         self._slots.acquire()
+        waited = time.perf_counter() - t0
+        if waited > 1e-4:
+            # Backpressure made the STEP LOOP wait on the commit queue —
+            # exactly the stall the flight recorder exists to show.
+            rec = obs.tracer()
+            if rec is not None:
+                rec.emit(
+                    "ckpt_queue_wait", "ckpt",
+                    time.time() - waited, waited, step=step,
+                )
         if self._root is not None:
             from . import integrity
 
@@ -159,6 +182,7 @@ class AsyncCheckpointWriter:
             # barrier: the queue is briefly empty while the thread is
             # mid-commit, and wait() must not return then.
             self._outstanding += 1
+            self._inflight_ts[step] = time.time()
             self._idle.clear()
             self._ensure_thread()
         self._q.put((step, payload, fault))
@@ -173,22 +197,41 @@ class AsyncCheckpointWriter:
     # ---- commit side (background thread) ----
 
     def _run(self) -> None:
+        from .. import obs
+
         while True:
             item = self._q.get()
             if item is None:
                 return
             step, payload, fault = item
             try:
-                self._commit(step, payload, fault)
+                t0 = time.perf_counter()
+                with obs.span("ckpt_commit", cat="ckpt", step=step):
+                    self._commit(step, payload, fault)
+                commit_s = time.perf_counter() - t0
                 with self._lock:
                     self._last_committed = step
                     self.committed.append(step)
+                    self._inflight_ts.pop(step, None)
+                    depth = self._outstanding - 1
+                    oldest = min(self._inflight_ts.values(), default=None)
+                if self._on_commit is not None:
+                    try:
+                        self._on_commit(
+                            step,
+                            commit_s,
+                            max(depth, 0),
+                            (time.time() - oldest) if oldest else 0.0,
+                        )
+                    except Exception:
+                        pass  # telemetry must never fail a commit
             except BaseException as e:  # noqa: BLE001 — a failed commit
                 # must never take the commit thread (and with it every
                 # queued save) down; the failure is recorded and the
                 # step loop keeps training.
                 with self._lock:
                     self.errors.append((step, e))
+                    self._inflight_ts.pop(step, None)
                 if self._root is not None:
                     from . import integrity
 
@@ -220,6 +263,18 @@ class AsyncCheckpointWriter:
 
     def pending(self) -> bool:
         return not self._idle.is_set()
+
+    def stats(self) -> dict:
+        """Live queue telemetry: submitted-undecided depth and the age
+        of the oldest in-flight commit (0 when idle)."""
+        with self._lock:
+            oldest = min(self._inflight_ts.values(), default=None)
+            return {
+                "queue_depth": self._outstanding,
+                "oldest_inflight_age_s": (
+                    time.time() - oldest if oldest else 0.0
+                ),
+            }
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Drain, stop the commit thread, refuse further submits."""

@@ -177,24 +177,30 @@ class CheckpointManager:
         reported (``checkpoint_save_failed``) and recorded on the
         writer, never raised into the step loop.
         """
-        from .. import faults
+        from .. import faults, obs
 
         fault = faults.checkpoint_write_fault()
         if block:
             self._drain()  # commits stay in submission order
-            self._commit_step(step, state, fault)
+            with obs.span("ckpt_blocking_save", cat="ckpt", step=step):
+                self._commit_step(step, state, fault)
             return
         from .async_writer import AsyncCheckpointWriter, snapshot_to_host
 
         if self._writer is None:
+            from ..runtime.rendezvous import report_checkpoint_committed
+
             self._writer = AsyncCheckpointWriter(
                 self._commit_step,
                 root=self.directory,
                 on_error=self._report_save_failed,
+                on_commit=report_checkpoint_committed,
             )
         # The host snapshot is the ONLY stall the step loop pays: after
         # this line the caller may donate/overwrite the live state.
-        self._writer.submit(step, snapshot_to_host(state), fault)
+        with obs.span("ckpt_snapshot", cat="ckpt", step=step):
+            snap = snapshot_to_host(state)
+        self._writer.submit(step, snap, fault)
 
     def wait(self, timeout: Optional[float] = None) -> None:
         """Public barrier: drain pending async commits."""

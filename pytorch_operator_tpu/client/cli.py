@@ -91,6 +91,22 @@ def _load_fault_plan(path):
         raise SystemExit(f"error: invalid fault plan {path}: {e}")
 
 
+def _arm_cli_tracing(args) -> None:
+    """``--trace``: arm the flight recorder for this process AND every
+    replica it spawns — the supervisor's spans land in
+    ``<state>/trace/``, each job's in ``<state>/trace/<ns>_<job>/``
+    (the reconciler injects the per-job dir whenever process tracing is
+    on). ``tpujob trace <job>`` merges them afterward."""
+    if not getattr(args, "trace", False):
+        return
+    import os
+
+    from pytorch_operator_tpu import obs
+
+    os.environ["TPUJOB_TRACE_DIR"] = str(_state_dir(args) / "trace")
+    obs.reset_tracer()  # re-read the env this process already cached
+
+
 def _run_foreground(args, fault_plan=None, chaos: bool = False) -> int:
     """Shared supervise-to-completion loop behind ``run`` and ``chaos``.
 
@@ -112,6 +128,7 @@ def _run_foreground(args, fault_plan=None, chaos: bool = False) -> int:
         for warning in validate_against_job(fault_plan, job):
             print(f"warning: fault plan: {warning}", file=sys.stderr)
         faults.arm(fault_plan)
+    _arm_cli_tracing(args)
     sup = Supervisor(
         state_dir=_state_dir(args),
         gang_enabled=not args.no_gang,
@@ -161,6 +178,12 @@ def _run_foreground(args, fault_plan=None, chaos: bool = False) -> int:
         sup.shutdown()
         if fault_plan is not None:
             faults.disarm()
+        if getattr(args, "trace", False):
+            from pytorch_operator_tpu import obs
+
+            rec = obs.tracer()
+            if rec is not None:
+                rec.flush()  # buffered supervisor spans, visible now
     if j is None:
         print("job was garbage-collected")
         return 0
@@ -256,6 +279,7 @@ def cmd_supervisor(args) -> int:
         raise KeyboardInterrupt
 
     signal.signal(signal.SIGTERM, _sigterm)
+    _arm_cli_tracing(args)
     sup = Supervisor(
         state_dir=_state_dir(args),
         gang_enabled=not args.no_gang,
@@ -273,11 +297,14 @@ def cmd_supervisor(args) -> int:
     def start_monitoring() -> bool:
         nonlocal monitoring
         from ..controller.monitoring import MonitoringServer, supervisor_health
+        from ..obs import top as obs_top
 
         monitoring = MonitoringServer(
             render_metrics=sup.metrics.render_text,
             health=lambda: supervisor_health(sup),
             port=args.monitoring_port,
+            # `curl :port/top` — the tpujob-top table over HTTP.
+            text_routes={"/top": lambda: obs_top.render(sup.state_dir) + "\n"},
         )
         try:
             print(f"tpujob supervisor: monitoring on 127.0.0.1:{monitoring.start()}")
@@ -476,16 +503,130 @@ def _get_once(args, missing_ok: bool = False, store=None) -> int:
     return 0
 
 
-def cmd_events(args) -> int:
-    """kubectl get events analog: merged per-job event logs, oldest first,
-    bounded by --tail."""
+def cmd_trace(args) -> int:
+    """Merge the supervisor's and every replica's span files into one
+    Chrome-trace/Perfetto JSON for this job (obs/trace.py). Open the
+    output at https://ui.perfetto.dev or chrome://tracing."""
+    from pytorch_operator_tpu.obs import merge_trace_files
+    from pytorch_operator_tpu.obs.trace import span_files
+
+    state = _state_dir(args)
+    key = _resolve_key(args)
+    trace_root = state / "trace"
+    # Replica spans live in the per-job dir the reconciler injected;
+    # supervisor spans (pass phases, per-job reconciles, store I/O)
+    # directly under the root. Rotated ring generations included.
+    paths = span_files(trace_root / key_to_fs(key)) + span_files(trace_root)
+    if not paths:
+        print(
+            f"error: no span files for tpujob {key} under {trace_root} — "
+            "run with --trace or set spec.observability.trace: true",
+            file=sys.stderr,
+        )
+        return 1
+    doc = merge_trace_files(paths)
+    n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    if args.out:
+        Path(args.out).write_text(json.dumps(doc) + "\n")
+        print(
+            f"wrote {args.out}: {n_spans} spans from {len(paths)} file(s) "
+            "(open in https://ui.perfetto.dev)"
+        )
+    else:
+        print(json.dumps(doc))
+    return 0
+
+
+def cmd_top(args) -> int:
+    """Live one-screen fleet table (obs/top.py): per-job step, steps/s,
+    p50/p99 step time, checkpoint lag, feed stall — from the status-dir
+    heartbeats plus the daemon's metrics.prom when present."""
+    from pytorch_operator_tpu.obs import top as obs_top
+
+    state = _state_dir(args)
+    if args.once:
+        print(obs_top.render(state))
+        return 0
+    try:
+        while True:
+            body = obs_top.render(state)
+            # ANSI clear + home — a poor man's curses, dependency-free.
+            sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _follow_events(args, state: Path, key: str) -> int:
+    """``events --follow``: tail one job's event sink, aggregation-aware
+    — the sink appends cumulative-count update records for a repeating
+    event, so the follower re-merges the file each poll
+    (load_merged_events) and re-prints a record whose count grew
+    (crash-loop debugging without re-running describe). Ends when the
+    job record finishes or disappears, after a final drain."""
     from pytorch_operator_tpu.controller.events import load_merged_events
 
+    path = state / "events" / (key_to_fs(key) + ".events.jsonl")
+    store = JobStore(persist_dir=state / "jobs")
+    shown: list = []  # (type, reason, message, count) already printed
+
+    def fmt(rec) -> str:
+        count = int(rec.get("count", 1) or 1)
+        tail = f" (x{count})" if count > 1 else ""
+        return (
+            f"[{rec.get('type', '?')}] {rec.get('reason', '?')}: "
+            f"{rec.get('message', '')}{tail}"
+        )
+
+    def drain() -> None:
+        merged = load_merged_events(path)
+        for i, rec in enumerate(merged):
+            ident = (
+                rec.get("type"), rec.get("reason"), rec.get("message"),
+                int(rec.get("count", 1) or 1),
+            )
+            if i < len(shown):
+                if shown[i] != ident:
+                    # Same position, higher count: the aggregated event
+                    # repeated — reprint with the live count.
+                    print(fmt(rec), flush=True)
+                    shown[i] = ident
+            else:
+                print(fmt(rec), flush=True)
+                shown.append(ident)
+
+    try:
+        while True:
+            job = store.reload(key)
+            finished = job is None or job.is_finished()
+            drain()  # after the finish check: the last pass drains fully
+            if finished:
+                return 0
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_events(args) -> int:
+    """kubectl get events analog: merged per-job event logs, oldest first,
+    bounded by --tail. With a NAME, only that job's; ``--follow`` tails
+    the job's sink live."""
+    from pytorch_operator_tpu.controller.events import load_merged_events
+
+    state = _state_dir(args)
+    if getattr(args, "follow", False):
+        if not args.name:
+            print("error: --follow requires a job NAME", file=sys.stderr)
+            return 2
+        return _follow_events(args, state, _resolve_key(args))
     ev_dir = _state_dir(args) / "events"
     records = []
     if ev_dir.is_dir():
         for p in sorted(ev_dir.glob("*.events.jsonl")):
             obj = fs_to_key(p.name[: -len(".events.jsonl")])
+            if args.name and obj != _resolve_key(args):
+                continue
             # A repeating event appends updated records (cumulative
             # count); the loader collapses runs so one crash-loop warning
             # shows once with its live count, not once per flush.
@@ -897,6 +1038,11 @@ def build_parser() -> argparse.ArgumentParser:
         "this run — failures fire in the supervisor and ride into "
         "replicas via TPUJOB_FAULT_PLAN",
     )
+    sp.add_argument(
+        "--trace", action="store_true",
+        help="record flight-recorder spans (supervisor + every replica) "
+        "under <state>/trace/ for `tpujob trace`",
+    )
     sp.set_defaults(func=cmd_run)
 
     sp = sub.add_parser(
@@ -909,6 +1055,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--timeout", type=float, default=None)
     sp.add_argument("--no-gang", action="store_true")
     sp.add_argument("--max-slots", type=int, default=None)
+    sp.add_argument(
+        "--trace", action="store_true",
+        help="record flight-recorder spans during the chaos run "
+        "(`tpujob trace` shows the failure timeline)",
+    )
     sp.set_defaults(func=cmd_chaos)
 
     sp = sub.add_parser("submit", help="queue a job for a running supervisor")
@@ -957,6 +1108,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="keep N pre-warmed standby processes (interpreter + jax "
         "imports already paid) and hand module-template replicas to "
         "them — cuts schedule-to-first-step latency (0 = off)",
+    )
+    sp.add_argument(
+        "--trace", action="store_true",
+        help="record flight-recorder spans (supervisor + every replica) "
+        "under <state>/trace/ for `tpujob trace`",
     )
     sp.set_defaults(func=cmd_supervisor)
 
@@ -1012,9 +1168,49 @@ def build_parser() -> argparse.ArgumentParser:
         "events", help="merged event log across jobs (kubectl get events)"
     )
     sp.add_argument(
+        "name", nargs="?", default=None,
+        help="only this job's events (required with --follow)",
+    )
+    sp.add_argument(
         "--tail", type=int, default=50, help="show the last N events (0 = all)"
     )
+    sp.add_argument(
+        "-f", "--follow", action="store_true",
+        help="tail the job's event sink live (aggregation-aware: a "
+        "crash-looping event re-prints with its growing count) until "
+        "the job finishes",
+    )
+    add_ns(sp)
     sp.set_defaults(func=cmd_events)
+
+    sp = sub.add_parser(
+        "trace",
+        help="merge a job's flight-recorder span files into one "
+        "Chrome-trace/Perfetto JSON (record with run/supervisor "
+        "--trace or spec.observability.trace)",
+    )
+    sp.add_argument("name")
+    sp.add_argument(
+        "--out", default=None,
+        help="write the trace JSON here (default: stdout)",
+    )
+    add_ns(sp)
+    sp.set_defaults(func=cmd_trace)
+
+    sp = sub.add_parser(
+        "top",
+        help="live fleet table: per-job step, steps/s, p50/p99 step "
+        "time, checkpoint lag, feed stall",
+    )
+    sp.add_argument(
+        "--once", action="store_true",
+        help="print one snapshot and exit (default: refresh loop)",
+    )
+    sp.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh interval in seconds",
+    )
+    sp.set_defaults(func=cmd_top)
 
     sp = sub.add_parser(
         "apply", help="create or update a job from a spec file (kubectl apply)"

@@ -100,6 +100,7 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._counters: Dict[str, Counter] = {}
+        self._histograms: Dict[str, object] = {}
         self.jobs_created = self.counter(
             "tpujob_jobs_created_total", "TPUJobs accepted by the supervisor"
         )
@@ -170,6 +171,83 @@ class MetricsRegistry:
             "signal: a healthy steps/sec with a growing age means the "
             "workload stopped reporting (hung), not that it is training",
         )
+        # ---- flight-recorder surfaces (obs/): latency distributions ----
+        # Counters/gauges above say WHAT happened; these histograms say
+        # where the time went, live, with p50/p99 derivable per scrape.
+        self.sync_pass_seconds = self.histogram(
+            "tpujob_sync_pass_seconds",
+            "Supervisor sync-pass latency by phase (serial scheduling vs "
+            "parallel steady vs total)",
+        )
+        self.reconcile_seconds = self.histogram(
+            "tpujob_reconcile_seconds",
+            "Per-job reconcile duration (all jobs pooled — label-per-job "
+            "would explode series cardinality at fleet scale)",
+        )
+        self.store_persist_seconds = self.histogram(
+            "tpujob_store_persist_seconds",
+            "JobStore persist latency per update (clean skips included — "
+            "the O(1) dirty check IS the distribution's left edge)",
+        )
+        self.store_rescan_seconds = self.histogram(
+            "tpujob_store_rescan_seconds",
+            "JobStore rescan (scandir snapshot + marker scans) latency",
+        )
+        self.step_time_seconds = self.histogram(
+            "tpujob_step_time_seconds",
+            "Per-job training step time, folded from progress heartbeats "
+            "(interval-averaged: 1/steps_per_sec per heartbeat)",
+        )
+        self.checkpoint_commit_seconds = self.histogram(
+            "tpujob_checkpoint_commit_seconds",
+            "Per-job async checkpoint commit duration, folded from "
+            "checkpoint_committed status records",
+        )
+        self.rendezvous_join_seconds = self.histogram(
+            "tpujob_rendezvous_join_seconds",
+            "Worker rendezvous join duration, folded from rendezvous_join "
+            "status records",
+        )
+        # Data-plane companion gauges for the fold (tpujob top columns).
+        self.job_checkpoint_step = self.gauge(
+            "tpujob_job_checkpoint_step",
+            "Newest committed (sidecar-verified) checkpoint step per job — "
+            "checkpoint lag = tpujob_job_step minus this",
+        )
+        self.job_ckpt_queue_depth = self.gauge(
+            "tpujob_job_ckpt_queue_depth",
+            "Async checkpoint writer queue depth at the newest commit",
+        )
+        self.job_ckpt_oldest_age = self.gauge(
+            "tpujob_job_ckpt_oldest_inflight_age_seconds",
+            "Age of the oldest in-flight async checkpoint at the newest "
+            "commit",
+        )
+        self.job_feed_stall = self.gauge(
+            "tpujob_job_feed_stall_ms",
+            "Mean step-loop wait on the device feed per get (0 = the feed "
+            "thread keeps ahead), as reported in progress heartbeats",
+        )
+        # Live mirrors of the bench-only I/O instrumentation: idle-I/O
+        # regressions become visible in production, not just in
+        # BENCH_ctrlplane.json (store deltas folded once per pass).
+        self.store_io = {
+            k: self.counter(
+                f"tpujob_store_{k}_total",
+                f"JobStore persistence-layer {k.replace('_', ' ')} "
+                "(StoreIOCounters, folded per sync pass)",
+            )
+            for k in ("reads", "writes", "writes_skipped", "scans",
+                      "serializations")
+        }
+        self.progress_io = {
+            k: self.counter(
+                f"tpujob_progress_{k}_total",
+                f"Progress-heartbeat tailer {k.replace('_', ' ')} "
+                "(ProgressTailer fold stats, folded per sync pass)",
+            )
+            for k in ("dir_scans", "file_reads", "bytes_read")
+        }
 
     def counter(self, name: str, help_text: str = "") -> Counter:
         if name not in self._counters:
@@ -181,7 +259,17 @@ class MetricsRegistry:
             self._gauges[name] = Gauge(name, help_text)
         return self._gauges[name]
 
+    def histogram(self, name: str, help_text: str = "", buckets=None):
+        """Register (or fetch) a Histogram (obs/metrics.py — imported
+        lazily: obs depends on this module for label escaping)."""
+        if name not in self._histograms:
+            from ..obs.metrics import Histogram
+
+            self._histograms[name] = Histogram(name, help_text, buckets)
+        return self._histograms[name]
+
     def render_text(self) -> str:
         parts = [c.render() for c in self._counters.values()]
         parts += [g.render() for g in self._gauges.values()]
+        parts += [h.render() for h in self._histograms.values()]
         return "\n".join(parts) + "\n"

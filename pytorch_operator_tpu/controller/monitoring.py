@@ -39,11 +39,17 @@ class MonitoringServer:
         health: Callable[[], Dict],
         port: int = 0,
         host: str = "127.0.0.1",
+        text_routes: Optional[Dict[str, Callable[[], str]]] = None,
     ):
         self._render_metrics = render_metrics
         self._health = health
         self._host = host
         self._requested_port = port
+        # Extra plaintext endpoints (path -> body callable), same
+        # thread-safety contract as render_metrics. The daemon mounts
+        # ``/top`` here so `curl :port/top` answers the fleet-glance
+        # question without the CLI.
+        self._text_routes = dict(text_routes or {})
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -70,6 +76,9 @@ class MonitoringServer:
                     elif path == "/healthz":
                         body = json.dumps(outer._health()).encode()
                         ctype = "application/json"
+                    elif path in outer._text_routes:
+                        body = outer._text_routes[path]().encode()
+                        ctype = "text/plain; charset=utf-8"
                     else:
                         self.send_error(404)
                         return

@@ -62,16 +62,31 @@ def _job_status_dir_cached(status_root: str, key: str) -> Path:
     return Path(status_root) / key_to_fs(key)
 
 
-_NUMERIC_FIELDS = ("ts", "step", "loss", "steps_per_sec", "throughput")
+# Status-channel record kinds the supervisor folds into /metrics, and
+# the numeric fields each carries. ``progress`` is the training
+# heartbeat; ``checkpoint_committed`` is the async writer's
+# commit-telemetry record (checkpoint/manager.py + exit_with) feeding
+# the checkpoint-lag / queue-depth surfaces.
+TAILED_KINDS: dict = {
+    "progress": (
+        "ts", "step", "loss", "steps_per_sec", "throughput",
+        "step_time_ms", "feed_stall_ms",
+    ),
+    "checkpoint_committed": (
+        "ts", "step", "commit_ms", "queue_depth", "oldest_age_s",
+    ),
+}
+
+_NUMERIC_FIELDS = TAILED_KINDS["progress"]
 
 
-def _sanitize(rec: dict) -> Optional[dict]:
-    """A progress record with every consumed field coerced to float (or
+def _sanitize(rec: dict, kind: str = "progress") -> Optional[dict]:
+    """A status record with every consumed field coerced to float (or
     absent), or None if any present field is non-numeric — one bad line
     from a foreign writer must not crash describe or degrade every
     daemon sync pass downstream."""
     out = {"ts": 0.0}
-    for f in _NUMERIC_FIELDS:
+    for f in TAILED_KINDS[kind]:
         if rec.get(f) is not None:
             try:
                 out[f] = float(rec[f])
@@ -82,12 +97,12 @@ def _sanitize(rec: dict) -> Optional[dict]:
     return out
 
 
-def read_latest_progress(status_dir) -> Optional[dict]:
-    """The newest ``progress`` record across a job's replica status files
-    (plus which replica reported it), or None. Torn/foreign/malformed
-    lines are skipped — the status dir is written by live workload
-    processes. Every numeric field in the result is a float; consumers
-    need no further validation."""
+def read_latest_event(status_dir, kind: str) -> Optional[dict]:
+    """The newest record of ``kind`` (a :data:`TAILED_KINDS` key) across
+    a job's replica status files (plus which replica reported it), or
+    None. Torn/foreign/malformed lines are skipped — the status dir is
+    written by live workload processes. Every numeric field in the
+    result is a float; consumers need no further validation."""
     if status_dir is None:
         return None
     d = Path(status_dir)
@@ -98,18 +113,44 @@ def read_latest_progress(status_dir) -> Optional[dict]:
         for line in reversed(_tail_lines(p)):
             try:
                 rec = json.loads(line)
-                if rec.get("event") != "progress":
+                if rec.get("event") != kind:
                     continue
             except (ValueError, TypeError, AttributeError):
                 continue
-            clean = _sanitize(rec)
+            clean = _sanitize(rec, kind)
             if clean is None:
-                continue  # malformed progress record: keep looking back
+                continue  # malformed record: keep looking back
             if best is None or clean["ts"] > best["ts"]:
                 clean["replica"] = p.stem
                 best = clean
-            break  # newest valid progress in this file found
+            break  # newest valid record of this kind in this file found
     return best
+
+
+def read_latest_progress(status_dir) -> Optional[dict]:
+    """The newest ``progress`` heartbeat (see :func:`read_latest_event`)."""
+    return read_latest_event(status_dir, "progress")
+
+
+class TailerIOCounters:
+    """Per-tailer fold-I/O accounting, mirrored onto the live ``/metrics``
+    (``tpujob_progress_*_total``) so an idle-I/O regression in the
+    heartbeat fold is visible in production, not just in the
+    control-plane bench. Monotonic; consumers read deltas per pass."""
+
+    __slots__ = ("dir_scans", "file_reads", "bytes_read")
+
+    def __init__(self) -> None:
+        self.dir_scans = 0
+        self.file_reads = 0
+        self.bytes_read = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "dir_scans": self.dir_scans,
+            "file_reads": self.file_reads,
+            "bytes_read": self.bytes_read,
+        }
 
 
 class ProgressTailer:
@@ -118,10 +159,12 @@ class ProgressTailer:
     replica file on every call — fine for a one-shot CLI ``describe``,
     but a daemon folding N jobs' gauges every 200 ms pays that read I/O
     forever. This reader remembers, per file, the byte offset already
-    consumed and the newest valid record seen: an idle pass costs one
-    directory scan and one stat per file with ZERO reads; a busy pass
-    reads only the appended bytes, from the remembered offset, never
-    from the top.
+    consumed and the newest valid record seen PER KIND (every
+    :data:`TAILED_KINDS` event is collected from the same appended
+    bytes — the checkpoint-telemetry fold costs no second read): an
+    idle pass costs one directory scan and one stat per file with ZERO
+    reads; a busy pass reads only the appended bytes, from the
+    remembered offset, never from the top.
 
     A file seen for the first time starts at the tail (last TAIL_BYTES),
     matching the one-shot reader's semantics; a file that shrank
@@ -130,8 +173,9 @@ class ProgressTailer:
     """
 
     def __init__(self) -> None:
-        # path -> [consumed_offset, newest_sanitized_record_or_None]
+        # path -> [consumed_offset, {kind: newest_sanitized_record}]
         self._files: dict = {}
+        self.io = TailerIOCounters()
 
     def _drop_dir(self, d: Path) -> None:
         prefix = str(d) + os.sep
@@ -139,46 +183,56 @@ class ProgressTailer:
             del self._files[p]
 
     def _consume(self, path: str, offset: int, skip_partial: bool):
-        """Read complete lines appended past ``offset``; returns (newest
-        sanitized progress record or None, new offset). A trailing
+        """Read complete lines appended past ``offset``; returns
+        ({kind: newest sanitized record}, new offset). A trailing
         partially-written line stays for the next pass."""
         try:
             with open(path, "rb") as f:
                 f.seek(offset)
                 chunk = f.read()
         except OSError:
-            return None, offset
+            return {}, offset
+        self.io.file_reads += 1
+        self.io.bytes_read += len(chunk)
         last_nl = chunk.rfind(b"\n")
         if last_nl < 0:
-            return None, offset
+            return {}, offset
         consumed = chunk[: last_nl + 1]
         new_offset = offset + last_nl + 1
         lines = consumed.splitlines()
         if skip_partial and lines:
             # First sight started mid-file: the first line is partial.
             lines = lines[1:]
-        best = None
+        best: dict = {}
         for line in lines:
             if not line.strip():
                 continue
             try:
                 rec = json.loads(line)
-                if rec.get("event") != "progress":
+                kind = rec.get("event")
+                if kind not in TAILED_KINDS:
                     continue
             except (ValueError, TypeError, AttributeError):
                 continue
-            clean = _sanitize(rec)
+            clean = _sanitize(rec, kind)
             if clean is None:
                 continue
-            if best is None or clean["ts"] >= best["ts"]:
-                best = clean
+            cur = best.get(kind)
+            if cur is None or clean["ts"] >= cur["ts"]:
+                best[kind] = clean
         return best, new_offset
 
     def latest(self, status_dir) -> Optional[dict]:
         """The newest progress record across the job's replica files
         (same result shape as :func:`read_latest_progress`)."""
+        return self.poll(status_dir).get("progress")
+
+    def poll(self, status_dir) -> dict:
+        """One incremental scan; returns the newest record per tailed
+        kind across the job's replica files, e.g. ``{"progress": {...},
+        "checkpoint_committed": {...}}`` (kinds never seen are absent)."""
         if status_dir is None:
-            return None
+            return {}
         d = Path(status_dir)
         try:
             entries = [
@@ -186,33 +240,36 @@ class ProgressTailer:
                 for e in os.scandir(d)
                 if e.name.endswith(".jsonl")
             ]
+            self.io.dir_scans += 1
         except OSError:
             self._drop_dir(d)
-            return None
+            return {}
         seen = set()
-        best = None
+        best: dict = {}
         for path, size in entries:
             seen.add(path)
             st = self._files.get(path)
             if st is None:
-                st = [max(0, size - TAIL_BYTES), None]
+                st = [max(0, size - TAIL_BYTES), {}]
                 self._files[path] = st
                 first_sight = st[0] > 0
             else:
                 first_sight = False
                 if size < st[0]:
                     # Truncated/replaced (new incarnation): start over.
-                    st[0], st[1] = 0, None
+                    st[0], st[1] = 0, {}
             if size > st[0]:
-                rec, st[0] = self._consume(path, st[0], first_sight)
-                if rec is not None and (
-                    st[1] is None or rec["ts"] >= st[1]["ts"]
-                ):
-                    rec = dict(rec)
-                    rec["replica"] = Path(path).stem
-                    st[1] = rec
-            if st[1] is not None and (best is None or st[1]["ts"] > best["ts"]):
-                best = st[1]
+                recs, st[0] = self._consume(path, st[0], first_sight)
+                for kind, rec in recs.items():
+                    cur = st[1].get(kind)
+                    if cur is None or rec["ts"] >= cur["ts"]:
+                        rec = dict(rec)
+                        rec["replica"] = Path(path).stem
+                        st[1][kind] = rec
+            for kind, rec in st[1].items():
+                cur = best.get(kind)
+                if cur is None or rec["ts"] > cur["ts"]:
+                    best[kind] = rec
         # Files deleted under us must not pin stale records forever.
         prefix = str(d) + os.sep
         for p in [p for p in self._files if p.startswith(prefix) and p not in seen]:

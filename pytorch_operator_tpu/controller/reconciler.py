@@ -74,6 +74,7 @@ class Reconciler:
         cache_root: Optional[Path] = None,
         coordinator_host: str = "127.0.0.1",
         queue_slots: Optional[dict] = None,
+        trace_root: Optional[Path] = None,
     ):
         self.store = store
         self.runner = runner
@@ -83,6 +84,10 @@ class Reconciler:
         self.expectations = expectations or ControllerExpectations()
         self.status_root = Path(status_root) if status_root else None
         self.checkpoint_root = Path(checkpoint_root) if checkpoint_root else None
+        # Per-job span files land under here when a job's spec opts into
+        # tracing (spec.observability.trace) or the supervisor itself is
+        # traced (TPUJOB_TRACE_DIR armed — trace everything).
+        self.trace_root = Path(trace_root) if trace_root else None
         # ONE cache for the whole state dir (not per-job): the win is a
         # resubmitted job hitting the previous run's compiled executables.
         self.cache_root = Path(cache_root) if cache_root else None
@@ -160,6 +165,17 @@ class Reconciler:
         the existing checkpoint dir" (SURVEY.md §5 "Checkpoint / resume");
         ``delete_job(purge_artifacts=True)`` reclaims it."""
         return self.job_subdir(self.checkpoint_root, key)
+
+    def _trace_dir(self, job: TPUJob, key: str) -> Optional[str]:
+        """Per-job span-file dir to inject, or None (tracing off for this
+        job). On when the spec opts in OR the supervisor process itself
+        is traced — global tracing traces the whole fleet."""
+        from .. import obs
+
+        ob = job.spec.observability
+        if (ob is not None and ob.trace) or obs.trace_enabled():
+            return self.job_subdir(self.trace_root, key)
+        return None
 
     def begin_pass(self) -> None:
         """Start a supervisor sync pass. Resets the priority reservation
@@ -441,6 +457,16 @@ class Reconciler:
                         f"replica stalled {rec.get('seconds')}s at "
                         f"{rec.get('site', 'rendezvous')} (fault plan).",
                     )
+                elif event == "rendezvous_join":
+                    # Worker-side join latency rides the status channel
+                    # into the live /metrics histogram (the supervisor
+                    # cannot time a join it does not perform).
+                    try:
+                        self.metrics.rendezvous_join_seconds.observe(
+                            float(rec.get("seconds", 0.0))
+                        )
+                    except (TypeError, ValueError):
+                        pass
         if earliest is not None and job.status.first_step_time is None:
             job.status.first_step_time = earliest
             job.touch()
@@ -474,8 +500,17 @@ class Reconciler:
 
     def sync(self, key: str, now: Optional[float] = None) -> bool:
         """One reconcile pass. Returns True if the job still needs syncing."""
-        with self.key_lock(key):
-            return self._sync_locked(key, now)
+        from .. import obs
+
+        t0 = time.perf_counter()
+        with obs.span("reconcile", cat="supervisor", job=key):
+            with self.key_lock(key):
+                result = self._sync_locked(key, now)
+        # Pooled across jobs (a per-job label would mint one series per
+        # key ever seen); the distribution answers "is any reconcile
+        # slow", the trace answers "which one".
+        self.metrics.reconcile_seconds.observe(time.perf_counter() - t0)
+        return result
 
     def _sync_locked(self, key: str, now: Optional[float]) -> bool:
         now = time.time() if now is None else now
@@ -765,6 +800,7 @@ class Reconciler:
                 job.touch()
             status_dir = self._status_dir(key)
             checkpoint_dir = self._checkpoint_dir(key)
+            trace_dir = self._trace_dir(job, key)
             cache_dir = None
             if self.cache_root is not None:
                 self.cache_root.mkdir(parents=True, exist_ok=True)
@@ -782,6 +818,7 @@ class Reconciler:
                         status_dir=status_dir,
                         checkpoint_dir=checkpoint_dir,
                         compile_cache_dir=cache_dir,
+                        trace_dir=trace_dir,
                     )
                     self.runner.create(
                         key, rtype, index, job.spec.replica_specs[rtype].template, env

@@ -124,6 +124,12 @@ class JobStore:
         self._pass_markers: Optional[dict] = None
         self._last_sweep = 0.0
         self.io = StoreIOCounters()
+        # Optional latency histograms (obs/metrics.Histogram — anything
+        # with .observe(seconds)); the owning supervisor wires them so
+        # /metrics carries persist/rescan distributions, while CLI-side
+        # observer stores pay nothing.
+        self.persist_hist = None
+        self.rescan_hist = None
         self.persist_dir = Path(persist_dir) if persist_dir else None
         if self.persist_dir is not None:
             self.persist_dir.mkdir(parents=True, exist_ok=True)
@@ -223,6 +229,19 @@ class JobStore:
     def _persist(self, key: str) -> None:
         if self.persist_dir is None:
             return
+        if self.persist_hist is None:
+            self._persist_inner(key)
+            return
+        t0 = time.perf_counter()
+        try:
+            self._persist_inner(key)
+        finally:
+            # Clean skips included ON PURPOSE: the O(1) dirty check is
+            # the distribution's left edge; a regression that starts
+            # serializing idle jobs shows up as the p50 jumping decades.
+            self.persist_hist.observe(time.perf_counter() - t0)
+
+    def _persist_inner(self, key: str) -> None:
         job = self._jobs.get(key)
         path = self._path_for(key)
         if job is None:
@@ -336,6 +355,15 @@ class JobStore:
         """
         if self.persist_dir is None:
             return []
+        if self.rescan_hist is None:
+            return self._rescan_inner()
+        t0 = time.perf_counter()
+        try:
+            return self._rescan_inner()
+        finally:
+            self.rescan_hist.observe(time.perf_counter() - t0)
+
+    def _rescan_inner(self) -> List[str]:
         new_keys: List[str] = []
         markers = {kind: [] for kind in _MARKER_KINDS}
         tmp_paths: List[Path] = []

@@ -112,7 +112,21 @@ class Supervisor:
             checkpoint_root=self.state_dir / "checkpoints",
             cache_root=self.state_dir / "xla_cache",
             queue_slots=queue_slots,
+            trace_root=self.state_dir / "trace",
         )
+        # Flight-recorder wiring (obs/): the store times its own
+        # persist/rescan into these histograms, and the per-pass counter
+        # folds below mirror the bench-only I/O instrumentation onto the
+        # live /metrics. Last-seen snapshots make the counter folds
+        # delta-based (counters are monotonic; the sources are too).
+        self.store.persist_hist = self.metrics.store_persist_seconds
+        self.store.rescan_hist = self.metrics.store_rescan_seconds
+        self._store_io_seen = self.store.io.snapshot()
+        self._progress_io_seen = self._progress.io.snapshot()
+        # Per-job ts of the newest folded heartbeat / checkpoint record:
+        # histograms must observe each record ONCE, not once per pass.
+        self._hb_observed: dict = {}
+        self._ckpt_observed: dict = {}
 
     # ---- API-server-ish surface ----
 
@@ -336,7 +350,10 @@ class Supervisor:
         each job serialized with CLI-driven mutations. Process liveness is
         polled ONCE for the whole pass (runner.sync), not once per job.
         """
+        from .. import obs
+
         now = time.time() if now is None else now
+        t_pass = time.perf_counter()
         self._inject_pass_faults()
         any_active = False
         jobs = []
@@ -358,25 +375,41 @@ class Supervisor:
         # queue-usage cache) before admitting in priority order; close the
         # pass afterwards so solo syncs never see its stale state.
         self.reconciler.begin_pass()
+        t_serial = t_parallel = 0.0
         try:
             steady: List[str] = []
-            for key, job in jobs:
-                if job.is_finished():
-                    self._gc_ttl(job, key, now)
-                    continue
-                if not self.parallel_sync or self._needs_scheduling(key, job):
-                    if self.reconciler.sync(key, now=now):
-                        any_active = True
-                else:
-                    steady.append(key)
+            t0 = time.perf_counter()
+            with obs.span("pass_serial", cat="supervisor", jobs=len(jobs)):
+                for key, job in jobs:
+                    if job.is_finished():
+                        self._gc_ttl(job, key, now)
+                        continue
+                    if not self.parallel_sync or self._needs_scheduling(
+                        key, job
+                    ):
+                        if self.reconciler.sync(key, now=now):
+                            any_active = True
+                    else:
+                        steady.append(key)
+            t_serial = time.perf_counter() - t0
             if steady:
-                for active in self._sync_parallel(steady, now):
-                    any_active = any_active or active
+                t0 = time.perf_counter()
+                with obs.span(
+                    "pass_steady", cat="supervisor", jobs=len(steady)
+                ):
+                    for active in self._sync_parallel(steady, now):
+                        any_active = any_active or active
+                t_parallel = time.perf_counter() - t0
             if self.preempt_enabled:
                 self._maybe_preempt(jobs, now)
         finally:
             queue_usage = self.reconciler.end_pass()
         self._update_gauges(jobs, queue_usage)
+        m = self.metrics.sync_pass_seconds
+        m.observe(t_serial, phase="serial")
+        if t_parallel:
+            m.observe(t_parallel, phase="parallel")
+        m.observe(time.perf_counter() - t_pass, phase="total")
         return any_active
 
     def _needs_scheduling(self, key: str, job: TPUJob) -> bool:
@@ -473,6 +506,26 @@ class Supervisor:
                 m.queue_slots_capacity.set(cap, queue=qname)
                 m.queue_slots_used.set(queue_usage.get(qname, 0), queue=qname)
         self._update_progress_gauges(jobs)
+        self._fold_io_counters()
+
+    def _fold_io_counters(self) -> None:
+        """Mirror the bench-only I/O instrumentation (StoreIOCounters,
+        ProgressTailer fold stats) onto live registry counters, once per
+        pass, as deltas — an idle-I/O regression shows on /metrics in
+        production, not just in BENCH_ctrlplane.json."""
+        m = self.metrics
+        cur = self.store.io.snapshot()
+        for k, counter in m.store_io.items():
+            delta = cur[k] - self._store_io_seen.get(k, 0)
+            if delta:
+                counter.inc(delta)
+        self._store_io_seen = cur
+        cur = self._progress.io.snapshot()
+        for k, counter in m.progress_io.items():
+            delta = cur[k] - self._progress_io_seen.get(k, 0)
+            if delta:
+                counter.inc(delta)
+        self._progress_io_seen = cur
 
     def _update_progress_gauges(self, jobs) -> None:
         """Fold each unfinished job's newest workload heartbeat
@@ -486,7 +539,12 @@ class Supervisor:
             m.job_step, m.job_steps_per_sec, m.job_throughput, m.job_loss,
             m.job_progress_age,
         )
-        for g in (g_step, g_sps, g_tp, g_loss, g_age):
+        gauges = (
+            g_step, g_sps, g_tp, g_loss, g_age,
+            m.job_checkpoint_step, m.job_ckpt_queue_depth,
+            m.job_ckpt_oldest_age, m.job_feed_stall,
+        )
+        for g in gauges:
             g.clear()
         from .progress import job_status_dir
 
@@ -496,24 +554,57 @@ class Supervisor:
         for key, job in jobs:
             if job.is_finished():
                 continue
-            rec = self._progress.latest(job_status_dir(root, key))
-            if rec is None:
-                continue
-            if rec.get("step") is not None:
-                g_step.set(float(rec["step"]), job=key)
-            if rec.get("steps_per_sec") is not None:
-                g_sps.set(float(rec["steps_per_sec"]), job=key)
-            if rec.get("throughput") is not None:
-                g_tp.set(
-                    float(rec["throughput"]),
-                    job=key,
-                    unit=str(rec.get("unit") or "units/sec"),
-                )
-            if rec.get("loss") is not None:
-                g_loss.set(float(rec["loss"]), job=key)
-            # Staleness signal: without it a hung job's meter reads as a
-            # healthy rate forever.
-            g_age.set(max(time.time() - rec["ts"], 0.0), job=key)
+            by_kind = self._progress.poll(job_status_dir(root, key))
+            rec = by_kind.get("progress")
+            if rec is not None:
+                if rec.get("step") is not None:
+                    g_step.set(float(rec["step"]), job=key)
+                if rec.get("steps_per_sec") is not None:
+                    g_sps.set(float(rec["steps_per_sec"]), job=key)
+                if rec.get("throughput") is not None:
+                    g_tp.set(
+                        float(rec["throughput"]),
+                        job=key,
+                        unit=str(rec.get("unit") or "units/sec"),
+                    )
+                if rec.get("loss") is not None:
+                    g_loss.set(float(rec["loss"]), job=key)
+                if rec.get("feed_stall_ms") is not None:
+                    m.job_feed_stall.set(float(rec["feed_stall_ms"]), job=key)
+                # Staleness signal: without it a hung job's meter reads
+                # as a healthy rate forever.
+                g_age.set(max(time.time() - rec["ts"], 0.0), job=key)
+                # Step-time distribution, one observation per NEW
+                # heartbeat (interval-averaged: each heartbeat's rate is
+                # already a mean over its reporting window).
+                sps = rec.get("steps_per_sec")
+                if sps and rec["ts"] > self._hb_observed.get(key, 0.0):
+                    self._hb_observed[key] = rec["ts"]
+                    st = rec.get("step_time_ms")
+                    m.step_time_seconds.observe(
+                        st / 1000.0 if st is not None else 1.0 / float(sps),
+                        job=key,
+                    )
+            ck = by_kind.get("checkpoint_committed")
+            if ck is not None:
+                if ck.get("step") is not None:
+                    m.job_checkpoint_step.set(float(ck["step"]), job=key)
+                if ck.get("queue_depth") is not None:
+                    m.job_ckpt_queue_depth.set(
+                        float(ck["queue_depth"]), job=key
+                    )
+                if ck.get("oldest_age_s") is not None:
+                    m.job_ckpt_oldest_age.set(
+                        float(ck["oldest_age_s"]), job=key
+                    )
+                if (
+                    ck.get("commit_ms") is not None
+                    and ck["ts"] > self._ckpt_observed.get(key, 0.0)
+                ):
+                    self._ckpt_observed[key] = ck["ts"]
+                    m.checkpoint_commit_seconds.observe(
+                        float(ck["commit_ms"]) / 1000.0, job=key
+                    )
 
     def _maybe_preempt(self, jobs, now: float) -> None:
         """volcano ``preempt``: evict lower-priority running worlds so the

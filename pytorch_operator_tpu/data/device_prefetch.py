@@ -37,9 +37,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import numpy as np
+
+from .. import obs
 
 _SENTINEL = object()
 
@@ -79,13 +82,32 @@ class DevicePrefetcher:
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._err: Optional[BaseException] = None
+        # Flight-recorder accounting: feed-thread time (host gather +
+        # device_put) vs step-thread wait — "is the feed keeping ahead"
+        # is THE data-plane health question, surfaced as the feed-stall
+        # column of `tpujob top` via the progress heartbeat.
+        self._stats_lock = threading.Lock()
+        self._stats = {
+            "batches": 0, "produce_s": 0.0, "put_s": 0.0,
+            "gets": 0, "get_wait_s": 0.0,
+        }
         self._thread = threading.Thread(target=self._fill, name=name, daemon=True)
         self._thread.start()
 
     def _fill(self) -> None:
         while not self._stop.is_set():
             try:
-                item = self._put(self._produce())
+                t0 = time.perf_counter()
+                with obs.span("feed_produce", cat="data"):
+                    batch = self._produce()
+                t1 = time.perf_counter()
+                with obs.span("feed_put", cat="data"):
+                    item = self._put(batch)
+                t2 = time.perf_counter()
+                with self._stats_lock:
+                    self._stats["batches"] += 1
+                    self._stats["produce_s"] += t1 - t0
+                    self._stats["put_s"] += t2 - t1
             except BaseException as e:  # noqa: BLE001 — deliver to consumer
                 self._err = e
                 item = _SENTINEL
@@ -103,10 +125,28 @@ class DevicePrefetcher:
         feed thread has fallen behind the step loop."""
         if self._stop.is_set():
             raise RuntimeError("prefetcher is closed")
+        t0 = time.perf_counter()
         item = self._q.get()
+        waited = time.perf_counter() - t0
+        with self._stats_lock:
+            self._stats["gets"] += 1
+            self._stats["get_wait_s"] += waited
+        if waited > 1e-4:
+            rec = obs.tracer()
+            if rec is not None:
+                rec.emit("feed_wait", "data", time.time() - waited, waited)
         if item is _SENTINEL:
             raise self._err
         return item
+
+    def stats(self) -> dict:
+        """Cumulative feed accounting plus the derived mean step-loop
+        stall per get (``feed_stall_ms_avg``) — the heartbeat field the
+        supervisor folds into ``tpujob_job_feed_stall_ms``."""
+        with self._stats_lock:
+            s = dict(self._stats)
+        s["feed_stall_ms_avg"] = 1000.0 * s["get_wait_s"] / max(s["gets"], 1)
+        return s
 
     def close(self) -> None:
         """Stop the feed thread and drop queued batches. Idempotent."""
@@ -153,6 +193,9 @@ class PrefetchedLoader:
     @property
     def batches_per_epoch(self) -> int:
         return self.loader.batches_per_epoch
+
+    def stats(self) -> dict:
+        return self._pf.stats()
 
     def next_batch(self):
         """Same contract as the wrapped loader, but ``fields`` is the

@@ -112,13 +112,19 @@ class FaultInjector:
     def drop_heartbeat(
         self, rtype=None, index=None, restart: Optional[int] = None
     ) -> bool:
-        """drop_heartbeat: suppress this progress report?"""
-        with self._lock:
-            for i, f in self._candidates("drop_heartbeat", rtype, index):
-                if self._restart_ok(f, restart):
-                    self._consume(i, f)
-                    return True
-        return False
+        """drop_heartbeat: suppress this progress report? One report is
+        one site occurrence; the fault drops occurrences
+        [nth, nth+times) — ``nth > 1`` lets the first beats through
+        (the hang-deadline chaos scenario: train visibly, THEN go
+        silent, so the progress-age surfaces show the hang)."""
+        return (
+            self._nth_fire(
+                "drop_heartbeat",
+                f"heartbeat:{self._replica_id(rtype, index)}",
+                rtype, index, restart,
+            )
+            is not None
+        )
 
     def _occurrence(self, site: str) -> int:
         """Bump and return the 1-based occurrence count of a site."""

@@ -19,9 +19,11 @@ Fault kinds (``Fault.kind``):
 
 - ``crash_at_step``          worker-side: exit ``exit_code`` at step ``at``
 - ``stall_rendezvous``       worker-side: sleep ``seconds`` before joining
-- ``drop_heartbeat``         worker-side: suppress the next ``times``
-                             progress heartbeats (trips the supervisor's
-                             hung-world detector)
+- ``drop_heartbeat``         worker-side: suppress ``times`` progress
+                             heartbeats starting at the ``nth`` one
+                             (trips the supervisor's hung-world
+                             detector; ``nth > 1`` trains visibly
+                             first, then goes silent)
 - ``fail_checkpoint_write``  worker-side: the ``nth`` checkpoint save
                              raises (transient — the retry wrapper
                              recovers it)
@@ -81,6 +83,7 @@ KINDS = frozenset(
 # an absolute step/pass number (``at``).
 NTH_KINDS = frozenset(
     {
+        "drop_heartbeat",
         "fail_checkpoint_write",
         "torn_checkpoint_write",
         "enospc_checkpoint_write",

@@ -1,0 +1,57 @@
+"""Cross-layer flight recorder: span tracing + latency histograms.
+
+The control and data planes got fast (PRs 2-3) but only offline bench
+JSON proves it; this package makes the LIVE system debuggable. Two
+primitives, deliberately tiny and import-light (the step loop and the
+supervisor's per-job reconcile both touch them every iteration):
+
+- :class:`~pytorch_operator_tpu.obs.metrics.Histogram` — fixed
+  log-spaced buckets, Prometheus text exposition alongside the existing
+  Counter/Gauge (controller/metrics.py registers them; ``/metrics``
+  serves step-time, reconcile-pass, and checkpoint-commit
+  distributions, not just point gauges).
+- :class:`~pytorch_operator_tpu.obs.trace.SpanRecorder` — appends
+  ``{name, cat, ts, dur, pid, tid, args}`` span records to a
+  per-process JSONL ring file under ``$TPUJOB_TRACE_DIR``. The module
+  helpers (:func:`span`, :func:`tracer`) are ZERO-overhead when the env
+  knob is unset: one cached None check, a shared nullcontext, no I/O.
+
+``tpujob trace <job>`` merges the supervisor's and every replica's span
+files into one Chrome-trace/Perfetto JSON (:func:`merge_trace_files`);
+``tpujob top`` renders the live fleet table from ``/metrics`` +
+progress heartbeats (obs/top.py).
+"""
+
+from .metrics import (
+    DEFAULT_BUCKETS,
+    Histogram,
+    histogram_quantile,
+    parse_prometheus_text,
+)
+from .trace import (
+    SpanRecorder,
+    instant,
+    load_span_file,
+    merge_trace_files,
+    records_emitted,
+    reset_tracer,
+    span,
+    trace_enabled,
+    tracer,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Histogram",
+    "SpanRecorder",
+    "histogram_quantile",
+    "instant",
+    "load_span_file",
+    "merge_trace_files",
+    "parse_prometheus_text",
+    "records_emitted",
+    "reset_tracer",
+    "span",
+    "trace_enabled",
+    "tracer",
+]

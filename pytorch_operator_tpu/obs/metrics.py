@@ -1,0 +1,221 @@
+"""Histogram metric type + Prometheus text helpers.
+
+The existing Counter/Gauge (controller/metrics.py) answer "how many"
+and "how much right now"; at scale the interesting failures live in
+tail latencies neither can see (the TPU-pod concurrency study,
+PAPERS.md). :class:`Histogram` adds distributions with FIXED log-spaced
+buckets — fixed so that two scrapes, two supervisors, or two runs are
+always mergeable (dynamic buckets are not), log-spaced because latency
+is multiplicative (a 63ms and a 70ms pass are the same story; 63ms vs
+630ms is the story).
+
+Exposition follows the Prometheus text format contract the conformance
+tests pin: cumulative ``_bucket`` series with ``le`` labels, the
+``+Inf`` bucket equal to ``_count``, and ``_sum``; label escaping is
+shared with the Counter/Gauge ``_fmt_labels`` so a queue name with a
+quote in it cannot invalidate one metric family but not another.
+
+:func:`parse_prometheus_text` / :func:`histogram_quantile` are the read
+side — ``tpujob top`` turns a scraped ``/metrics`` (or the daemon's
+``metrics.prom`` file) back into p50/p99 columns.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..controller.metrics import _fmt_labels
+
+# Default bucket boundaries (seconds): ~log-spaced 1-2.5-5 per decade,
+# 100 microseconds to 100 s — wide enough for a store persist (sub-ms)
+# and a cold rendezvous join (tens of seconds) on one fixed grid.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005,
+    0.001, 0.0025, 0.005,
+    0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0,
+    10.0, 25.0, 50.0, 100.0,
+)
+
+
+def _fmt_le(bound: float) -> str:
+    """Prometheus renders +Inf literally; finite bounds as shortest repr."""
+    if bound == float("inf"):
+        return "+Inf"
+    return f"{bound:g}"
+
+
+class Histogram:
+    """A labeled histogram with fixed log-spaced buckets.
+
+    ``observe(value, **labels)`` is the hot-path call: one lock, one
+    bisect, three adds — cheap enough for per-reconcile and per-persist
+    observation with no sampling. Series (label sets) are created on
+    first observation, like Counter/Gauge.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str = "",
+        buckets: Optional[Tuple[float, ...]] = None,
+    ):
+        self.name = name
+        self.help = help_text
+        bs = tuple(sorted(buckets if buckets is not None else DEFAULT_BUCKETS))
+        if any(b2 <= b1 for b1, b2 in zip(bs, bs[1:])):
+            raise ValueError(f"histogram buckets must strictly increase: {bs}")
+        self.buckets = bs
+        # key -> [per-bucket counts (+1 overflow slot for +Inf), sum, count]
+        self._series: Dict[tuple, list] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._series[key] = s
+            s[0][idx] += 1
+            s[1] += value
+            s[2] += 1
+
+    def count(self, **labels: str) -> int:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            s = self._series.get(key)
+            return 0 if s is None else s[2]
+
+    def sum(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            s = self._series.get(key)
+            return 0.0 if s is None else s[1]
+
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """Bucket-interpolated quantile (the promQL histogram_quantile
+        estimate) for live rendering; None with no observations."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None or s[2] == 0:
+                return None
+            counts = list(s[0])
+        cum: List[Tuple[float, int]] = []
+        total = 0
+        for bound, c in zip(self.buckets + (float("inf"),), counts):
+            total += c
+            cum.append((bound, total))
+        return histogram_quantile(cum, q)
+
+    def render(self) -> str:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} histogram")
+        with self._lock:
+            series = {
+                k: ([*v[0]], v[1], v[2]) for k, v in self._series.items()
+            }
+        for key, (counts, total_sum, total_count) in sorted(series.items()):
+            base = _fmt_labels(key)
+            cum = 0
+            for bound, c in zip(self.buckets + (float("inf"),), counts):
+                cum += c
+                le = _fmt_labels((("le", _fmt_le(bound)),))
+                labels = f"{base},{le}" if base else le
+                lines.append(f"{self.name}_bucket{{{labels}}} {cum}")
+            brace = f"{{{base}}}" if base else ""
+            lines.append(f"{self.name}_sum{brace} {total_sum:g}")
+            lines.append(f"{self.name}_count{brace} {total_count}")
+        if not series:
+            # Family present (HELP/TYPE) but no series yet — same idle
+            # shape as Counter/Gauge, minus a fake zero sample (an empty
+            # histogram has no meaningful le grid to fabricate).
+            pass
+        return "\n".join(lines)
+
+
+def histogram_quantile(
+    cumulative: List[Tuple[float, int]], q: float
+) -> Optional[float]:
+    """PromQL-style quantile from cumulative ``(le_bound, cum_count)``
+    pairs (the last bound may be +Inf). Linear interpolation within the
+    winning bucket; values in the +Inf bucket clamp to the last finite
+    bound (Prometheus's behavior)."""
+    if not cumulative:
+        return None
+    total = cumulative[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in cumulative:
+        if cum >= rank:
+            if bound == float("inf"):
+                return prev_bound
+            if cum == prev_cum:
+                return bound
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_cum = (0.0 if bound == float("inf") else bound), cum
+    return prev_bound
+
+
+def parse_prometheus_text(text: str) -> Dict[str, List[Tuple[dict, float]]]:
+    """Parse Prometheus text exposition into
+    ``{metric_name: [(labels_dict, value), ...]}`` — the read side of
+    ``render_text`` that ``tpujob top`` uses on ``metrics.prom`` or a
+    scraped ``/metrics`` body. Tolerant: unparseable lines are skipped
+    (the file may be mid-rewrite when read)."""
+    out: Dict[str, List[Tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                label_blob, value_part = rest.rsplit("}", 1)
+                labels = _parse_labels(label_blob)
+                value = float(value_part.strip())
+            else:
+                name, value_part = line.rsplit(None, 1)
+                labels = {}
+                value = float(value_part)
+        except ValueError:
+            continue
+        out.setdefault(name.strip(), []).append((labels, value))
+    return out
+
+
+def _parse_labels(blob: str) -> dict:
+    """Inverse of ``_fmt_labels`` (quoted, escaped label values)."""
+    labels: dict = {}
+    i, n = 0, len(blob)
+    while i < n:
+        eq = blob.index("=", i)
+        key = blob[i:eq].strip().lstrip(",").strip()
+        if blob[eq + 1] != '"':
+            raise ValueError(f"unquoted label value in {blob!r}")
+        j = eq + 2
+        val = []
+        while j < n:
+            ch = blob[j]
+            if ch == "\\" and j + 1 < n:
+                nxt = blob[j + 1]
+                val.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                j += 2
+                continue
+            if ch == '"':
+                break
+            val.append(ch)
+            j += 1
+        labels[key] = "".join(val)
+        i = j + 1
+    return labels

@@ -1,0 +1,147 @@
+"""``tpujob top`` — one-screen live fleet table.
+
+Answers the operator's glance questions without a dashboard: per job,
+where is it (step), how fast (steps/s), how SMOOTH (p50/p99 step time —
+the tail counters can't see), how far behind are its checkpoints
+(lag = newest step - newest committed step), and is the device feed
+keeping ahead (feed stall).
+
+Sources, all file-based so it works with or without a daemon:
+
+- the persisted job store (which jobs exist, their phase);
+- each job's status dir heartbeats (step, steps/s, feed stall) and
+  ``checkpoint_committed`` records (checkpoint lag) — read one-shot via
+  controller/progress.py;
+- the daemon's ``metrics.prom`` (written every pass) for the step-time
+  histogram quantiles; absent (no daemon), the p50/p99 columns show
+  ``-`` and the heartbeat-derived columns still render.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .metrics import histogram_quantile, parse_prometheus_text
+
+STEP_HIST = "tpujob_step_time_seconds"
+
+
+def _hist_quantiles(
+    metrics: Dict, name: str, job: str
+) -> Optional[tuple]:
+    """(p50_s, p99_s) for one job's series of histogram ``name`` parsed
+    from exposition text, or None."""
+    rows = metrics.get(f"{name}_bucket")
+    if not rows:
+        return None
+    cum = sorted(
+        (
+            (float("inf") if le == "+Inf" else float(le), int(v))
+            for labels, v in rows
+            if labels.get("job") == job
+            for le in [labels.get("le", "+Inf")]
+        ),
+        key=lambda x: x[0],
+    )
+    if not cum or cum[-1][1] == 0:
+        return None
+    p50 = histogram_quantile(cum, 0.50)
+    p99 = histogram_quantile(cum, 0.99)
+    if p50 is None:
+        return None
+    return p50, p99
+
+
+def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
+    """One snapshot of the fleet: a dict per unfinished job (finished
+    jobs are noise on a live screen), newest-first by heartbeat."""
+    from ..controller.progress import job_status_dir, read_latest_event
+    from ..controller.store import JobStore, job_key
+
+    state = Path(state_dir)
+    now = time.time() if now is None else now
+    metrics: Dict = {}
+    prom = state / "metrics.prom"
+    if prom.exists():
+        try:
+            metrics = parse_prometheus_text(prom.read_text())
+        except OSError:
+            pass
+    store = JobStore(persist_dir=state / "jobs")
+    rows: List[dict] = []
+    for job in store.list():
+        if job.is_finished():
+            continue
+        key = job_key(job)
+        d = job_status_dir(state / "status", key)
+        hb = read_latest_event(d, "progress") or {}
+        ck = read_latest_event(d, "checkpoint_committed") or {}
+        q = _hist_quantiles(metrics, STEP_HIST, key)
+        step = hb.get("step")
+        ck_step = ck.get("step")
+        rows.append(
+            {
+                "job": key,
+                "step": step,
+                "steps_per_sec": hb.get("steps_per_sec"),
+                "p50_ms": 1000 * q[0] if q else None,
+                "p99_ms": 1000 * q[1] if q else None,
+                "ckpt_lag": (
+                    int(step - ck_step)
+                    if step is not None and ck_step is not None
+                    else None
+                ),
+                "feed_stall_ms": hb.get("feed_stall_ms"),
+                "age_s": (now - hb["ts"]) if hb.get("ts") else None,
+                "restarts": job.status.restart_count,
+            }
+        )
+    # Stable, predictable ordering for a refreshing screen: reporting
+    # jobs first (freshest heartbeat up top), silent jobs after, each
+    # group alphabetical.
+    rows.sort(
+        key=lambda r: (r["age_s"] is None, r["age_s"] or 0.0, r["job"])
+    )
+    return rows
+
+
+def _fmt(v, spec: str = "", dash: str = "-") -> str:
+    if v is None:
+        return dash
+    return format(v, spec) if spec else str(v)
+
+
+def render_table(rows: List[dict], now: Optional[float] = None) -> str:
+    """The one-screen table. Columns stay stable so watch-mode diffs
+    visually; '-' means "not reported", never 0."""
+    header = (
+        "JOB", "STEP", "STEPS/S", "P50(ms)", "P99(ms)",
+        "CKPT LAG", "FEED(ms)", "HB AGE", "RESTARTS",
+    )
+    table = [header]
+    for r in rows:
+        table.append(
+            (
+                r["job"],
+                _fmt(None if r["step"] is None else int(r["step"])),
+                _fmt(r["steps_per_sec"], ".2f"),
+                _fmt(r["p50_ms"], ".1f"),
+                _fmt(r["p99_ms"], ".1f"),
+                _fmt(r["ckpt_lag"]),
+                _fmt(r["feed_stall_ms"], ".2f"),
+                _fmt(None if r["age_s"] is None else f"{r['age_s']:.0f}s"),
+                str(r["restarts"]),
+            )
+        )
+    widths = [max(len(row[i]) for row in table) for i in range(len(header))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+             for row in table]
+    if not rows:
+        lines.append("(no active jobs)")
+    return "\n".join(lines)
+
+
+def render(state_dir, now: Optional[float] = None) -> str:
+    return render_table(gather_rows(state_dir, now), now)

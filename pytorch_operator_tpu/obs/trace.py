@@ -1,0 +1,321 @@
+"""Span recording to per-process JSONL ring files + Chrome-trace merge.
+
+Write side: :class:`SpanRecorder` appends one JSON object per span —
+``{"name", "cat", "ph": "X", "ts", "dur", "pid", "tid", "args"}`` with
+``ts``/``dur`` in microseconds (the Chrome trace event format, so the
+merged output loads in Perfetto / ``chrome://tracing`` unmodified) — to
+``$TPUJOB_TRACE_DIR/<proc>-<pid>.trace.jsonl``. The file is a ring:
+past ``max_bytes`` it rotates once (``.1`` generation kept, older
+dropped), so a week-long daemon cannot fill the disk with spans.
+
+Enablement is the ``TPUJOB_TRACE_DIR`` env knob, injected per replica
+by runtime/env.py and read once per process: with it unset,
+:func:`tracer` caches None and :func:`span` returns a shared
+nullcontext — no I/O, no allocation, one attribute check. The
+``bench_smoke`` lane pins that a tracing-disabled step loop emits ZERO
+span records.
+
+Timestamps are ``time.time()`` (wall clock — all replicas of a local
+world share it, and it is the same clock the progress heartbeats carry,
+so a future multi-host merger can align skewed hosts by matching each
+replica's heartbeat ``ts`` against the supervisor's fold time). Each
+file opens with a ``clock_sync`` metadata record carrying both the wall
+clock and ``perf_counter`` so sub-ms skew is reconstructable.
+
+Read side: :func:`load_span_file` skips torn/foreign lines (a
+SIGKILLed writer tears its last line — the ring-file tests pin that the
+merger survives it); :func:`merge_trace_files` folds many span files
+into one ``{"traceEvents": [...]}`` document.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional
+
+ENV_VAR = "TPUJOB_TRACE_DIR"
+
+# Ring size per generation; two generations (current + .1) are kept.
+DEFAULT_MAX_BYTES = 8 << 20
+
+# Flush cadence: buffered records are cheap to lose only if a crash
+# tears them anyway; every FLUSH_EVERY records the buffer hits disk so
+# a live `tpujob trace` sees near-current spans.
+FLUSH_EVERY = 32
+
+_NULL = contextlib.nullcontext()
+
+# Process-global recorder, resolved lazily from the env once.
+_TRACER: Optional["SpanRecorder"] = None
+_RESOLVED = False
+_LOCK = threading.Lock()
+
+# Total span records emitted by this process (across recorders) — the
+# bench_smoke "zero step-path spans when disabled" pin reads this.
+_RECORDS = 0
+
+
+def _default_process_name() -> str:
+    rtype = os.environ.get("TPUJOB_REPLICA_TYPE")
+    if rtype:
+        idx = os.environ.get("TPUJOB_REPLICA_INDEX", "0")
+        return f"{rtype.lower()}-{idx}"
+    return "supervisor"
+
+
+def tracer() -> Optional["SpanRecorder"]:
+    """The process recorder, or None when ``TPUJOB_TRACE_DIR`` is unset
+    or empty. Resolved once; :func:`reset_tracer` re-reads (tests)."""
+    global _TRACER, _RESOLVED
+    if _RESOLVED:
+        return _TRACER
+    with _LOCK:
+        if not _RESOLVED:
+            d = os.environ.get(ENV_VAR, "")
+            _TRACER = SpanRecorder(d, _default_process_name()) if d else None
+            _RESOLVED = True
+    return _TRACER
+
+
+def trace_enabled() -> bool:
+    return tracer() is not None
+
+
+def reset_tracer() -> None:
+    """Close and forget the process recorder so the next :func:`tracer`
+    call re-reads the env — tests and the CLI's ``--trace`` flag (which
+    sets the env after import time) use this."""
+    global _TRACER, _RESOLVED
+    with _LOCK:
+        if _TRACER is not None:
+            _TRACER.close()
+        _TRACER, _RESOLVED = None, False
+
+
+def span(name: str, cat: str = "span", **args):
+    """Context manager recording one complete span — THE call sites
+    sprinkle through the stack. Disabled: returns a shared nullcontext
+    (no allocation)."""
+    rec = tracer()
+    if rec is None:
+        return _NULL
+    return rec.span(name, cat, **args)
+
+
+def instant(name: str, cat: str = "span", **args) -> None:
+    """Zero-duration marker event (restarts, kills, fault injections)."""
+    rec = tracer()
+    if rec is not None:
+        rec.emit(name, cat, time.time(), 0.0, **args)
+
+
+def records_emitted() -> int:
+    """Span records emitted by this process so far (0 when disabled —
+    the zero-overhead invariant the bench_smoke lane asserts)."""
+    return _RECORDS
+
+
+class SpanRecorder:
+    """Appends span records to one per-process JSONL ring file.
+
+    Lock-cheap by construction: the JSON line is formatted OUTSIDE the
+    lock; inside it there is an append + a size check, with a real
+    ``flush()`` only every :data:`FLUSH_EVERY` records (plus close).
+    A crash can therefore tear the buffered tail — the merge side
+    (:func:`load_span_file`) skips torn lines by contract.
+    """
+
+    def __init__(
+        self,
+        trace_dir,
+        process_name: Optional[str] = None,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+    ):
+        self.trace_dir = Path(trace_dir)
+        self.trace_dir.mkdir(parents=True, exist_ok=True)
+        self.process_name = process_name or _default_process_name()
+        self.pid = os.getpid()
+        self.path = self.trace_dir / f"{self.process_name}-{self.pid}.trace.jsonl"
+        self.max_bytes = max_bytes
+        self.records = 0
+        self._lock = threading.Lock()
+        self._f = open(self.path, "ab")
+        self._since_flush = 0
+        self._write_header()
+        # Normal process exit flushes the buffered tail; a SIGKILL tears
+        # it, which the merge side tolerates by contract.
+        atexit.register(self.close)
+
+    def _write_header(self) -> None:
+        # Metadata the merger turns into Perfetto process names, plus
+        # the clock-sync pair for (future) cross-host alignment.
+        meta = [
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": self.pid,
+                "tid": 0,
+                "args": {"name": self.process_name},
+            },
+            {
+                "ph": "M",
+                "name": "clock_sync",
+                "pid": self.pid,
+                "tid": 0,
+                "args": {
+                    "unix_ts": time.time(),
+                    "perf_counter": time.perf_counter(),
+                    "job": os.environ.get("TPUJOB_KEY", ""),
+                },
+            },
+        ]
+        with self._lock:
+            for m in meta:
+                self._f.write(json.dumps(m).encode() + b"\n")
+            self._f.flush()
+
+    def emit(
+        self, name: str, cat: str, ts: float, dur_s: float, **args
+    ) -> None:
+        """Record one complete span; ``ts`` is wall-clock seconds of the
+        span START, ``dur_s`` its duration."""
+        global _RECORDS
+        rec = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": round(ts * 1e6, 1),
+            "dur": round(dur_s * 1e6, 1),
+            "pid": self.pid,
+            "tid": threading.get_ident() & 0x7FFFFFFF,
+        }
+        if args:
+            rec["args"] = args
+        line = json.dumps(rec).encode() + b"\n"
+        with self._lock:
+            if self._f.closed:
+                return
+            self._maybe_rotate(len(line))
+            self._f.write(line)
+            self.records += 1
+            _RECORDS += 1
+            self._since_flush += 1
+            if self._since_flush >= FLUSH_EVERY:
+                self._f.flush()
+                self._since_flush = 0
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        """Ring rotation under the held lock: current generation moves
+        to ``.1`` (replacing the previous one), a fresh file starts."""
+        try:
+            if self._f.tell() + incoming <= self.max_bytes:
+                return
+            self._f.flush()
+            self._f.close()
+            self.path.replace(self.path.with_suffix(".jsonl.1"))
+            self._f = open(self.path, "ab")
+        except OSError:
+            # A full disk must never take the traced process down.
+            if self._f.closed:
+                self._f = open(os.devnull, "ab")
+        # Re-emit the header so the new generation is self-describing.
+        for m in (
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": self.pid,
+                "tid": 0,
+                "args": {"name": self.process_name},
+            },
+        ):
+            self._f.write(json.dumps(m).encode() + b"\n")
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "span", **args):
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.emit(name, cat, t_wall, time.perf_counter() - t0, **args)
+
+    def flush(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._since_flush = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+
+# ---- merge / export ----
+
+
+def load_span_file(path) -> List[dict]:
+    """Parse one span JSONL file into event dicts. Torn last lines
+    (crashed writer), foreign lines, and records missing the required
+    Chrome-trace fields are skipped — the trace dir is written by live
+    processes and read after kills."""
+    out: List[dict] = []
+    try:
+        data = Path(path).read_bytes()
+    except OSError:
+        return out
+    for line in data.splitlines():
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn or foreign
+        if not isinstance(rec, dict) or "ph" not in rec or "name" not in rec:
+            continue
+        if rec["ph"] == "X" and ("ts" not in rec or "dur" not in rec):
+            continue
+        out.append(rec)
+    return out
+
+
+def span_files(trace_dir, include_rotated: bool = True) -> List[Path]:
+    """The span files (current + rotated generations) directly under
+    ``trace_dir``, stable order."""
+    d = Path(trace_dir)
+    if not d.is_dir():
+        return []
+    pats = ["*.trace.jsonl"] + (["*.trace.jsonl.1"] if include_rotated else [])
+    return sorted(p for pat in pats for p in d.glob(pat))
+
+
+def merge_trace_files(paths: Iterable, clock_offsets: Optional[Dict] = None) -> dict:
+    """Fold span files into one Chrome-trace JSON document.
+
+    ``clock_offsets`` maps path -> seconds to ADD to that file's
+    timestamps (the cross-host alignment hook; local worlds share a
+    clock so the default is 0 everywhere). Events are sorted by ts;
+    metadata records keep their file order. The result loads directly
+    in Perfetto (https://ui.perfetto.dev) or chrome://tracing."""
+    meta: List[dict] = []
+    events: List[dict] = []
+    for p in paths:
+        off_us = 1e6 * (clock_offsets or {}).get(p, 0.0)
+        for rec in load_span_file(p):
+            if rec.get("ph") == "M":
+                if rec not in meta:
+                    meta.append(rec)
+            else:
+                if off_us:
+                    rec = dict(rec)
+                    rec["ts"] = rec.get("ts", 0) + off_us
+                events.append(rec)
+    events.sort(key=lambda r: r.get("ts", 0))
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}

@@ -37,6 +37,7 @@ def build_cluster_env(
     status_dir: Optional[str] = None,
     checkpoint_dir: Optional[str] = None,
     compile_cache_dir: Optional[str] = None,
+    trace_dir: Optional[str] = None,
 ) -> Dict[str, str]:
     """Build the injected environment for one replica process.
 
@@ -89,6 +90,15 @@ def build_cluster_env(
         env["TPUJOB_STATUS_DIR"] = status_dir
     if checkpoint_dir is not None:
         env["TPUJOB_CHECKPOINT_DIR"] = checkpoint_dir
+    # Flight-recorder knob (obs/trace.py): with a per-job trace dir the
+    # replica's step loop / device feed / rendezvous / async-checkpoint
+    # spans land where `tpujob trace <job>` merges them. Explicitly
+    # cleared otherwise — a supervisor tracing ITSELF must not leak its
+    # own (root) trace dir into replicas via inherited environment.
+    if trace_dir is not None:
+        env["TPUJOB_TRACE_DIR"] = trace_dir
+    else:
+        env["TPUJOB_TRACE_DIR"] = ""
     # Data-plane policy (spec.data_plane): workloads read these as the
     # defaults for --async-checkpoint / --prefetch, so host-I/O overlap
     # is a SPEC property, not per-workload args plumbing.

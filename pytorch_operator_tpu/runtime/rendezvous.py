@@ -100,7 +100,9 @@ def initialize_from_env(
     delay); the outer ``timeout_s`` contract is unchanged.
     """
     from .backend import setup_backend
+    from .. import obs
 
+    t_join = time.time()
     fault_stall_if_armed()
     setup_backend()
     world = world_from_env()
@@ -119,11 +121,21 @@ def initialize_from_env(
         )
 
     try:
-        retry_call(
-            join,
-            backoff=join_backoff(timeout_s, retry_interval_s, world.process_id),
-            timeout_s=timeout_s,
-        )
+        with obs.span(
+            "rendezvous_join", cat="rendezvous",
+            coordinator=world.coordinator, world=world.num_processes,
+        ):
+            retry_call(
+                join,
+                backoff=join_backoff(
+                    timeout_s, retry_interval_s, world.process_id
+                ),
+                timeout_s=timeout_s,
+            )
+        # Join latency rides the status channel into the supervisor's
+        # /metrics histogram (the supervisor cannot time a join it does
+        # not perform).
+        report("rendezvous_join", seconds=time.time() - t_join)
         return world
     except Exception as e:  # pragma: no cover - env-dependent errors
         raise TimeoutError(
@@ -179,6 +191,8 @@ def report_progress(
     steps_per_sec: Optional[float] = None,
     throughput: Optional[float] = None,
     unit: Optional[str] = None,
+    step_time_ms: Optional[float] = None,
+    feed_stall_ms: Optional[float] = None,
 ) -> None:
     """Live training heartbeat (step/loss/throughput) for the operator
     surface: the supervisor folds the newest record into per-job
@@ -202,4 +216,28 @@ def report_progress(
         fields["throughput"] = round(float(throughput), 4)
     if unit is not None:
         fields["unit"] = unit
+    if step_time_ms is not None:
+        fields["step_time_ms"] = round(float(step_time_ms), 3)
+    if feed_stall_ms is not None:
+        fields["feed_stall_ms"] = round(float(feed_stall_ms), 3)
     report("progress", step=step, **fields)
+
+
+def report_checkpoint_committed(
+    step: int,
+    commit_s: float,
+    queue_depth: int = 0,
+    oldest_age_s: float = 0.0,
+) -> None:
+    """Async-checkpoint commit telemetry for the operator surface: the
+    supervisor folds the newest record into the per-job checkpoint-step
+    /queue-depth/oldest-inflight-age gauges and observes the commit
+    duration into ``tpujob_checkpoint_commit_seconds`` — checkpoint lag
+    in ``tpujob top`` is ``job_step - job_checkpoint_step``."""
+    report(
+        "checkpoint_committed",
+        step=step,
+        commit_ms=round(1000.0 * commit_s, 3),
+        queue_depth=int(queue_depth),
+        oldest_age_s=round(oldest_age_s, 3),
+    )

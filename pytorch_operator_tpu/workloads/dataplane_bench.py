@@ -115,7 +115,10 @@ def bench_cell(
 
     from ..checkpoint import CheckpointManager
 
+    from .. import obs
+
     blocking = ckpt_mode == "blocking"
+    spans_before = obs.records_emitted()
     init_state, train_step, host_batch = _build_model(dim, batch)
 
     # Step-path transfer accounting: every feed goes through this put;
@@ -205,6 +208,12 @@ def bench_cell(
         "last_verified_step": last_verified,
         "all_saves_verified": last_verified == last_saved,
         "final_loss": round(final_loss, 4),
+        # Flight-recorder overhead pin: with TPUJOB_TRACE_DIR unset this
+        # MUST be 0 — the instrumented step path emitted no span records
+        # (the bench_smoke lane asserts it, so observability can never
+        # quietly tax the hot loop).
+        "span_records": obs.records_emitted() - spans_before,
+        "trace_enabled": obs.trace_enabled(),
     }
     log(
         f"[dataplane] ckpt={ckpt_mode:8s} feed={feed_mode:10s} "
@@ -273,6 +282,9 @@ def run(
         ],
         "async_saves_verified": async_["all_saves_verified"]
         and by[("async", "prefetched")]["all_saves_verified"],
+        "trace_disabled_zero_spans": all(
+            c["span_records"] == 0 for c in cells if not c["trace_enabled"]
+        ),
     }
     result = {
         "bench": "data_plane",

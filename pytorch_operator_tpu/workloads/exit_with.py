@@ -30,7 +30,7 @@ import sys
 import time
 from pathlib import Path
 
-from .. import faults
+from .. import faults, obs
 from ..backoff import Backoff, retry_call
 from ..checkpoint import integrity
 from ..runtime import rendezvous
@@ -119,10 +119,12 @@ def _run_steps(
     async_checkpoint: bool = False,
     commit_time: float = 0.0,
 ) -> int:
-    rendezvous.fault_stall_if_armed()  # the rendezvous-join stand-in
+    with obs.span("rendezvous_join", cat="rendezvous"):
+        rendezvous.fault_stall_if_armed()  # the rendezvous-join stand-in
     ckpt = os.environ.get("TPUJOB_CHECKPOINT_DIR")
     root = Path(ckpt) if ckpt else None
-    start = _restore_step(root) if root is not None else 0
+    with obs.span("restore", cat="ckpt"):
+        start = _restore_step(root) if root is not None else 0
     writer = None
     if async_checkpoint and root is not None:
         from ..checkpoint.async_writer import AsyncCheckpointWriter
@@ -133,27 +135,36 @@ def _run_steps(
             ),
             root=root,
             on_error=_report_save_failed,
+            on_commit=rendezvous.report_checkpoint_committed,
         )
     rendezvous.report_first_step(start + 1)
     for step in range(start + 1, steps + 1):
-        rendezvous.report_progress(step, steps_per_sec=1.0 / max(step_time, 1e-6))
-        faults.crash_if_due(step)
-        if root is not None:
-            fault = faults.checkpoint_write_fault()
-            if writer is not None:
-                writer.submit(step, None, fault)
-            else:
-                try:
-                    _commit_step_checkpoint(root, step, fault)
-                except OSError as e:
-                    # Disk-full (enospc) after retries: the step loop
-                    # survives — recovery falls back to the last
-                    # verified step.
-                    _report_save_failed(step, e)
-        if step_time:
-            time.sleep(step_time)
+        with obs.span("step", cat="step", step=step):
+            rendezvous.report_progress(
+                step,
+                steps_per_sec=1.0 / max(step_time, 1e-6),
+                step_time_ms=1000.0 * step_time,
+            )
+            faults.crash_if_due(step)
+            if root is not None:
+                fault = faults.checkpoint_write_fault()
+                if writer is not None:
+                    writer.submit(step, None, fault)
+                else:
+                    try:
+                        _commit_step_checkpoint(root, step, fault)
+                    except OSError as e:
+                        # Disk-full (enospc) after retries: the step loop
+                        # survives — recovery falls back to the last
+                        # verified step.
+                        _report_save_failed(step, e)
+            if step_time:
+                time.sleep(step_time)
     if writer is not None:
         writer.close()  # exit drains: every submitted save is decided
+    rec = obs.tracer()
+    if rec is not None:
+        rec.close()  # flush buffered spans before exit
     print(f"[exit_with] completed {steps} steps (resumed from {start})", flush=True)
     return 0
 

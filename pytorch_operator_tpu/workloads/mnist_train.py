@@ -183,19 +183,21 @@ def main(argv=None) -> int:
             ):
                 yield put_xy(bx, by)
 
-    from .trainer import ProgressHeartbeat
+    from .. import obs
+    from .trainer import ProgressHeartbeat, heartbeat_reporter
 
     step = 0
     loss = None
     # Live telemetry heartbeat (the shared throttle, so cadence/rate
     # semantics match throughput_loop's workloads). None standalone:
-    # no listener, no telemetry fences.
+    # no listener, no telemetry fences. The reporter adds the
+    # flight-recorder extras (interval step time; feed stall when the
+    # prefetcher is on) to each record.
     hb = ProgressHeartbeat(
-        (
-            lambda s, l, sps: rendezvous.report_progress(
-                s, loss=l, steps_per_sec=sps,
-                throughput=sps * batch / dp, unit="images/sec/chip",
-            )
+        heartbeat_reporter(
+            rendezvous.report_progress,
+            batch=batch, n_dev=dp, unit="images/sec/chip",
+            feed=loader,
         )
         if rendezvous.progress_enabled()
         else None
@@ -203,7 +205,10 @@ def main(argv=None) -> int:
     try:
         for epoch in range(args.epochs):
             for gx, gy in epoch_iter(epoch):
-                params, opt_state, loss = train_step(params, opt_state, gx, gy)
+                with obs.span("step", cat="step", step=step):
+                    params, opt_state, loss = train_step(
+                        params, opt_state, gx, gy
+                    )
                 if step == 0:
                     float(jax.device_get(loss))  # real fence (not block_until_ready)
                     rendezvous.report_first_step(step)

@@ -183,6 +183,9 @@ def open_image_feed(
         class _Feed:
             """Caller-owned close handle: prefetcher first, then loader."""
 
+            def stats(self):
+                return pf.stats()  # feed-stall telemetry passthrough
+
             def close(self):
                 pf.close()
                 loader.close()
@@ -517,6 +520,37 @@ class ProgressHeartbeat:
         return done - now  # report time only; the fence was real compute
 
 
+def heartbeat_reporter(report_progress, *, batch=None, n_dev=1, unit=None,
+                       feed=None):
+    """The shared ``ProgressHeartbeat`` → ``report_progress`` adapter:
+    maps (step, loss, steps/sec) into a heartbeat record carrying the
+    flight-recorder extras — interval-averaged step time (the
+    supervisor's ``tpujob_step_time_seconds`` source) and, when ``feed``
+    exposes ``stats()`` (a device prefetcher), the mean feed stall per
+    get (the `tpujob top` feed-stall column)."""
+
+    def report(step, loss, sps):
+        kw = {}
+        if batch is not None:
+            kw["throughput"] = sps * batch / max(n_dev, 1)
+            kw["unit"] = unit or "items/sec/chip"
+        stats = getattr(feed, "stats", None)
+        if stats is not None:
+            try:
+                kw["feed_stall_ms"] = stats()["feed_stall_ms_avg"]
+            except Exception:
+                pass  # telemetry must never kill the step loop
+        report_progress(
+            step,
+            loss=loss,
+            steps_per_sec=sps,
+            step_time_ms=1000.0 / sps if sps > 0 else None,
+            **kw,
+        )
+
+    return report
+
+
 def window_progress(report_progress, *, steps: int, batch: int, n_dev: int,
                     unit: str):
     """The shared rate math behind the image benches' per-window live
@@ -644,17 +678,21 @@ def throughput_loop(
             log(f"first step (compile) +{time.time() - t0:.1f}s")
     device_get(loss)
 
+    from .. import obs
+
     t_excluded = 0.0
     with maybe_profile(profile_dir, log):
         t0 = time.time()
         hb = ProgressHeartbeat(progress, progress_every_s, start_step=step)
         for _ in range(steps):
-            state, loss = train_step(state, batches(step))
+            with obs.span("step", cat="step", step=step):
+                state, loss = train_step(state, batches(step))
             step += 1
             if checkpoint_every and save is not None and step % checkpoint_every == 0:
                 device_get(loss)  # fence before leaving the hot loop
                 t_save = time.time()
-                save(step, state)
+                with obs.span("save", cat="ckpt", step=step):
+                    save(step, state)
                 dt_save = time.time() - t_save
                 t_excluded += dt_save
                 hb.exclude(dt_save)  # the live meter excludes it too

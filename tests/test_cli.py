@@ -353,6 +353,52 @@ class TestEvents:
         assert run_cli("--state-dir", tmp_path / "fresh", "events") == 0
         assert "no events" in capsys.readouterr().out
 
+    def test_events_name_filters_to_one_job(self, tmp_path, job_yaml, capsys):
+        state = tmp_path / "state"
+        other = tmp_path / "other.yaml"
+        other.write_text(
+            "metadata: {name: other-job}\n"
+            "spec:\n  replica_specs:\n    Master:\n"
+            "      template: {module: pytorch_operator_tpu.workloads.noop}\n"
+        )
+        assert run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30") == 0
+        assert run_cli("--state-dir", state, "run", other, "--timeout", "30") == 0
+        capsys.readouterr()
+        assert run_cli("--state-dir", state, "events", "cli-job") == 0
+        out = capsys.readouterr().out
+        assert "cli-job" in out and "other-job" not in out
+
+    def test_events_follow_drains_then_exits_on_finished_job(
+        self, tmp_path, job_yaml, capsys
+    ):
+        """--follow on an already-finished job: one full aggregation-aware
+        drain, then exit 0 (the live-tail loop ends when the job record
+        finishes — crash-loop debugging without re-running describe)."""
+        state = tmp_path / "state"
+        assert run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30") == 0
+        capsys.readouterr()
+        assert run_cli("--state-dir", state, "events", "cli-job", "--follow") == 0
+        out = capsys.readouterr().out
+        assert "TPUJobSubmitted" in out
+        assert "TPUJobSucceeded" in out
+
+    def test_events_follow_requires_name(self, tmp_path, capsys):
+        assert run_cli("--state-dir", tmp_path / "s", "events", "--follow") == 2
+        assert "requires a job NAME" in capsys.readouterr().err
+
+
+class TestTop:
+    def test_top_once_renders_fleet_table(self, tmp_path, job_yaml, capsys):
+        state = tmp_path / "state"
+        assert run_cli("--state-dir", state, "run", job_yaml, "--timeout", "30") == 0
+        capsys.readouterr()
+        assert run_cli("--state-dir", state, "top", "--once") == 0
+        out = capsys.readouterr().out
+        # Finished jobs are noise on a live screen: header renders, the
+        # succeeded job does not.
+        assert "CKPT LAG" in out and "STEPS/S" in out
+        assert "(no active jobs)" in out
+
 
 class TestEventRecorder:
     def test_consecutive_duplicates_aggregate_with_count(self, tmp_path):

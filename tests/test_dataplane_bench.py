@@ -26,6 +26,14 @@ pytestmark = pytest.mark.bench_smoke
 
 @pytest.fixture(scope="module")
 def smoke_result(tmp_path_factory):
+    import os
+
+    from pytorch_operator_tpu.obs import trace as obs_trace
+
+    # The flight-recorder overhead pin below requires tracing OFF: an
+    # env leak from an earlier test would void the zero-span invariant.
+    os.environ.pop(obs_trace.ENV_VAR, None)
+    obs_trace.reset_tracer()
     td = tmp_path_factory.mktemp("dataplane")
     # Small but real: 15 steps, 3 timed saves per cell, ~1.5 MB state.
     return dataplane_bench.run(
@@ -68,6 +76,38 @@ class TestDataPlaneSmoke:
         for c in smoke_result["cells"]:
             assert c["all_saves_verified"], c
             assert c["last_verified_step"] == c["steps"]
+
+    def test_tracing_disabled_adds_zero_step_path_spans(self, smoke_result):
+        """The flight-recorder overhead pin (observability PR): with
+        ``TPUJOB_TRACE_DIR`` unset, the fully instrumented step path
+        (step spans, save spans, feed-thread spans, queue-wait spans)
+        must emit ZERO span records — observability can never quietly
+        tax the hot loop."""
+        assert smoke_result["comparisons"]["trace_disabled_zero_spans"] is True
+        for c in smoke_result["cells"]:
+            assert c["trace_enabled"] is False, c
+            assert c["span_records"] == 0, c
+
+    def test_disabled_span_helper_cost_is_noise(self):
+        """The ≤1% step-time budget, pinned structurally: a disabled
+        ``obs.span`` is one cached None check returning a shared
+        nullcontext. Bound its per-call cost at 5 µs — the PR-3 bench's
+        steps run ~20 ms, so even a span per step, per save, and per
+        feed get stays orders of magnitude under 1%."""
+        import time as _time
+
+        from pytorch_operator_tpu import obs
+
+        assert not obs.trace_enabled()
+        before = obs.records_emitted()
+        n = 50_000
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            with obs.span("step", cat="step"):
+                pass
+        per_call = (_time.perf_counter() - t0) / n
+        assert per_call < 5e-6, f"disabled span helper costs {per_call:.2e}s"
+        assert obs.records_emitted() == before
 
     def test_artifact_shape_is_committed_schema(self, smoke_result, tmp_path):
         out = tmp_path / "bench.json"

@@ -232,7 +232,18 @@ class TestInjector:
         assert inj.drop_heartbeat("Worker", 0) is True
         assert inj.drop_heartbeat("Worker", 0) is True
         assert inj.drop_heartbeat("Worker", 0) is False
-        assert inj.fired == ["drop_heartbeat(*@0)"] * 2
+        # drop_heartbeat is an NTH_KIND: its label carries the
+        # occurrence window, not a step index.
+        assert inj.fired == ["drop_heartbeat(*#1)"] * 2
+
+    def test_drop_heartbeat_nth_window(self):
+        """nth > 1 lets the first beats through — the hang-deadline
+        chaos scenario trains visibly, THEN goes silent."""
+        inj = faults.FaultInjector(
+            FaultPlan(faults=[Fault(kind="drop_heartbeat", nth=3, times=2)])
+        )
+        drops = [inj.drop_heartbeat("Master", 0) for _ in range(6)]
+        assert drops == [False, False, True, True, False, False]
 
     def test_target_and_restart_gating(self):
         plan = FaultPlan(

@@ -148,6 +148,27 @@ class TestMonitoringServer:
         rendered = g.render()
         assert 'queue="we\\"ird\\\\q\\nx"' in rendered
 
+    def test_extra_text_routes_served(self, tmp_path):
+        """The daemon mounts `tpujob top`'s table at /top via
+        text_routes — same plaintext contract as /metrics."""
+        from pytorch_operator_tpu.obs import top as obs_top
+
+        sup = Supervisor(state_dir=tmp_path / "state")
+        srv = MonitoringServer(
+            render_metrics=sup.metrics.render_text,
+            health=lambda: supervisor_health(sup),
+            text_routes={"/top": lambda: obs_top.render(sup.state_dir) + "\n"},
+        )
+        port = srv.start()
+        try:
+            status, ctype, body = _get(port, "/top")
+            assert status == 200
+            assert ctype.startswith("text/plain")
+            assert "CKPT LAG" in body
+        finally:
+            srv.stop()
+            sup.shutdown()
+
     def test_unknown_path_404(self, tmp_path):
         sup = Supervisor(state_dir=tmp_path, persist=False)
         srv = MonitoringServer(

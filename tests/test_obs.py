@@ -1,0 +1,422 @@
+"""Flight-recorder primitives (obs/): Histogram exposition conformance,
+SpanRecorder ring files, the Chrome-trace merger, and `tpujob top`.
+
+Satellite coverage for the observability PR:
+
+- Prometheus exposition conformance for the new ``Histogram`` — bucket
+  monotonicity, ``+Inf`` bucket == ``_count``, label escaping shared
+  with the Counter/Gauge ``_fmt_labels`` (a hostile label value must
+  render identically across families and parse back exactly);
+- SpanRecorder ring-file rotation and writer-crash torn lines (the
+  merger must skip a torn last line by contract);
+- zero-overhead-when-disabled: with ``TPUJOB_TRACE_DIR`` unset the span
+  helpers return one shared nullcontext and emit nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+
+import pytest
+
+from pytorch_operator_tpu import obs
+from pytorch_operator_tpu.controller.metrics import Counter, MetricsRegistry
+from pytorch_operator_tpu.obs import metrics as obs_metrics
+from pytorch_operator_tpu.obs import trace as obs_trace
+from pytorch_operator_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    Histogram,
+    histogram_quantile,
+    parse_prometheus_text,
+)
+from tests.testutil import assert_histogram_conformant
+
+
+@pytest.fixture
+def traced_dir(tmp_path, monkeypatch):
+    """Arm the process tracer at a tmp dir; disarm + close on exit."""
+    d = tmp_path / "trace"
+    monkeypatch.setenv(obs_trace.ENV_VAR, str(d))
+    obs_trace.reset_tracer()
+    yield d
+    monkeypatch.delenv(obs_trace.ENV_VAR, raising=False)
+    obs_trace.reset_tracer()
+
+
+# ---- Histogram ----
+
+
+class TestHistogram:
+    def test_bucket_grid_must_strictly_increase(self):
+        with pytest.raises(ValueError):
+            Histogram("h", buckets=(1.0, 1.0, 2.0))
+
+    def test_exposition_conformance(self):
+        h = Histogram("tpujob_test_seconds", "help text")
+        for v in (0.00005, 0.0002, 0.003, 0.003, 0.07, 1.2, 999.0):
+            h.observe(v, job="a")
+        for v in (0.01, 0.02):
+            h.observe(v, job="b")
+        text = h.render()
+        assert "# TYPE tpujob_test_seconds histogram" in text
+        parsed = parse_prometheus_text(text)
+        assert_histogram_conformant(parsed, "tpujob_test_seconds")
+        # Exact invariants beyond shape: +Inf == count, sum == total.
+        assert h.count(job="a") == 7
+        assert h.count(job="b") == 2
+        assert h.sum(job="b") == pytest.approx(0.03)
+        inf_a = [
+            v for labels, v in parsed["tpujob_test_seconds_bucket"]
+            if labels.get("job") == "a" and labels["le"] == "+Inf"
+        ]
+        assert inf_a == [7]
+        # 999.0 overflows the largest finite bucket: the largest finite
+        # le must hold 6, +Inf all 7.
+        top_fin = [
+            v for labels, v in parsed["tpujob_test_seconds_bucket"]
+            if labels.get("job") == "a"
+            and labels["le"] == f"{max(DEFAULT_BUCKETS):g}"
+        ]
+        assert top_fin == [6]
+
+    def test_boundary_value_is_inclusive(self):
+        # Prometheus le is <=: an observation equal to a bound lands in
+        # that bound's bucket.
+        h = Histogram("h", buckets=(0.1, 1.0))
+        h.observe(0.1)
+        parsed = parse_prometheus_text(h.render())
+        by_le = {labels["le"]: v for labels, v in parsed["h_bucket"]}
+        assert by_le == {"0.1": 1, "1": 1, "+Inf": 1}
+
+    def test_label_escaping_shared_with_counter(self):
+        hostile = 'evil"job\\with\nnewline'
+        h = Histogram("h_total_seconds")
+        h.observe(0.5, job=hostile)
+        c = Counter("c_total")
+        c.inc(1, job=hostile)
+        h_line = next(
+            ln for ln in h.render().splitlines() if ln.startswith("h_total_seconds_sum")
+        )
+        c_line = next(
+            ln for ln in c.render().splitlines() if "{" in ln
+        )
+        # Identical escaped label blob across metric families.
+        h_blob = h_line[h_line.index("{") + 1:h_line.rindex("}")]
+        c_blob = c_line[c_line.index("{") + 1:c_line.rindex("}")]
+        assert h_blob == c_blob
+        # And the parser inverts the escaping exactly.
+        parsed = parse_prometheus_text(h.render())
+        labels, _ = parsed["h_total_seconds_count"][0]
+        assert labels["job"] == hostile
+
+    def test_empty_histogram_renders_family_only(self):
+        h = Histogram("h_empty", "nothing yet")
+        text = h.render()
+        assert "# TYPE h_empty histogram" in text
+        assert "h_empty_bucket" not in text
+
+    def test_quantile_interpolation(self):
+        h = Histogram("h", buckets=(1.0, 2.0, 4.0))
+        for _ in range(100):
+            h.observe(1.5)
+        # All mass in (1, 2]: p50 interpolates inside that bucket.
+        q = h.quantile(0.5)
+        assert 1.0 < q <= 2.0
+        # +Inf-bucket mass clamps to the largest finite bound.
+        h2 = Histogram("h2", buckets=(1.0,))
+        h2.observe(50.0)
+        assert h2.quantile(0.99) == 1.0
+        assert h2.quantile(0.5, job="missing") is None
+
+    def test_histogram_quantile_helper_edge_cases(self):
+        assert histogram_quantile([], 0.5) is None
+        assert histogram_quantile([(1.0, 0), (float("inf"), 0)], 0.5) is None
+        cum = [(1.0, 10), (2.0, 10), (float("inf"), 10)]
+        # Flat tail: quantile stays at the first bound that covers rank.
+        assert histogram_quantile(cum, 0.99) <= 1.0
+
+    def test_registry_serves_histograms(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("tpujob_extra_seconds", "x")
+        assert reg.histogram("tpujob_extra_seconds") is h
+        h.observe(0.2, job="j")
+        reg.sync_pass_seconds.observe(0.01, phase="total")
+        text = reg.render_text()
+        parsed = parse_prometheus_text(text)
+        assert_histogram_conformant(parsed, "tpujob_extra_seconds")
+        assert_histogram_conformant(parsed, "tpujob_sync_pass_seconds")
+        assert text.endswith("\n")
+
+    def test_parser_skips_garbage_lines(self):
+        text = "a_metric 1.5\nnot a metric line at all\nb{x=\"y\"} nan?\n"
+        parsed = parse_prometheus_text(text)
+        assert parsed == {"a_metric": [({}, 1.5)]}
+
+
+# ---- SpanRecorder / tracer ----
+
+
+class TestSpanRecorderDisabled:
+    def test_disabled_is_shared_nullcontext_and_zero_records(self, monkeypatch):
+        monkeypatch.delenv(obs_trace.ENV_VAR, raising=False)
+        obs_trace.reset_tracer()
+        assert obs.tracer() is None
+        assert not obs.trace_enabled()
+        before = obs.records_emitted()
+        cm = obs.span("step", cat="step", step=1)
+        # THE zero-overhead contract: one shared nullcontext, no
+        # allocation, nothing emitted.
+        assert cm is obs_trace._NULL
+        with cm:
+            pass
+        obs.instant("marker")
+        assert obs.records_emitted() == before
+
+
+class TestSpanRecorder:
+    def test_spans_recorded_with_chrome_fields(self, traced_dir):
+        with obs.span("step", cat="step", step=3):
+            time.sleep(0.002)
+        obs.instant("kill", cat="fault", target="worker-0")
+        rec = obs.tracer()
+        rec.flush()
+        events = obs_trace.load_span_file(rec.path)
+        meta = [e for e in events if e["ph"] == "M"]
+        spans = [e for e in events if e["ph"] == "X"]
+        assert {m["name"] for m in meta} >= {"process_name", "clock_sync"}
+        step = next(e for e in spans if e["name"] == "step")
+        assert step["cat"] == "step"
+        assert step["args"] == {"step": 3}
+        assert step["dur"] >= 2000  # microseconds
+        for field in ("ts", "dur", "pid", "tid"):
+            assert isinstance(step[field], (int, float))
+        kill = next(e for e in spans if e["name"] == "kill")
+        assert kill["dur"] == 0
+
+    def test_ring_rotation_keeps_two_generations(self, tmp_path):
+        rec = obs_trace.SpanRecorder(tmp_path, "proc", max_bytes=4096)
+        for i in range(400):
+            rec.emit("s", "cat", time.time(), 0.001, i=i, pad="x" * 40)
+        rec.close()
+        files = obs_trace.span_files(tmp_path)
+        assert rec.path in files
+        rotated = rec.path.with_suffix(".jsonl.1")
+        assert rotated in files
+        # Ring bound: current generation respects max_bytes; older
+        # generations beyond .1 were dropped, not accumulated.
+        assert rec.path.stat().st_size <= 4096
+        assert len(files) == 2
+        # The merge spans both generations and the new generation is
+        # self-describing (a process_name metadata record re-emitted).
+        doc = obs_trace.merge_trace_files(files)
+        spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert spans[-1]["args"]["i"] == 399
+        assert len(spans) > 1
+        cur_events = obs_trace.load_span_file(rec.path)
+        assert any(e["ph"] == "M" for e in cur_events)
+
+    def test_torn_last_line_is_skipped_by_merger(self, tmp_path):
+        rec = obs_trace.SpanRecorder(tmp_path, "crashy")
+        rec.emit("good", "cat", 1.0, 0.5)
+        rec.close()
+        # A SIGKILLed writer tears its buffered tail: append half a
+        # record with no newline, plus a foreign line for good measure.
+        with open(rec.path, "ab") as f:
+            f.write(b'not json at all\n')
+            f.write(b'[1, 2, 3]\n')  # JSON, but not a span record
+            f.write(b'{"name": "half", "ph": "X", "ts": 12')
+        events = obs_trace.load_span_file(rec.path)
+        spans = [e for e in events if e["ph"] == "X"]
+        assert [s["name"] for s in spans] == ["good"]
+        # ph=X records missing ts/dur are dropped too.
+        with open(rec.path, "ab") as f:
+            f.write(b'\n{"name": "no-ts", "ph": "X"}\n')
+        spans = [
+            e for e in obs_trace.load_span_file(rec.path) if e["ph"] == "X"
+        ]
+        assert [s["name"] for s in spans] == ["good"]
+
+    def test_load_missing_file_is_empty(self, tmp_path):
+        assert obs_trace.load_span_file(tmp_path / "nope.jsonl") == []
+
+    def test_reset_rereads_env(self, traced_dir):
+        assert obs.trace_enabled()
+        first = obs.tracer()
+        obs_trace.reset_tracer()
+        second = obs.tracer()
+        assert second is not first and second is not None
+
+
+class TestMerge:
+    def _mk(self, tmp_path, name, ts_list):
+        rec = obs_trace.SpanRecorder(tmp_path, name)
+        for ts in ts_list:
+            rec.emit("e", "cat", ts, 0.001, src=name)
+        rec.close()
+        return rec.path
+
+    def test_merge_sorts_and_keeps_meta_first(self, tmp_path):
+        a = self._mk(tmp_path, "a", [3.0, 1.0])
+        b = self._mk(tmp_path, "b", [2.0])
+        doc = obs_trace.merge_trace_files([a, b])
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        spans = [e for e in events if e.get("ph") == "X"]
+        metas = [e for e in events if e.get("ph") == "M"]
+        assert metas and events[:len(metas)] == metas
+        assert [s["ts"] for s in spans] == sorted(s["ts"] for s in spans)
+        # The whole document is valid Chrome-trace JSON.
+        json.loads(json.dumps(doc))
+
+    def test_clock_offsets_shift_spans_not_meta(self, tmp_path):
+        a = self._mk(tmp_path, "a", [1.0])
+        doc = obs_trace.merge_trace_files([a], clock_offsets={a: 2.0})
+        span = next(e for e in doc["traceEvents"] if e.get("ph") == "X")
+        assert span["ts"] == pytest.approx(3.0e6)
+
+
+# ---- reconciler trace-dir injection (spec knob vs global) ----
+
+
+class TestTraceDirInjection:
+    def _reconciler(self, tmp_path):
+        from pytorch_operator_tpu.controller import (
+            EventRecorder,
+            FakeRunner,
+            GangScheduler,
+            JobStore,
+            Reconciler,
+        )
+
+        return Reconciler(
+            store=JobStore(),
+            runner=FakeRunner(),
+            events=EventRecorder(),
+            metrics=MetricsRegistry(),
+            gang=GangScheduler(enabled=True),
+            trace_root=tmp_path / "trace",
+        )
+
+    def test_spec_knob_arms_per_job_dir(self, tmp_path, monkeypatch):
+        from pytorch_operator_tpu.api import ObservabilityPolicy
+        from tests.testutil import new_job
+
+        monkeypatch.delenv(obs_trace.ENV_VAR, raising=False)
+        obs_trace.reset_tracer()
+        rec = self._reconciler(tmp_path)
+        job = new_job(name="traced")
+        assert rec._trace_dir(job, "default/traced") is None
+        job.spec.observability = ObservabilityPolicy(trace=True)
+        d = rec._trace_dir(job, "default/traced")
+        assert d is not None and d.endswith("default_traced")
+
+    def test_global_tracing_traces_every_job(self, tmp_path, monkeypatch):
+        from tests.testutil import new_job
+
+        monkeypatch.setenv(obs_trace.ENV_VAR, str(tmp_path / "sup-trace"))
+        obs_trace.reset_tracer()
+        try:
+            rec = self._reconciler(tmp_path)
+            job = new_job(name="plain")  # no spec opt-in
+            assert rec._trace_dir(job, "default/plain") is not None
+        finally:
+            monkeypatch.delenv(obs_trace.ENV_VAR, raising=False)
+            obs_trace.reset_tracer()
+
+    def test_env_builder_clears_inherited_trace_dir(self):
+        from pytorch_operator_tpu.api import ReplicaType
+        from pytorch_operator_tpu.runtime.env import build_cluster_env
+        from tests.testutil import new_job
+
+        job = new_job(name="envjob")
+        env = build_cluster_env(job, ReplicaType.WORKER, 0)
+        # A traced supervisor must not leak ITS trace dir into replicas.
+        assert env["TPUJOB_TRACE_DIR"] == ""
+        env = build_cluster_env(
+            job, ReplicaType.WORKER, 0, trace_dir="/tmp/t"
+        )
+        assert env["TPUJOB_TRACE_DIR"] == "/tmp/t"
+
+
+# ---- device-feed spans (the data-plane layer of the trace) ----
+
+
+class TestDeviceFeedSpans:
+    def test_feed_thread_spans_and_stall_stats(self, traced_dir):
+        from pytorch_operator_tpu.data.device_prefetch import DevicePrefetcher
+
+        pf = DevicePrefetcher(lambda: 1, put=lambda x: x + 1, depth=2)
+        try:
+            assert [pf.get() for _ in range(4)] == [2, 2, 2, 2]
+            stats = pf.stats()
+        finally:
+            pf.close()
+        assert stats["gets"] == 4 and stats["batches"] >= 4
+        assert stats["feed_stall_ms_avg"] >= 0.0
+        rec = obs.tracer()
+        rec.flush()
+        names = {
+            e["name"]
+            for e in obs_trace.load_span_file(rec.path)
+            if e["ph"] == "X"
+        }
+        assert {"feed_produce", "feed_put"} <= names
+
+
+# ---- tpujob top ----
+
+
+class TestTop:
+    def _seed_state(self, tmp_path):
+        from pytorch_operator_tpu.controller.progress import job_status_dir
+        from pytorch_operator_tpu.controller.store import JobStore
+        from tests.testutil import new_job
+
+        state = tmp_path / "state"
+        store = JobStore(persist_dir=state / "jobs")
+        job = new_job(name="live", workers=0)
+        key = store.add(job)
+        now = time.time()
+        d = job_status_dir(state / "status", key)
+        d.mkdir(parents=True)
+        recs = [
+            {"event": "progress", "ts": now - 1, "step": 40,
+             "steps_per_sec": 8.0, "feed_stall_ms": 0.25},
+            {"event": "checkpoint_committed", "ts": now - 2, "step": 35,
+             "commit_ms": 12.0, "queue_depth": 1, "oldest_age_s": 0.1},
+        ]
+        (d / "master-0.jsonl").write_text(
+            "".join(json.dumps(r) + "\n" for r in recs)
+        )
+        from pytorch_operator_tpu.obs.top import STEP_HIST
+
+        h = Histogram(STEP_HIST)
+        for v in (0.1, 0.12, 0.3):
+            h.observe(v, job=key)
+        (state / "metrics.prom").write_text(h.render() + "\n")
+        return state, key
+
+    def test_gather_rows_and_render(self, tmp_path):
+        from pytorch_operator_tpu.obs import top
+
+        state, key = self._seed_state(tmp_path)
+        rows = top.gather_rows(state)
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["job"] == key
+        assert r["step"] == 40.0
+        assert r["ckpt_lag"] == 5
+        assert r["steps_per_sec"] == 8.0
+        assert r["feed_stall_ms"] == 0.25
+        assert r["p50_ms"] is not None and r["p99_ms"] >= r["p50_ms"]
+        assert r["age_s"] >= 0.5
+        text = top.render_table(rows)
+        assert "CKPT LAG" in text and key in text
+
+    def test_empty_state_renders_placeholder(self, tmp_path):
+        from pytorch_operator_tpu.obs import top
+
+        out = top.render(tmp_path / "fresh")
+        assert "(no active jobs)" in out

@@ -62,3 +62,43 @@ def new_job(
     if defaulted:
         set_defaults(job)
     return job
+
+
+def assert_histogram_conformant(parsed: dict, name: str) -> None:
+    """Prometheus histogram exposition invariants for one metric family
+    parsed from text (obs.metrics.parse_prometheus_text): at least one
+    series; per series, cumulative ``_bucket`` values monotone
+    nondecreasing over increasing ``le``; a ``+Inf`` bucket present and
+    equal to ``_count``; a ``_sum`` sample present and consistent with
+    the observed count (zero iff count is zero, for nonnegative
+    latencies)."""
+    buckets = parsed.get(f"{name}_bucket") or []
+    sums = parsed.get(f"{name}_sum") or []
+    counts = parsed.get(f"{name}_count") or []
+    assert buckets, f"{name}: no _bucket series in exposition"
+
+    def base_key(labels: dict) -> tuple:
+        return tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+
+    series: dict = {}
+    for labels, v in buckets:
+        assert "le" in labels, f"{name}_bucket sample without le: {labels}"
+        series.setdefault(base_key(labels), []).append((labels["le"], v))
+    sum_by = {base_key(l): v for l, v in sums}
+    count_by = {base_key(l): v for l, v in counts}
+    for key, entries in series.items():
+        ordered = sorted(
+            (float("inf") if le == "+Inf" else float(le), v)
+            for le, v in entries
+        )
+        bounds = [b for b, _ in ordered]
+        assert len(set(bounds)) == len(bounds), f"{name}{key}: duplicate le"
+        cums = [v for _, v in ordered]
+        assert cums == sorted(cums), f"{name}{key}: buckets not cumulative"
+        assert bounds[-1] == float("inf"), f"{name}{key}: no +Inf bucket"
+        assert key in count_by, f"{name}{key}: missing _count"
+        assert key in sum_by, f"{name}{key}: missing _sum"
+        assert cums[-1] == count_by[key], (
+            f"{name}{key}: +Inf bucket {cums[-1]} != count {count_by[key]}"
+        )
+        assert (sum_by[key] == 0) == (count_by[key] == 0) or sum_by[key] >= 0
