@@ -423,16 +423,41 @@ class ObservabilityPolicy:
     <job>`` merges into one Chrome-trace/Perfetto JSON. Off (the
     default) the span helpers are a cached None check — zero step-path
     overhead, pinned by the bench_smoke lane.
+
+    ``trace_ring_bytes`` / ``trace_flush_every`` size the per-process
+    span ring (bytes per generation, two generations kept) and the
+    record-count flush cadence — spec knobs instead of the former fixed
+    constants, threaded as ``TPUJOB_TRACE_RING_BYTES`` /
+    ``TPUJOB_TRACE_FLUSH_EVERY``. 0 (the default) keeps the built-in
+    defaults (obs/trace.py: 8 MiB, 32 records).
     """
 
     trace: bool = False
+    trace_ring_bytes: int = 0
+    trace_flush_every: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"trace": True} if self.trace else {}
+        d: Dict[str, Any] = {}
+        if self.trace:
+            d["trace"] = True
+        if self.trace_ring_bytes:
+            d["trace_ring_bytes"] = self.trace_ring_bytes
+        if self.trace_flush_every:
+            d["trace_flush_every"] = self.trace_flush_every
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ObservabilityPolicy":
-        return cls(trace=bool(d.get("trace", False)))
+        return cls(
+            trace=bool(d.get("trace", False)),
+            trace_ring_bytes=_parse_int(
+                d.get("trace_ring_bytes", 0), "observability.trace_ring_bytes"
+            ),
+            trace_flush_every=_parse_int(
+                d.get("trace_flush_every", 0),
+                "observability.trace_flush_every",
+            ),
+        )
 
 
 @dataclass

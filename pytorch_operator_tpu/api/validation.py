@@ -123,6 +123,13 @@ def validate_spec(spec: TPUJobSpec) -> List[str]:
     if spec.data_plane is not None and spec.data_plane.prefetch < 0:
         errs.append("spec.data_plane.prefetch: must be >= 0")
 
+    if spec.observability is not None:
+        ob = spec.observability
+        if ob.trace_ring_bytes < 0:
+            errs.append("spec.observability.trace_ring_bytes: must be >= 0")
+        if ob.trace_flush_every < 0:
+            errs.append("spec.observability.trace_flush_every: must be >= 0")
+
     return errs
 
 

@@ -505,9 +505,15 @@ def _get_once(args, missing_ok: bool = False, store=None) -> int:
 
 def cmd_trace(args) -> int:
     """Merge the supervisor's and every replica's span files into one
-    Chrome-trace/Perfetto JSON for this job (obs/trace.py). Open the
-    output at https://ui.perfetto.dev or chrome://tracing."""
+    Chrome-trace/Perfetto JSON for this job (obs/trace.py), with
+    per-replica clock corrections from the heartbeat-matching estimator
+    (obs/clock.py) so cross-host timelines come out causally ordered.
+    Open the output at https://ui.perfetto.dev or chrome://tracing."""
     from pytorch_operator_tpu.obs import merge_trace_files
+    from pytorch_operator_tpu.obs.clock import (
+        estimate_job_offsets,
+        offsets_for_trace_files,
+    )
     from pytorch_operator_tpu.obs.trace import span_files
 
     state = _state_dir(args)
@@ -524,7 +530,19 @@ def cmd_trace(args) -> int:
             file=sys.stderr,
         )
         return 1
-    doc = merge_trace_files(paths)
+    # Clock alignment: per-replica offsets estimated from the job's
+    # heartbeat observation log (empty → no corrections, the single-host
+    # behavior). --no-clock-sync keeps raw per-host timestamps.
+    offsets = {}
+    if not getattr(args, "no_clock_sync", False):
+        estimates = estimate_job_offsets(state, key)
+        offsets = offsets_for_trace_files(paths, estimates)
+        for p, off in sorted(offsets.items()):
+            print(
+                f"clock_sync: {Path(p).name} corrected by {off:+.6f}s",
+                file=sys.stderr,
+            )
+    doc = merge_trace_files(paths, clock_offsets=offsets or None)
     n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
     if args.out:
         Path(args.out).write_text(json.dumps(doc) + "\n")
@@ -537,25 +555,132 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_why(args) -> int:
+    """The postmortem engine (obs/analyze.py): reconstruct the job's
+    causal timeline from recorded artifacts — clock-aligned heartbeats,
+    events, spans — and run the detector pass (step-time regression,
+    feed-stall dominance, checkpoint lag, heartbeat silence, straggler).
+    Strictly offline: reads the state dir, touches no live process."""
+    from pytorch_operator_tpu.obs import analyze as obs_analyze
+
+    state = _state_dir(args)
+    key = _resolve_key(args)
+    report = obs_analyze.analyze(state, key, window_s=args.window)
+    if (
+        not report["replicas"]
+        and not report["events"]
+        and report["phase"] is None
+    ):
+        print(
+            f"error: no recorded artifacts for tpujob {key} under {state} "
+            "(no status records, events, or job object)",
+            file=sys.stderr,
+        )
+        return 1
+    if args.out:
+        Path(args.out).write_text(json.dumps(report, indent=2) + "\n")
+    if getattr(args, "json", False):
+        print(json.dumps(report, indent=2))
+    else:
+        print(obs_analyze.render_report(report))
+        if args.out:
+            print(f"\nwrote {args.out}")
+    return 0
+
+
 def cmd_top(args) -> int:
     """Live one-screen fleet table (obs/top.py): per-job step, steps/s,
     p50/p99 step time, checkpoint lag, feed stall — from the status-dir
-    heartbeats plus the daemon's metrics.prom when present."""
+    heartbeats plus the daemon's metrics.prom when present.
+
+    On a TTY the repaint loop takes keys (still no curses): ``s`` cycles
+    the sort column, ``r`` flips direction, ``/`` starts a job-name
+    substring filter (Enter/Esc ends it), ``c`` clears the filter,
+    ``q`` quits."""
     from pytorch_operator_tpu.obs import top as obs_top
 
     state = _state_dir(args)
     if args.once:
         print(obs_top.render(state))
         return 0
+
+    sort_idx = None  # index into obs_top.COLUMNS; None = default order
+    reverse = True
+    filt = ""
+    filter_mode = False
+
+    def paint(interactive: bool) -> None:
+        key = None if sort_idx is None else obs_top.COLUMNS[sort_idx][1]
+        body = obs_top.render(
+            state, sort_key=key, reverse=reverse, filter_str=filt or None
+        )
+        if interactive:
+            hint = (
+                f"filter> {filt}▏  (Enter=apply, Esc=cancel)"
+                if filter_mode
+                else "keys: s=sort col  r=reverse  /=filter  c=clear  q=quit"
+            )
+            body += "\n\n" + hint
+        # ANSI clear + home — a poor man's curses, dependency-free.
+        sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
+        sys.stdout.flush()
+
+    interactive = sys.stdin.isatty()
+    if not interactive:
+        # Piped/headless: the plain repaint loop (previous behavior).
+        try:
+            while True:
+                paint(False)
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+    import os
+    import select
+    import termios
+    import tty
+
+    fd = sys.stdin.fileno()
+    saved = termios.tcgetattr(fd)
     try:
+        tty.setcbreak(fd)
+        deadline = 0.0
         while True:
-            body = obs_top.render(state)
-            # ANSI clear + home — a poor man's curses, dependency-free.
-            sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
-            sys.stdout.flush()
-            time.sleep(args.interval)
+            now = time.time()
+            if now >= deadline:
+                paint(True)
+                deadline = now + args.interval
+            ready, _, _ = select.select([sys.stdin], [], [], deadline - now)
+            if not ready:
+                continue
+            ch = os.read(fd, 1).decode(errors="replace")
+            if filter_mode:
+                if ch in ("\r", "\n"):
+                    filter_mode = False
+                elif ch == "\x1b":  # Esc cancels the filter being typed
+                    filter_mode, filt = False, ""
+                elif ch in ("\x7f", "\b"):
+                    filt = filt[:-1]
+                elif ch.isprintable():
+                    filt += ch
+            elif ch == "q":
+                sys.stdout.write("\n")
+                return 0
+            elif ch == "s":
+                sort_idx = 0 if sort_idx is None else sort_idx + 1
+                if sort_idx >= len(obs_top.COLUMNS):
+                    sort_idx = None
+            elif ch == "r":
+                reverse = not reverse
+            elif ch == "/":
+                filter_mode, filt = True, ""
+            elif ch == "c":
+                filt = ""
+            deadline = 0.0  # immediate repaint on any key
     except KeyboardInterrupt:
         return 0
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, saved)
 
 
 def _follow_events(args, state: Path, key: str) -> int:
@@ -1194,8 +1319,38 @@ def build_parser() -> argparse.ArgumentParser:
         "--out", default=None,
         help="write the trace JSON here (default: stdout)",
     )
+    sp.add_argument(
+        "--no-clock-sync", action="store_true", dest="no_clock_sync",
+        help="skip the heartbeat-matched per-replica clock corrections "
+        "(keep each host's raw timestamps)",
+    )
     add_ns(sp)
     sp.set_defaults(func=cmd_trace)
+
+    sp = sub.add_parser(
+        "why",
+        help="postmortem a job from its recorded artifacts: clock-align "
+        "the cross-host timeline, run the anomaly detectors (step-time "
+        "regression, feed stall, checkpoint lag, heartbeat silence, "
+        "straggler), print findings with evidence",
+    )
+    sp.add_argument("name")
+    sp.add_argument(
+        "--window", type=float, default=None,
+        help="analyze only the last N seconds of the recorded timeline "
+        "(default: everything; the regression baseline is what precedes "
+        "the window)",
+    )
+    sp.add_argument(
+        "--out", default=None,
+        help="also write the machine-readable JSON report here",
+    )
+    sp.add_argument(
+        "--json", action="store_true",
+        help="print the JSON report instead of the terminal rendering",
+    )
+    add_ns(sp)
+    sp.set_defaults(func=cmd_why)
 
     sp = sub.add_parser(
         "top",

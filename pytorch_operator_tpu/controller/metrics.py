@@ -74,6 +74,20 @@ class Gauge:
         with self._lock:
             self._values.clear()
 
+    def drop_series(self, label: str, value: str) -> int:
+        """Retire every series carrying ``label == value`` (a deleted
+        job's per-job gauges). Returns the count dropped."""
+        pair = (label, str(value))
+        with self._lock:
+            doomed = [k for k in self._values if pair in k]
+            for k in doomed:
+                del self._values[k]
+        return len(doomed)
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._values)
+
     def get(self, **labels: str) -> float:
         key = tuple(sorted(labels.items()))
         with self._lock:
@@ -267,6 +281,32 @@ class MetricsRegistry:
 
             self._histograms[name] = Histogram(name, help_text, buckets)
         return self._histograms[name]
+
+    def retire_job(self, key: str) -> int:
+        """Metric lifecycle: drop every ``job=<key>`` series — histogram
+        buckets AND gauges — from the live registry. Called when a job
+        is deleted (reconciler/TTL GC, ``tpujob delete``): per-job
+        series are label-cardinality a supervisor pays FOREVER otherwise
+        (the ROADMAP unbounded-cardinality item — fine for thousands of
+        jobs, fatal for millions). Finished-but-undeleted jobs keep
+        their series: they are the postmortem surface ``tpujob why``
+        reads. Returns the number of series dropped."""
+        dropped = 0
+        for h in self._histograms.values():
+            dropped += h.drop_series("job", key)
+        for g in self._gauges.values():
+            dropped += g.drop_series("job", key)
+        return dropped
+
+    def series_count(self) -> int:
+        """Total live labeled series across all families — the bound
+        the churn test pins."""
+        n = 0
+        for h in self._histograms.values():
+            n += h.series_count()
+        for g in self._gauges.values():
+            n += g.series_count()
+        return n
 
     def render_text(self) -> str:
         parts = [c.render() for c in self._counters.values()]

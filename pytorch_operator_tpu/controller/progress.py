@@ -175,12 +175,17 @@ class ProgressTailer:
     def __init__(self) -> None:
         # path -> [consumed_offset, {kind: newest_sanitized_record}]
         self._files: dict = {}
+        # dir -> [paths] index maintained by poll(), so replica_latest
+        # is O(this job's files), not O(every tailed file in the fleet)
+        # — the per-pass clock fold must not undo the O(1) idle pass.
+        self._dir_files: dict = {}
         self.io = TailerIOCounters()
 
     def _drop_dir(self, d: Path) -> None:
         prefix = str(d) + os.sep
         for p in [p for p in self._files if p.startswith(prefix)]:
             del self._files[p]
+        self._dir_files.pop(str(d), None)
 
     def _consume(self, path: str, offset: int, skip_partial: bool):
         """Read complete lines appended past ``offset``; returns
@@ -227,6 +232,22 @@ class ProgressTailer:
         (same result shape as :func:`read_latest_progress`)."""
         return self.poll(status_dir).get("progress")
 
+    def replica_latest(self, status_dir) -> dict:
+        """``{replica: {kind: newest record}}`` from the state the last
+        :meth:`poll` of this directory left behind — ZERO I/O. The
+        supervisor's clock-observation fold (obs/clock.py) needs the
+        newest beat PER REPLICA, not just the job-wide newest that
+        ``poll`` returns; reading it from the per-file state costs
+        nothing extra."""
+        if status_dir is None:
+            return {}
+        out: dict = {}
+        for path in self._dir_files.get(str(Path(status_dir)), ()):
+            st = self._files.get(path)
+            if st is not None and st[1]:
+                out[Path(path).stem] = st[1]
+        return out
+
     def poll(self, status_dir) -> dict:
         """One incremental scan; returns the newest record per tailed
         kind across the job's replica files, e.g. ``{"progress": {...},
@@ -271,9 +292,10 @@ class ProgressTailer:
                 if cur is None or rec["ts"] > cur["ts"]:
                     best[kind] = rec
         # Files deleted under us must not pin stale records forever.
-        prefix = str(d) + os.sep
-        for p in [p for p in self._files if p.startswith(prefix) and p not in seen]:
-            del self._files[p]
+        for p in self._dir_files.get(str(d), ()):
+            if p not in seen and p in self._files:
+                del self._files[p]
+        self._dir_files[str(d)] = sorted(seen)
         return best
 
 

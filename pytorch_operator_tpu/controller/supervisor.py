@@ -127,6 +127,13 @@ class Supervisor:
         # histograms must observe each record ONCE, not once per pass.
         self._hb_observed: dict = {}
         self._ckpt_observed: dict = {}
+        # Clock-observation fold (obs/clock.py): per-(key, replica) ts of
+        # the newest beat already paired with a supervisor observe time,
+        # and one append-only log per job. First sight of a replica only
+        # PRIMES the dedup — a daemon restart must not pair a stale beat
+        # with a fresh observe time (a garbage delay sample).
+        self._clock_logs: dict = {}
+        self._clock_seen: dict = {}
 
     # ---- API-server-ish surface ----
 
@@ -191,6 +198,7 @@ class Supervisor:
             if job is not None:
                 self.store.delete(key)
             self.events.drop_job(key)
+            self._retire_job_telemetry(key)
             if purge_artifacts:
                 purge_job_artifacts(self.state_dir, key)
         # NOTE: the key's reconcile lock is NOT dropped here — delete_job
@@ -554,7 +562,9 @@ class Supervisor:
         for key, job in jobs:
             if job.is_finished():
                 continue
-            by_kind = self._progress.poll(job_status_dir(root, key))
+            status_dir = job_status_dir(root, key)
+            by_kind = self._progress.poll(status_dir)
+            self._record_clock_observations(key, status_dir)
             rec = by_kind.get("progress")
             if rec is not None:
                 if rec.get("step") is not None:
@@ -581,8 +591,17 @@ class Supervisor:
                 if sps and rec["ts"] > self._hb_observed.get(key, 0.0):
                     self._hb_observed[key] = rec["ts"]
                     st = rec.get("step_time_ms")
+                    # Exemplar = the span coordinates of the step this
+                    # beat reported: `tpujob top`/`why` can jump from a
+                    # histogram cell straight to the trace span.
+                    ex = (
+                        f"{rec.get('replica', '?')}/step:{int(rec['step'])}"
+                        if rec.get("step") is not None
+                        else None
+                    )
                     m.step_time_seconds.observe(
                         st / 1000.0 if st is not None else 1.0 / float(sps),
+                        exemplar=ex,
                         job=key,
                     )
             ck = by_kind.get("checkpoint_committed")
@@ -602,9 +621,41 @@ class Supervisor:
                     and ck["ts"] > self._ckpt_observed.get(key, 0.0)
                 ):
                     self._ckpt_observed[key] = ck["ts"]
-                    m.checkpoint_commit_seconds.observe(
-                        float(ck["commit_ms"]) / 1000.0, job=key
+                    ex = (
+                        f"{ck.get('replica', '?')}/ckpt_commit:{int(ck['step'])}"
+                        if ck.get("step") is not None
+                        else None
                     )
+                    m.checkpoint_commit_seconds.observe(
+                        float(ck["commit_ms"]) / 1000.0, exemplar=ex, job=key
+                    )
+
+    def _record_clock_observations(self, key: str, status_dir) -> None:
+        """Pair each replica's NEW heartbeat-send timestamp with this
+        supervisor's observe time and append it to the job's clock log —
+        the raw material for the cross-host offset estimator
+        (obs/clock.py). Zero I/O when no replica beat since the last
+        pass; first sight of a replica primes the dedup without logging
+        (see __init__)."""
+        by_replica = self._progress.replica_latest(status_dir)
+        if not by_replica:
+            return
+        now = time.time()
+        for replica, kinds in by_replica.items():
+            rec = kinds.get("progress")
+            if rec is None:
+                continue
+            seen = self._clock_seen.get((key, replica))
+            if seen is not None and rec["ts"] > seen:
+                log = self._clock_logs.get(key)
+                if log is None:
+                    from ..obs.clock import ClockLog, job_clock_log
+
+                    log = ClockLog(job_clock_log(self.state_dir, key))
+                    self._clock_logs[key] = log
+                log.observe(replica, rec["ts"], now)
+            if seen is None or rec["ts"] > seen:
+                self._clock_seen[(key, replica)] = rec["ts"]
 
     def _maybe_preempt(self, jobs, now: float) -> None:
         """volcano ``preempt``: evict lower-priority running worlds so the
@@ -670,6 +721,19 @@ class Supervisor:
                     continue
                 self.reconciler.preempt_world(vjob, vkey, active, key, now=now)
                 self.store.update(vjob)
+
+    def _retire_job_telemetry(self, key: str) -> None:
+        """Metric lifecycle on job deletion (reconciler GC, TTL, CLI
+        delete): drop the job's per-job histogram/gauge series from the
+        live registry and forget the supervisor-side fold state — the
+        ROADMAP unbounded-cardinality fix. A churn of N jobs leaves the
+        registry bounded (pinned by tests/test_obs_analyze.py)."""
+        self.metrics.retire_job(key)
+        self._hb_observed.pop(key, None)
+        self._ckpt_observed.pop(key, None)
+        self._clock_logs.pop(key, None)
+        for k in [k for k in self._clock_seen if k[0] == key]:
+            del self._clock_seen[k]
 
     def _gc_ttl(self, job: TPUJob, key: str, now: float) -> None:
         """TTLSecondsAfterFinished → delete the job object (SURVEY.md §3.4)."""

@@ -17,15 +17,19 @@ supervisor's per-job reconcile both touch them every iteration):
   knob is unset: one cached None check, a shared nullcontext, no I/O.
 
 ``tpujob trace <job>`` merges the supervisor's and every replica's span
-files into one Chrome-trace/Perfetto JSON (:func:`merge_trace_files`);
-``tpujob top`` renders the live fleet table from ``/metrics`` +
-progress heartbeats (obs/top.py).
+files into one Chrome-trace/Perfetto JSON (:func:`merge_trace_files`),
+clock-aligning cross-host files via the heartbeat-matched offset
+estimator (obs/clock.py); ``tpujob top`` renders the live fleet table
+from ``/metrics`` + progress heartbeats (obs/top.py); ``tpujob why``
+runs the offline postmortem — causal timeline + anomaly detectors —
+over the recorded artifacts (obs/analyze.py).
 """
 
 from .metrics import (
     DEFAULT_BUCKETS,
     Histogram,
     histogram_quantile,
+    parse_exemplars,
     parse_prometheus_text,
 )
 from .trace import (
@@ -48,6 +52,7 @@ __all__ = [
     "instant",
     "load_span_file",
     "merge_trace_files",
+    "parse_exemplars",
     "parse_prometheus_text",
     "records_emitted",
     "reset_tracer",

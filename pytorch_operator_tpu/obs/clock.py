@@ -1,0 +1,299 @@
+"""Cross-host clock alignment from heartbeat observations.
+
+The flight recorder timestamps spans with each process's own wall
+clock. On one host those clocks agree; across hosts they can be skewed
+by milliseconds to seconds (NTP droop, VM migration), which inverts
+causality in a merged trace — a worker's ``rendezvous_join`` can appear
+to START before the coordinator that admitted it was even launched.
+``merge_trace_files(clock_offsets=...)`` has carried the correction
+hook since the recorder shipped; this module computes the corrections.
+
+The insight is that a clock reference already flows through the system
+for free: every progress heartbeat carries the REPLICA's send timestamp
+(``ts``, runtime/rendezvous.py:report), and the supervisor — whose
+clock is the reference frame for events, kills, and its own spans —
+observes each new beat at a known local time during the sync-pass fold.
+Each (send_ts, observe_ts) pair bounds the replica's offset from one
+side: ``observe = send + offset + delay`` with ``delay >= 0`` (status
+write + poll latency, at most ~one poll interval), so
+
+    observe - send = offset + delay,   delay ∈ [0, poll+jitter].
+
+Estimator (:func:`estimate_offset`): drift first, via a Theil–Sen
+median of pairwise slopes of ``observe - send`` against ``send`` —
+robust to dropped heartbeats (gaps just widen the pair baseline) and to
+delay jitter (the median ignores outlier pairs). Then the drift-
+detrended residuals ``(observe - send) - drift·(send - t₀)`` are an
+offset-plus-delay sample set; the offset is their ROBUST MIDPOINT —
+the midpoint of the (q10, q50) residual band, which splits the
+difference between "minimum residual" (right when the fastest poll had
+zero delay, fragile to a single early outlier) and "median residual"
+(biased upward by half the typical poll delay). The residual spread is
+reported so consumers can judge the estimate; the e2e acceptance bound
+is a residual under one heartbeat interval.
+
+Write side: the supervisor appends one JSONL observation per NEW
+per-replica heartbeat to ``<state>/clock/<ns>_<job>.jsonl``
+(:class:`ClockLog`, size-capped like the span rings). Read side:
+:func:`estimate_job_offsets` folds a log into per-replica estimates;
+:func:`offsets_for_trace_files` maps them onto span-file paths (the
+file name leads with the process name, ``<replica>-<pid>.trace.jsonl``)
+for the merge hook. Everything here runs OFFLINE from recorded
+artifacts — the step path gains zero calls.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Subdirectory of the supervisor state dir holding per-job observation
+# logs (a sibling of jobs/, status/, events/, trace/).
+CLOCK_DIR = "clock"
+
+# Per-job observation-log cap: past it the file rotates once (.1 kept),
+# mirroring the span rings — a month-long job cannot fill the disk with
+# 40-byte clock pairs. ~1 MiB holds ~10k observations, far more than
+# the estimator needs.
+LOG_MAX_BYTES = 1 << 20
+
+# Estimator floor: below this many pairs drift is forced to 0 (two
+# noisy points define a garbage slope) and the offset falls back to the
+# plain robust midpoint of the residuals.
+MIN_PAIRS_FOR_DRIFT = 4
+
+# Credibility clamp on the fitted drift: real quartz drifts tens of
+# ppm, NTP-disciplined clocks far less. A short observation window
+# turns delay jitter into a huge apparent slope (observed: 28000 "ppm"
+# from a 0.5s window) — extrapolating that beyond the window would
+# corrupt corrections, so implausible slopes collapse to pure offset.
+MAX_CREDIBLE_DRIFT_PPM = 500.0
+
+
+def job_clock_log(state_dir, key: str) -> Path:
+    """THE per-job observation-log path (write and read side agree).
+    A per-job DIRECTORY like status/checkpoints, so ``delete --purge``
+    reclaims it through the same artifact-root sweep."""
+    from ..controller.store import key_to_fs
+
+    return Path(state_dir) / CLOCK_DIR / key_to_fs(key) / "observations.jsonl"
+
+
+class ClockLog:
+    """Append-only (send_ts, observe_ts) observation log for one job.
+
+    Best-effort like the event sink: an unwritable disk drops
+    observations, never the sync pass. The supervisor keeps one per
+    active job and calls :meth:`observe` only on NEW beats, so the
+    steady-state cost is zero writes per idle pass.
+    """
+
+    def __init__(self, path: Path, max_bytes: int = LOG_MAX_BYTES):
+        self.path = Path(path)
+        self.max_bytes = max_bytes
+        self._size: Optional[int] = None  # lazily stat'ed once
+
+    def observe(self, replica: str, send_ts: float, observe_ts: float) -> None:
+        line = (
+            json.dumps(
+                {"replica": replica, "send_ts": send_ts, "observe_ts": observe_ts}
+            )
+            + "\n"
+        ).encode()
+        try:
+            if self._size is None:
+                try:
+                    self._size = self.path.stat().st_size
+                except OSError:
+                    self._size = 0
+            if self._size + len(line) > self.max_bytes:
+                self.path.replace(self.path.with_suffix(".jsonl.1"))
+                self._size = 0
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            with self.path.open("ab") as f:
+                f.write(line)
+            self._size += len(line)
+        except OSError:
+            pass
+
+
+def load_observations(path) -> Dict[str, List[Tuple[float, float]]]:
+    """Parse an observation log (rotated generation included) into
+    ``{replica: [(send_ts, observe_ts), ...]}``, oldest first. Torn or
+    foreign lines are skipped — the log is appended by a live daemon
+    and read after kills, like every other recorded artifact."""
+    p = Path(path)
+    out: Dict[str, List[Tuple[float, float]]] = {}
+    for gen in (p.with_suffix(".jsonl.1"), p):
+        try:
+            data = gen.read_bytes()
+        except OSError:
+            continue
+        for line in data.splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                replica = str(rec["replica"])
+                pair = (float(rec["send_ts"]), float(rec["observe_ts"]))
+            except (ValueError, TypeError, KeyError):
+                continue
+            out.setdefault(replica, []).append(pair)
+    return out
+
+
+@dataclass
+class OffsetEstimate:
+    """One replica's clock relation to the supervisor's clock.
+
+    ``offset_s``: seconds to ADD to the replica's timestamps to land
+    them on the supervisor clock (supervisor ≈ replica + offset).
+    ``drift_ppm``: relative clock rate error in parts-per-million.
+    ``residual_s``: spread (q90 - q10) of the detrended delay samples —
+    the estimate's uncertainty band; a skewed host is trustworthy when
+    this sits well under the heartbeat interval.
+    """
+
+    offset_s: float
+    drift_ppm: float
+    n: int
+    residual_s: float
+    # Anchor of the drift term: offset_s is the correction AT t0 (the
+    # earliest paired send_ts); offset_at extrapolates along the drift.
+    t0: float = 0.0
+
+    def offset_at(self, send_ts: float) -> float:
+        """Correction for a timestamp recorded at ``send_ts`` (drift
+        makes the correction time-dependent)."""
+        return self.offset_s + (self.drift_ppm * 1e-6) * (send_ts - self.t0)
+
+    def to_dict(self) -> dict:
+        return {
+            "offset_s": round(self.offset_s, 6),
+            "drift_ppm": round(self.drift_ppm, 3),
+            "n": self.n,
+            "residual_s": round(self.residual_s, 6),
+        }
+
+
+def _quantile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank-with-interpolation quantile of pre-sorted values."""
+    if not sorted_vals:
+        return 0.0
+    idx = q * (len(sorted_vals) - 1)
+    lo = int(idx)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = idx - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def _theil_sen_slope(xs: Sequence[float], ys: Sequence[float]) -> float:
+    """Median of pairwise slopes. O(n²) pairs are capped by striding so
+    a 10k-observation log costs ~thousands of pairs, not 50M."""
+    n = len(xs)
+    stride = max(1, (n * (n - 1) // 2) // 4096)
+    slopes: List[float] = []
+    k = 0
+    for i in range(n):
+        for j in range(i + 1, n):
+            k += 1
+            if k % stride:
+                continue
+            dx = xs[j] - xs[i]
+            if abs(dx) < 1e-9:
+                continue
+            slopes.append((ys[j] - ys[i]) / dx)
+    if not slopes:
+        return 0.0
+    slopes.sort()
+    return _quantile(slopes, 0.5)
+
+
+def estimate_offset(
+    pairs: Iterable[Tuple[float, float]], t0: Optional[float] = None
+) -> Optional[OffsetEstimate]:
+    """Estimate one replica's (offset, drift) from heartbeat pairs.
+
+    ``pairs`` is ``[(send_ts_on_replica_clock, observe_ts_on_supervisor
+    clock), ...]`` in any order; duplicates (a re-read beat) are
+    harmless. Returns None with no pairs. ``t0`` anchors the drift term
+    (defaults to the earliest send_ts) so ``offset_s`` is the
+    correction AT the start of the recorded window.
+    """
+    ps = sorted(set((float(s), float(o)) for s, o in pairs))
+    if not ps:
+        return None
+    t_ref = ps[0][0] if t0 is None else t0
+    xs = [s - t_ref for s, _ in ps]
+    ys = [o - s for s, o in ps]  # offset + delay samples
+    drift = (
+        _theil_sen_slope(xs, ys) if len(ps) >= MIN_PAIRS_FOR_DRIFT else 0.0
+    )
+    if abs(drift) * 1e6 > MAX_CREDIBLE_DRIFT_PPM:
+        drift = 0.0
+    resid = sorted(y - drift * x for x, y in zip(xs, ys))
+    # Robust midpoint of the low band: halfway between the 10th and
+    # 50th percentile residual — see the module docstring for why
+    # neither min nor median alone.
+    offset = 0.5 * (_quantile(resid, 0.10) + _quantile(resid, 0.50))
+    spread = _quantile(resid, 0.90) - _quantile(resid, 0.10)
+    return OffsetEstimate(
+        offset_s=offset,
+        drift_ppm=drift * 1e6,
+        n=len(ps),
+        residual_s=spread,
+        t0=t_ref,
+    )
+
+
+def estimate_job_offsets(
+    state_dir, key: str
+) -> Dict[str, OffsetEstimate]:
+    """Per-replica offset estimates for one job, from its recorded
+    observation log. Empty when nothing was recorded (no supervisor
+    daemon ran, or the job never heartbeat)."""
+    obs = load_observations(job_clock_log(state_dir, key))
+    out: Dict[str, OffsetEstimate] = {}
+    for replica, pairs in obs.items():
+        est = estimate_offset(pairs)
+        if est is not None:
+            out[replica] = est
+    return out
+
+
+def _trace_file_replica(path) -> Optional[str]:
+    """``<process>-<pid>.trace.jsonl[.1]`` → ``<process>``, or None for
+    files that do not follow the recorder's naming."""
+    name = os.path.basename(str(path))
+    for suffix in (".trace.jsonl.1", ".trace.jsonl"):
+        if name.endswith(suffix):
+            stem = name[: -len(suffix)]
+            proc, sep, pid = stem.rpartition("-")
+            if sep and pid.isdigit():
+                return proc
+            return stem or None
+    return None
+
+
+def offsets_for_trace_files(
+    paths: Iterable, estimates: Dict[str, OffsetEstimate]
+) -> Dict:
+    """Map per-replica estimates onto span-file paths for
+    ``merge_trace_files(clock_offsets=...)``. Files whose process name
+    matches no estimate (the supervisor's own files — already in the
+    reference frame — or replicas that never heartbeat) get no entry,
+    i.e. a zero correction; so do estimates built from fewer than
+    :data:`MIN_PAIRS_FOR_DRIFT` - 1 pairs (one delayed observation must
+    not shear a whole file sideways)."""
+    out: Dict = {}
+    for p in paths:
+        replica = _trace_file_replica(p)
+        if replica is None:
+            continue
+        est = estimates.get(replica)
+        if est is not None and est.offset_s and est.n >= 3:
+            out[p] = est.offset_s
+    return out

@@ -73,17 +73,58 @@ class Histogram:
         self._series: Dict[tuple, list] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(
+        self, value: float, exemplar: Optional[str] = None, **labels: str
+    ) -> None:
         key = tuple(sorted(labels.items()))
         idx = bisect.bisect_left(self.buckets, value)
         with self._lock:
             s = self._series.get(key)
             if s is None:
-                s = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                # [per-bucket counts (+Inf slot), sum, count, exemplars]
+                s = [[0] * (len(self.buckets) + 1), 0.0, 0, None]
                 self._series[key] = s
             s[0][idx] += 1
             s[1] += value
             s[2] += 1
+            if exemplar is not None:
+                # Latest span id per bucket (OpenMetrics exemplars): the
+                # jump-off point from a histogram cell to the exact
+                # trace span that landed in it.
+                if s[3] is None:
+                    s[3] = [None] * (len(self.buckets) + 1)
+                s[3][idx] = (str(exemplar), value)
+
+    def drop_series(self, label: str, value: str) -> int:
+        """Retire every series carrying ``label == value`` (metric
+        lifecycle: a deleted job's per-job series must not live in the
+        registry forever). Returns the count dropped."""
+        pair = (label, str(value))
+        with self._lock:
+            doomed = [k for k in self._series if pair in k]
+            for k in doomed:
+                del self._series[k]
+        return len(doomed)
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def exemplars(self, **labels: str) -> Dict[str, Tuple[str, float]]:
+        """``{le: (span_id, observed_value)}`` for one series — the
+        latest exemplar recorded per bucket (buckets without one are
+        absent)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            s = self._series.get(key)
+            ex = None if s is None else s[3]
+            ex = list(ex) if ex else []
+        out: Dict[str, Tuple[str, float]] = {}
+        bounds = self.buckets + (float("inf"),)
+        for bound, e in zip(bounds, ex):
+            if e is not None:
+                out[_fmt_le(bound)] = e
+        return out
 
     def count(self, **labels: str) -> int:
         key = tuple(sorted(labels.items()))
@@ -120,16 +161,29 @@ class Histogram:
         lines.append(f"# TYPE {self.name} histogram")
         with self._lock:
             series = {
-                k: ([*v[0]], v[1], v[2]) for k, v in self._series.items()
+                k: ([*v[0]], v[1], v[2], list(v[3]) if v[3] else None)
+                for k, v in self._series.items()
             }
-        for key, (counts, total_sum, total_count) in sorted(series.items()):
+        for key, (counts, total_sum, total_count, exemplars) in sorted(
+            series.items()
+        ):
             base = _fmt_labels(key)
             cum = 0
-            for bound, c in zip(self.buckets + (float("inf"),), counts):
+            for i, (bound, c) in enumerate(
+                zip(self.buckets + (float("inf"),), counts)
+            ):
                 cum += c
                 le = _fmt_labels((("le", _fmt_le(bound)),))
                 labels = f"{base},{le}" if base else le
-                lines.append(f"{self.name}_bucket{{{labels}}} {cum}")
+                line = f"{self.name}_bucket{{{labels}}} {cum}"
+                ex = exemplars[i] if exemplars else None
+                if ex is not None:
+                    # OpenMetrics exemplar suffix: the latest span that
+                    # landed in THIS bucket (not cumulative), so a p99
+                    # cell links to a concrete trace span.
+                    eid, val = ex
+                    line += f' # {{{_fmt_labels((("span_id", eid),))}}} {val:g}'
+                lines.append(line)
             brace = f"{{{base}}}" if base else ""
             lines.append(f"{self.name}_sum{brace} {total_sum:g}")
             lines.append(f"{self.name}_count{brace} {total_count}")
@@ -178,6 +232,11 @@ def parse_prometheus_text(text: str) -> Dict[str, List[Tuple[dict, float]]]:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        # OpenMetrics exemplar suffix (` # {span_id="..."} value`) is
+        # metadata, not part of the sample — strip it here so exemplared
+        # bucket lines parse identically to plain ones
+        # (:func:`parse_exemplars` is the suffix's read side).
+        line = line.split(" # ", 1)[0].rstrip()
         try:
             if "{" in line:
                 name, rest = line.split("{", 1)
@@ -191,6 +250,38 @@ def parse_prometheus_text(text: str) -> Dict[str, List[Tuple[dict, float]]]:
         except ValueError:
             continue
         out.setdefault(name.strip(), []).append((labels, value))
+    return out
+
+
+def parse_exemplars(
+    text: str,
+) -> Dict[str, List[Tuple[dict, str, float]]]:
+    """The exemplar read side of :func:`Histogram.render`:
+    ``{metric_name: [(labels, span_id, observed_value), ...]}`` for
+    every exposition line carrying an OpenMetrics exemplar suffix.
+    Tolerant like :func:`parse_prometheus_text` — a malformed suffix
+    just yields no exemplar for that line."""
+    out: Dict[str, List[Tuple[dict, str, float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or " # " not in line:
+            continue
+        sample, suffix = line.split(" # ", 1)
+        try:
+            name = sample.split("{", 1)[0].strip()
+            labels = (
+                _parse_labels(sample.split("{", 1)[1].rsplit("}", 1)[0])
+                if "{" in sample
+                else {}
+            )
+            ex_blob, ex_value = suffix.rsplit("}", 1)
+            ex_labels = _parse_labels(ex_blob.lstrip().lstrip("{"))
+            span_id = ex_labels.get("span_id", "")
+            value = float(ex_value.strip())
+        except (ValueError, IndexError):
+            continue
+        if span_id:
+            out.setdefault(name, []).append((labels, span_id, value))
     return out
 
 

@@ -23,9 +23,25 @@ import time
 from pathlib import Path
 from typing import Dict, List, Optional
 
-from .metrics import histogram_quantile, parse_prometheus_text
+from .metrics import histogram_quantile, parse_exemplars, parse_prometheus_text
 
 STEP_HIST = "tpujob_step_time_seconds"
+
+# The table's columns: (header, row key) in display order — one list so
+# the renderer, the sort-key cycling (`tpujob top` 's' key), and tests
+# cannot drift. Row keys index the dicts gather_rows returns.
+COLUMNS = (
+    ("JOB", "job"),
+    ("STEP", "step"),
+    ("STEPS/S", "steps_per_sec"),
+    ("P50(ms)", "p50_ms"),
+    ("P99(ms)", "p99_ms"),
+    ("CKPT LAG", "ckpt_lag"),
+    ("FEED(ms)", "feed_stall_ms"),
+    ("HB AGE", "age_s"),
+    ("RESTARTS", "restarts"),
+    ("P99 SPAN", "p99_span"),
+)
 
 
 def _hist_quantiles(
@@ -63,10 +79,13 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
     state = Path(state_dir)
     now = time.time() if now is None else now
     metrics: Dict = {}
+    exemplars: Dict = {}
     prom = state / "metrics.prom"
     if prom.exists():
         try:
-            metrics = parse_prometheus_text(prom.read_text())
+            text = prom.read_text()
+            metrics = parse_prometheus_text(text)
+            exemplars = parse_exemplars(text)
         except OSError:
             pass
     store = JobStore(persist_dir=state / "jobs")
@@ -96,6 +115,10 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
                 "feed_stall_ms": hb.get("feed_stall_ms"),
                 "age_s": (now - hb["ts"]) if hb.get("ts") else None,
                 "restarts": job.status.restart_count,
+                # Exemplar linking: the latest span that landed in the
+                # job's slowest populated step-time bucket — the jump
+                # from a p99 cell to the exact trace span.
+                "p99_span": _tail_exemplar(exemplars, STEP_HIST, key),
             }
         )
     # Stable, predictable ordering for a refreshing screen: reporting
@@ -107,18 +130,64 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
     return rows
 
 
+def _tail_exemplar(exemplars: Dict, name: str, job: str) -> Optional[str]:
+    """The span id recorded in the job's highest exemplared bucket of
+    histogram ``name`` (the worst step the recorder can still point
+    at), or None."""
+    rows = exemplars.get(f"{name}_bucket")
+    if not rows:
+        return None
+    best = None
+    for labels, span_id, value in rows:
+        if labels.get("job") != job:
+            continue
+        if best is None or value > best[0]:
+            best = (value, span_id)
+    return best[1] if best else None
+
+
+def sort_rows(rows: List[dict], sort_key: Optional[str], reverse: bool = True) -> List[dict]:
+    """Order rows by one COLUMNS key, unreported (None) values always
+    last regardless of direction; default ordering (sort_key None)
+    keeps gather_rows' freshest-heartbeat-first contract."""
+    if sort_key is None:
+        return rows
+    if sort_key == "job":
+        return sorted(rows, key=lambda r: r["job"], reverse=reverse)
+
+    def k(r):
+        v = r.get(sort_key)
+        return (v is None, (-v if reverse else v) if v is not None else 0.0)
+
+    return sorted(rows, key=k)
+
+
+def filter_rows(rows: List[dict], needle: Optional[str]) -> List[dict]:
+    """Case-insensitive job-name substring filter ('/' key)."""
+    if not needle:
+        return rows
+    n = needle.lower()
+    return [r for r in rows if n in r["job"].lower()]
+
+
 def _fmt(v, spec: str = "", dash: str = "-") -> str:
     if v is None:
         return dash
     return format(v, spec) if spec else str(v)
 
 
-def render_table(rows: List[dict], now: Optional[float] = None) -> str:
+def render_table(
+    rows: List[dict],
+    now: Optional[float] = None,
+    sort_key: Optional[str] = None,
+    filter_str: Optional[str] = None,
+) -> str:
     """The one-screen table. Columns stay stable so watch-mode diffs
-    visually; '-' means "not reported", never 0."""
-    header = (
-        "JOB", "STEP", "STEPS/S", "P50(ms)", "P99(ms)",
-        "CKPT LAG", "FEED(ms)", "HB AGE", "RESTARTS",
+    visually; '-' means "not reported", never 0. ``sort_key`` marks the
+    sorted column with '▾' (the interactive loop passes it; one-shot
+    callers don't)."""
+    header = tuple(
+        h + " ▾" if key == sort_key else h for h, key in COLUMNS
     )
     table = [header]
     for r in rows:
@@ -133,15 +202,29 @@ def render_table(rows: List[dict], now: Optional[float] = None) -> str:
                 _fmt(r["feed_stall_ms"], ".2f"),
                 _fmt(None if r["age_s"] is None else f"{r['age_s']:.0f}s"),
                 str(r["restarts"]),
+                _fmt(r.get("p99_span")),
             )
         )
     widths = [max(len(row[i]) for row in table) for i in range(len(header))]
     lines = ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
              for row in table]
     if not rows:
-        lines.append("(no active jobs)")
+        lines.append(
+            f"(no jobs matching {filter_str!r})" if filter_str
+            else "(no active jobs)"
+        )
+    if filter_str:
+        lines.append(f"filter: {filter_str}")
     return "\n".join(lines)
 
 
-def render(state_dir, now: Optional[float] = None) -> str:
-    return render_table(gather_rows(state_dir, now), now)
+def render(
+    state_dir,
+    now: Optional[float] = None,
+    sort_key: Optional[str] = None,
+    reverse: bool = True,
+    filter_str: Optional[str] = None,
+) -> str:
+    rows = filter_rows(gather_rows(state_dir, now), filter_str)
+    rows = sort_rows(rows, sort_key, reverse)
+    return render_table(rows, now, sort_key=sort_key, filter_str=filter_str)

@@ -42,12 +42,29 @@ from typing import Dict, Iterable, List, Optional
 ENV_VAR = "TPUJOB_TRACE_DIR"
 
 # Ring size per generation; two generations (current + .1) are kept.
+# Overridable per process via TPUJOB_TRACE_RING_BYTES — threaded from
+# spec.observability.trace_ring_bytes by runtime/env.py (a long soak
+# run wants deeper rings; a tiny CI world wants smaller ones).
 DEFAULT_MAX_BYTES = 8 << 20
+RING_BYTES_ENV = "TPUJOB_TRACE_RING_BYTES"
 
 # Flush cadence: buffered records are cheap to lose only if a crash
 # tears them anyway; every FLUSH_EVERY records the buffer hits disk so
-# a live `tpujob trace` sees near-current spans.
+# a live `tpujob trace` sees near-current spans. Overridable via
+# TPUJOB_TRACE_FLUSH_EVERY (spec.observability.trace_flush_every).
 FLUSH_EVERY = 32
+FLUSH_EVERY_ENV = "TPUJOB_TRACE_FLUSH_EVERY"
+
+
+def _env_int(name: str, default: int) -> int:
+    """A positive int env override, or the default (malformed or
+    non-positive values must never break span recording)."""
+    raw = os.environ.get(name, "")
+    try:
+        v = int(raw)
+    except ValueError:
+        return default
+    return v if v > 0 else default
 
 _NULL = contextlib.nullcontext()
 
@@ -78,7 +95,16 @@ def tracer() -> Optional["SpanRecorder"]:
     with _LOCK:
         if not _RESOLVED:
             d = os.environ.get(ENV_VAR, "")
-            _TRACER = SpanRecorder(d, _default_process_name()) if d else None
+            _TRACER = (
+                SpanRecorder(
+                    d,
+                    _default_process_name(),
+                    max_bytes=_env_int(RING_BYTES_ENV, DEFAULT_MAX_BYTES),
+                    flush_every=_env_int(FLUSH_EVERY_ENV, FLUSH_EVERY),
+                )
+                if d
+                else None
+            )
             _RESOLVED = True
     return _TRACER
 
@@ -136,6 +162,7 @@ class SpanRecorder:
         trace_dir,
         process_name: Optional[str] = None,
         max_bytes: int = DEFAULT_MAX_BYTES,
+        flush_every: int = FLUSH_EVERY,
     ):
         self.trace_dir = Path(trace_dir)
         self.trace_dir.mkdir(parents=True, exist_ok=True)
@@ -143,6 +170,7 @@ class SpanRecorder:
         self.pid = os.getpid()
         self.path = self.trace_dir / f"{self.process_name}-{self.pid}.trace.jsonl"
         self.max_bytes = max_bytes
+        self.flush_every = max(1, flush_every)
         self.records = 0
         self._lock = threading.Lock()
         self._f = open(self.path, "ab")
@@ -206,7 +234,7 @@ class SpanRecorder:
             self.records += 1
             _RECORDS += 1
             self._since_flush += 1
-            if self._since_flush >= FLUSH_EVERY:
+            if self._since_flush >= self.flush_every:
                 self._f.flush()
                 self._since_flush = 0
 
@@ -300,15 +328,24 @@ def merge_trace_files(paths: Iterable, clock_offsets: Optional[Dict] = None) -> 
     """Fold span files into one Chrome-trace JSON document.
 
     ``clock_offsets`` maps path -> seconds to ADD to that file's
-    timestamps (the cross-host alignment hook; local worlds share a
-    clock so the default is 0 everywhere). Events are sorted by ts;
-    metadata records keep their file order. The result loads directly
-    in Perfetto (https://ui.perfetto.dev) or chrome://tracing."""
+    timestamps — the cross-host alignment hook, now fed by the
+    heartbeat-matching estimator (obs/clock.py:estimate_job_offsets via
+    ``tpujob trace``/``tpujob why``; local worlds share a clock so the
+    default is 0 everywhere). Each corrected file gets a
+    ``clock_sync_correction`` metadata record naming the applied offset
+    so a merged trace is self-describing about its own alignment.
+    Events are sorted by ts; metadata records keep their file order.
+    The result loads directly in Perfetto (https://ui.perfetto.dev) or
+    chrome://tracing."""
     meta: List[dict] = []
     events: List[dict] = []
     for p in paths:
-        off_us = 1e6 * (clock_offsets or {}).get(p, 0.0)
+        off_s = (clock_offsets or {}).get(p, 0.0)
+        off_us = 1e6 * off_s
+        file_pid = None
         for rec in load_span_file(p):
+            if file_pid is None:
+                file_pid = rec.get("pid", 0)
             if rec.get("ph") == "M":
                 if rec not in meta:
                     meta.append(rec)
@@ -317,5 +354,18 @@ def merge_trace_files(paths: Iterable, clock_offsets: Optional[Dict] = None) -> 
                     rec = dict(rec)
                     rec["ts"] = rec.get("ts", 0) + off_us
                 events.append(rec)
+        if off_us:
+            meta.append(
+                {
+                    "ph": "M",
+                    "name": "clock_sync_correction",
+                    "pid": file_pid or 0,
+                    "tid": 0,
+                    "args": {
+                        "file": os.path.basename(str(p)),
+                        "offset_s": round(off_s, 6),
+                    },
+                }
+            )
     events.sort(key=lambda r: r.get("ts", 0))
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}

@@ -97,6 +97,14 @@ def build_cluster_env(
     # own (root) trace dir into replicas via inherited environment.
     if trace_dir is not None:
         env["TPUJOB_TRACE_DIR"] = trace_dir
+        # Ring sizing / flush cadence are spec knobs, not fixed
+        # constants (obs/trace.py reads these once at tracer creation).
+        ob = job.spec.observability
+        if ob is not None:
+            if ob.trace_ring_bytes > 0:
+                env["TPUJOB_TRACE_RING_BYTES"] = str(ob.trace_ring_bytes)
+            if ob.trace_flush_every > 0:
+                env["TPUJOB_TRACE_FLUSH_EVERY"] = str(ob.trace_flush_every)
     else:
         env["TPUJOB_TRACE_DIR"] = ""
     # Data-plane policy (spec.data_plane): workloads read these as the
