@@ -10,6 +10,7 @@ from .types import (  # noqa: F401
     KIND,
     RETRYABLE_EXIT_CODE_MIN,
     TERMINAL_CONDITIONS,
+    AlertPolicy,
     CleanPodPolicy,
     ConditionType,
     ElasticPolicy,

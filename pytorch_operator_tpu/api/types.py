@@ -52,6 +52,13 @@ def _parse_opt_int(d: Dict[str, Any], key: str, field_path: str) -> Optional[int
     return _parse_int(d[key], field_path) if d.get(key) is not None else None
 
 
+def _parse_float(value, field_path: str) -> float:
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        raise ValueError(f"{field_path}: invalid number {value!r}") from None
+
+
 def _env_str(value, field_path: str) -> str:
     """Coerce an env value: YAML booleans become 'true'/'false' (what the
     user wrote), scalars stringify, structures are rejected."""
@@ -413,6 +420,67 @@ class DataPlanePolicy:
 
 
 @dataclass
+class AlertPolicy:
+    """Live health-engine knobs (obs/watch.py + obs/rules.py).
+
+    The supervisor's streaming evaluator runs the shared detector
+    rules (heartbeat silence, step-time regression, feed-stall
+    dominance, checkpoint lag, straggler, noisy neighbor) over every
+    reporting job each sync pass. This block tunes ONE job's alerting:
+    ``enabled: false`` opts the job out entirely; ``for_s`` is the
+    hysteresis before a pending alert fires (a condition must persist
+    this long); ``clear_s`` before a firing alert resolves after the
+    condition clears; ``thresholds`` overrides any subset of the rule
+    thresholds by name (see obs/rules.Thresholds — e.g.
+    ``regression_factor: 2.0``, ``silence_min_s: 5``). The SAME values
+    drive ``tpujob why`` offline, so live and postmortem judge by one
+    bar.
+    """
+
+    enabled: bool = True
+    for_s: float = 0.0
+    clear_s: float = 5.0
+    thresholds: Dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if not self.enabled:
+            d["enabled"] = False
+        if self.for_s:
+            d["for_s"] = self.for_s
+        if self.clear_s != 5.0:
+            d["clear_s"] = self.clear_s
+        if self.thresholds:
+            d["thresholds"] = dict(self.thresholds)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AlertPolicy":
+        raw = d.get("thresholds") or {}
+        if not isinstance(raw, dict):
+            raise ValueError(
+                "observability.alerts.thresholds: must be a mapping"
+            )
+        thresholds: Dict[str, float] = {}
+        for k, v in raw.items():
+            try:
+                thresholds[str(k)] = float(v)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"observability.alerts.thresholds[{k}]: must be a "
+                    f"number, got {v!r}"
+                ) from None
+        return cls(
+            enabled=bool(d.get("enabled", True)),
+            for_s=_parse_float(d.get("for_s", 0.0), "observability.alerts.for_s"),
+            clear_s=_parse_float(
+                d.get("clear_s", 5.0), "observability.alerts.clear_s"
+            ),
+            thresholds=thresholds,
+        )
+
+
+@dataclass
 class ObservabilityPolicy:
     """Flight-recorder knobs (obs/).
 
@@ -435,6 +503,9 @@ class ObservabilityPolicy:
     trace: bool = False
     trace_ring_bytes: int = 0
     trace_flush_every: int = 0
+    # Live health-engine tuning (obs/watch.py); None = defaults (the
+    # watch runs for every job — this block customizes, it doesn't arm).
+    alerts: Optional[AlertPolicy] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -444,6 +515,8 @@ class ObservabilityPolicy:
             d["trace_ring_bytes"] = self.trace_ring_bytes
         if self.trace_flush_every:
             d["trace_flush_every"] = self.trace_flush_every
+        if self.alerts is not None and (al := self.alerts.to_dict()):
+            d["alerts"] = al
         return d
 
     @classmethod
@@ -456,6 +529,11 @@ class ObservabilityPolicy:
             trace_flush_every=_parse_int(
                 d.get("trace_flush_every", 0),
                 "observability.trace_flush_every",
+            ),
+            alerts=(
+                AlertPolicy.from_dict(d["alerts"])
+                if d.get("alerts") is not None
+                else None
             ),
         )
 

@@ -129,6 +129,29 @@ def validate_spec(spec: TPUJobSpec) -> List[str]:
             errs.append("spec.observability.trace_ring_bytes: must be >= 0")
         if ob.trace_flush_every < 0:
             errs.append("spec.observability.trace_flush_every: must be >= 0")
+        if ob.alerts is not None:
+            al = ob.alerts
+            if al.for_s < 0:
+                errs.append("spec.observability.alerts.for_s: must be >= 0")
+            if al.clear_s < 0:
+                errs.append("spec.observability.alerts.clear_s: must be >= 0")
+            # Unknown threshold names are near-certainly typos — the
+            # override would silently never apply (the live watch and
+            # `tpujob why` both ignore unknown keys at read time).
+            from ..obs.rules import THRESHOLD_FIELDS
+
+            for k, v in sorted(al.thresholds.items()):
+                if k not in THRESHOLD_FIELDS:
+                    errs.append(
+                        f"spec.observability.alerts.thresholds[{k}]: "
+                        f"unknown rule threshold (valid: "
+                        f"{', '.join(sorted(THRESHOLD_FIELDS))})"
+                    )
+                elif v <= 0:
+                    errs.append(
+                        f"spec.observability.alerts.thresholds[{k}]: "
+                        "must be > 0"
+                    )
 
     return errs
 

@@ -210,10 +210,46 @@ def cmd_run(args) -> int:
 
 def cmd_chaos(args) -> int:
     """Replay a declared failure scenario end-to-end: arm the plan, run
-    the job under it, print the deterministic replay summary."""
+    the job under it, print the deterministic replay summary. With
+    ``--record``, the positional argument is a JOB NAME instead of a
+    spec file: reconstruct a replayable plan from that job's recorded
+    failure artifacts (faults/record.py) and write it out — a watched
+    incident becomes a committed regression test."""
+    if getattr(args, "record", False):
+        return _cmd_chaos_record(args)
+    if not args.plan:
+        print("error: --plan is required (or use --record NAME)",
+              file=sys.stderr)
+        return 2
     return _run_foreground(
         args, fault_plan=_load_fault_plan(args.plan), chaos=True
     )
+
+
+def _cmd_chaos_record(args) -> int:
+    from pytorch_operator_tpu.faults.record import plan_from_recording
+
+    state = _state_dir(args)
+    key = f"{args.namespace}/{args.file}"
+    plan = plan_from_recording(state, key)
+    if not plan.faults:
+        print(
+            f"error: no replayable failure found in the recording of "
+            f"tpujob {key} (no hung-world kill, crash exit, or "
+            "checkpoint-save failure on record)",
+            file=sys.stderr,
+        )
+        return 1
+    body = json.dumps(plan.to_dict(), indent=2, sort_keys=True) + "\n"
+    if args.out:
+        Path(args.out).write_text(body)
+        print(
+            f"wrote {args.out}: {plan.summary()}\n"
+            f"replay with: tpujob chaos <job.yaml> --plan {args.out}"
+        )
+    else:
+        print(body, end="")
+    return 0
 
 
 def _load_validated_job(path):
@@ -303,8 +339,13 @@ def cmd_supervisor(args) -> int:
             render_metrics=sup.metrics.render_text,
             health=lambda: supervisor_health(sup),
             port=args.monitoring_port,
-            # `curl :port/top` — the tpujob-top table over HTTP.
-            text_routes={"/top": lambda: obs_top.render(sup.state_dir) + "\n"},
+            # `curl :port/top` — the tpujob-top table over HTTP;
+            # `curl :port/alerts` — the live health engine's state
+            # (in-memory: the watch is THE source, no log re-read).
+            text_routes={
+                "/top": lambda: obs_top.render(sup.state_dir) + "\n",
+                "/alerts": lambda: sup.watch.render_text() + "\n",
+            },
         )
         try:
             print(f"tpujob supervisor: monitoring on 127.0.0.1:{monitoring.start()}")
@@ -588,6 +629,88 @@ def cmd_why(args) -> int:
     return 0
 
 
+def _follow_alerts(args, state: Path, key: str) -> int:
+    """``alerts --follow``: live-tail one job's alert transition log
+    (like ``tpujob events -f``): incremental offset reads, each
+    firing/resolved transition printed once, rotation-tolerant (a
+    shrunken file restarts from zero). Ends when the job record
+    finishes or disappears, after a final drain."""
+    from pytorch_operator_tpu.obs.watch import format_alert_record, job_alert_log
+
+    path = job_alert_log(state, key)
+    store = JobStore(persist_dir=state / "jobs")
+    offset = 0
+
+    def drain() -> None:
+        nonlocal offset
+        try:
+            size = path.stat().st_size
+        except OSError:
+            return
+        if size < offset:
+            offset = 0  # rotated under us: replay the fresh generation
+        if size == offset:
+            return
+        try:
+            with path.open("rb") as f:
+                f.seek(offset)
+                chunk = f.read()
+        except OSError:
+            return
+        last_nl = chunk.rfind(b"\n")
+        if last_nl < 0:
+            return  # torn line: wait for the writer to finish it
+        offset += last_nl + 1
+        for line in chunk[: last_nl + 1].splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "rule" in rec:
+                print(format_alert_record(rec), flush=True)
+
+    try:
+        while True:
+            job = store.reload(key)
+            finished = job is None or job.is_finished()
+            drain()  # after the finish check: the last pass drains fully
+            if finished:
+                return 0
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_alerts(args) -> int:
+    """The live health engine's alert surface (obs/watch.py): current
+    state per (job, rule, replica) folded from the per-job alert logs —
+    file-based, so it answers with or without a daemon. ``--follow``
+    live-tails one job's transitions; ``--json`` emits the raw
+    records."""
+    from pytorch_operator_tpu.obs import watch as obs_watch
+
+    state = _state_dir(args)
+    if getattr(args, "follow", False):
+        if not args.name:
+            print("error: --follow requires a job NAME", file=sys.stderr)
+            return 2
+        return _follow_alerts(args, state, _resolve_key(args))
+    key = _resolve_key(args) if args.name else None
+    if getattr(args, "json", False):
+        keys = [key] if key else obs_watch.list_alert_jobs(state)
+        records = [
+            rec for k in keys for rec in obs_watch.load_alert_log(state, k)
+        ]
+        records.sort(key=lambda r: float(r.get("ts", 0.0)))
+        print(json.dumps(records, indent=2))
+        return 0
+    rows = obs_watch.gather_alert_rows(state, key)
+    print(obs_watch.render_alert_table(rows))
+    return 0
+
+
 def cmd_top(args) -> int:
     """Live one-screen fleet table (obs/top.py): per-job step, steps/s,
     p50/p99 step time, checkpoint lag, feed stall — from the status-dir
@@ -604,6 +727,26 @@ def cmd_top(args) -> int:
         print(obs_top.render(state))
         return 0
 
+    if getattr(args, "diff", False):
+        # Delta mode: print the full table once, then only what CHANGED
+        # each interval (step-rate moves, new firing alerts, jobs
+        # appearing/finishing) — a scrolling incident log instead of a
+        # repaint, so nothing scrolls away unseen.
+        prev = None
+        try:
+            while True:
+                rows = obs_top.gather_rows(state)
+                if prev is None:
+                    print(obs_top.render_table(rows))
+                else:
+                    for line in obs_top.diff_rows(prev, rows):
+                        print(line)
+                sys.stdout.flush()
+                prev = rows
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
     sort_idx = None  # index into obs_top.COLUMNS; None = default order
     reverse = True
     filt = ""
@@ -612,7 +755,8 @@ def cmd_top(args) -> int:
     def paint(interactive: bool) -> None:
         key = None if sort_idx is None else obs_top.COLUMNS[sort_idx][1]
         body = obs_top.render(
-            state, sort_key=key, reverse=reverse, filter_str=filt or None
+            state, sort_key=key, reverse=reverse, filter_str=filt or None,
+            color=interactive,  # firing-alert rows highlight on a TTY
         )
         if interactive:
             hint = (
@@ -1173,10 +1317,28 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser(
         "chaos",
         help="replay a declared failure scenario: run a job under a "
-        "fault plan and print the deterministic event-sequence summary",
+        "fault plan and print the deterministic event-sequence summary; "
+        "--record NAME instead reconstructs a plan from a recorded "
+        "live failure",
     )
-    sp.add_argument("file", help="TPUJob spec to run under faults")
-    sp.add_argument("--plan", required=True, help="fault plan file (YAML/JSON)")
+    sp.add_argument(
+        "file",
+        help="TPUJob spec to run under faults (with --record: the job "
+        "NAME whose recorded failure to capture)",
+    )
+    sp.add_argument(
+        "--plan", default=None, help="fault plan file (YAML/JSON)"
+    )
+    sp.add_argument(
+        "--record", action="store_true",
+        help="capture the named job's recorded failure timeline as a "
+        "replayable fault plan instead of running anything",
+    )
+    sp.add_argument(
+        "--out", default=None,
+        help="with --record: write the plan JSON here (default: stdout)",
+    )
+    sp.add_argument("-n", "--namespace", default="default")
     sp.add_argument("--timeout", type=float, default=None)
     sp.add_argument("--no-gang", action="store_true")
     sp.add_argument("--max-slots", type=int, default=None)
@@ -1355,7 +1517,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser(
         "top",
         help="live fleet table: per-job step, steps/s, p50/p99 step "
-        "time, checkpoint lag, feed stall",
+        "time, checkpoint lag, feed stall, firing alerts",
     )
     sp.add_argument(
         "--once", action="store_true",
@@ -1365,7 +1527,35 @@ def build_parser() -> argparse.ArgumentParser:
         "--interval", type=float, default=2.0,
         help="refresh interval in seconds",
     )
+    sp.add_argument(
+        "--diff", action="store_true",
+        help="print only deltas vs the previous repaint (step-rate "
+        "moves, new firing alerts, jobs appearing/finishing) as a "
+        "scrolling log instead of repainting the table",
+    )
     sp.set_defaults(func=cmd_top)
+
+    sp = sub.add_parser(
+        "alerts",
+        help="live health-engine alerts (streaming detector rules + "
+        "lifecycle): current state per job/rule/replica from the "
+        "per-job alert logs",
+    )
+    sp.add_argument(
+        "name", nargs="?", default=None,
+        help="only this job's alerts (required with --follow)",
+    )
+    sp.add_argument(
+        "-f", "--follow", action="store_true",
+        help="live-tail the job's alert transitions (firing/resolved) "
+        "until the job finishes",
+    )
+    sp.add_argument(
+        "--json", action="store_true",
+        help="print the raw transition records as JSON",
+    )
+    add_ns(sp)
+    sp.set_defaults(func=cmd_alerts)
 
     sp = sub.add_parser(
         "apply", help="create or update a job from a spec file (kubectl apply)"

@@ -237,6 +237,15 @@ class MetricsRegistry:
             "Age of the oldest in-flight async checkpoint at the newest "
             "commit",
         )
+        # Live health engine (obs/watch.py): firing alerts per
+        # job/rule/severity, rebuilt per pass from the watch state —
+        # the scrapeable face of the alert lifecycle (pending alerts
+        # are hysteresis-internal and deliberately not exported).
+        self.alerts_firing = self.gauge(
+            "tpujob_alerts",
+            "Firing live-health alerts per job/rule/severity "
+            "(obs/watch.py; pending/resolved states are not exported)",
+        )
         self.job_feed_stall = self.gauge(
             "tpujob_job_feed_stall_ms",
             "Mean step-loop wait on the device feed per get (0 = the feed "

@@ -66,7 +66,10 @@ def _job_status_dir_cached(status_root: str, key: str) -> Path:
 # the numeric fields each carries. ``progress`` is the training
 # heartbeat; ``checkpoint_committed`` is the async writer's
 # commit-telemetry record (checkpoint/manager.py + exit_with) feeding
-# the checkpoint-lag / queue-depth surfaces.
+# the checkpoint-lag / queue-depth surfaces; ``clock_probe`` is the
+# replica's echo of the supervisor's round-trip clock probe
+# (obs/clock.py — the record's own ``ts`` is the echo send time on the
+# replica clock, ``probe_ts`` the supervisor's write time).
 TAILED_KINDS: dict = {
     "progress": (
         "ts", "step", "loss", "steps_per_sec", "throughput",
@@ -75,6 +78,7 @@ TAILED_KINDS: dict = {
     "checkpoint_committed": (
         "ts", "step", "commit_ms", "queue_depth", "oldest_age_s",
     ),
+    "clock_probe": ("ts", "probe_ts", "seq"),
 }
 
 _NUMERIC_FIELDS = TAILED_KINDS["progress"]

@@ -134,6 +134,21 @@ class Supervisor:
         # with a fresh observe time (a garbage delay sample).
         self._clock_logs: dict = {}
         self._clock_seen: dict = {}
+        # Round-trip clock probes: per-key ts of the last probe file
+        # write (cadence gate), the recent probe seqs THIS supervisor
+        # wrote per key (only their echoes are accepted — a stale echo
+        # after a daemon restart would be a garbage round trip), and
+        # per-(key, replica) ts of the newest echo already logged.
+        self._probe_written: dict = {}
+        self._probe_seqs: dict = {}
+        self._probe_seen: dict = {}
+        # The live health engine (obs/watch.py): streaming detector
+        # rules + alert lifecycle, fed from the SAME tailed state as
+        # the gauge fold — zero extra I/O; log appends only on alert
+        # transitions.
+        from ..obs.watch import WatchEngine
+
+        self.watch = WatchEngine(self.state_dir)
 
     # ---- API-server-ish surface ----
 
@@ -514,6 +529,10 @@ class Supervisor:
                 m.queue_slots_capacity.set(cap, queue=qname)
                 m.queue_slots_used.set(queue_usage.get(qname, 0), queue=qname)
         self._update_progress_gauges(jobs)
+        # End-of-pass cross-job rule (noisy-neighbor attribution needs
+        # every job's verdict from THIS pass), then the alert gauges.
+        self.watch.correlate()
+        self.watch.export_gauge(m.alerts_firing)
         self._fold_io_counters()
 
     def _fold_io_counters(self) -> None:
@@ -561,10 +580,27 @@ class Supervisor:
             return
         for key, job in jobs:
             if job.is_finished():
+                # Close the live-alert lifecycle: anything still firing
+                # resolves (logged) so the postmortem sees it closed by
+                # the finish, not dangling. Idempotent after the first
+                # pass (state already dropped).
+                self.watch.finalize(key)
                 continue
             status_dir = job_status_dir(root, key)
             by_kind = self._progress.poll(status_dir)
-            self._record_clock_observations(key, status_dir)
+            by_replica = self._progress.replica_latest(status_dir)
+            self._record_clock_observations(key, status_dir, by_replica)
+            # Live health engine: fold the same already-tailed state
+            # (zero I/O) and run the shared detector rules. Jobs that
+            # never reported stay untracked — evaluation is skipped
+            # entirely, so an idle fleet pays one dict lookup per job
+            # here. No event list is passed: live silence is judged
+            # against the supervisor clock (a recorded kill is the
+            # OFFLINE engine's evidence; live it would pin a stale
+            # alert across the restart that healed it).
+            self.watch.observe(key, by_replica)
+            if self.watch.tracked(key):
+                self.watch.evaluate(key, job=job)
             rec = by_kind.get("progress")
             if rec is not None:
                 if rec.get("step") is not None:
@@ -630,32 +666,71 @@ class Supervisor:
                         float(ck["commit_ms"]) / 1000.0, exemplar=ex, job=key
                     )
 
-    def _record_clock_observations(self, key: str, status_dir) -> None:
+    def _record_clock_observations(
+        self, key: str, status_dir, by_replica: Optional[dict] = None
+    ) -> None:
         """Pair each replica's NEW heartbeat-send timestamp with this
         supervisor's observe time and append it to the job's clock log —
         the raw material for the cross-host offset estimator
         (obs/clock.py). Zero I/O when no replica beat since the last
         pass; first sight of a replica primes the dedup without logging
-        (see __init__)."""
-        by_replica = self._progress.replica_latest(status_dir)
+        (see __init__).
+
+        Round-trip probes ride the same fold: a job with fresh beats
+        gets a probe file rewrite at most every PROBE_INTERVAL_S
+        (supervisor write ts + seq); replicas echo it as a
+        ``clock_probe`` status record whose (probe write, echo send,
+        echo observe) triple kills the one-way delay bias in the
+        estimator. Idle jobs never probe — the zero-idle-I/O invariant
+        holds."""
+        if by_replica is None:
+            by_replica = self._progress.replica_latest(status_dir)
         if not by_replica:
             return
+        from ..obs.clock import PROBE_INTERVAL_S, write_probe
+
         now = time.time()
+        new_beat = False
         for replica, kinds in by_replica.items():
             rec = kinds.get("progress")
-            if rec is None:
-                continue
-            seen = self._clock_seen.get((key, replica))
-            if seen is not None and rec["ts"] > seen:
-                log = self._clock_logs.get(key)
-                if log is None:
-                    from ..obs.clock import ClockLog, job_clock_log
+            if rec is not None:
+                seen = self._clock_seen.get((key, replica))
+                if seen is not None and rec["ts"] > seen:
+                    self._clock_log(key).observe(replica, rec["ts"], now)
+                if seen is None or rec["ts"] > seen:
+                    self._clock_seen[(key, replica)] = rec["ts"]
+                    new_beat = True
+            echo = kinds.get("clock_probe")
+            if echo is not None and echo.get("probe_ts") is not None:
+                seen = self._probe_seen.get((key, replica))
+                if (seen is None or echo["ts"] > seen) and int(
+                    echo.get("seq", -1)
+                ) in self._probe_seqs.get(key, ()):
+                    # An echo of a probe THIS process wrote (stale
+                    # echoes from before a daemon restart are rejected
+                    # by seq, so no first-sight priming is needed).
+                    self._probe_seen[(key, replica)] = echo["ts"]
+                    self._clock_log(key).observe(
+                        replica, echo["ts"], now,
+                        probe_ts=float(echo["probe_ts"]),
+                    )
+        if new_beat and now - self._probe_written.get(key, 0.0) >= PROBE_INTERVAL_S:
+            self._probe_written[key] = now
+            seq = write_probe(status_dir, now)
+            if seq is not None:
+                # Keep the last few: a replica may echo the previous
+                # probe in the same window a rewrite lands.
+                self._probe_seqs.setdefault(key, []).append(seq)
+                del self._probe_seqs[key][:-4]
 
-                    log = ClockLog(job_clock_log(self.state_dir, key))
-                    self._clock_logs[key] = log
-                log.observe(replica, rec["ts"], now)
-            if seen is None or rec["ts"] > seen:
-                self._clock_seen[(key, replica)] = rec["ts"]
+    def _clock_log(self, key: str):
+        log = self._clock_logs.get(key)
+        if log is None:
+            from ..obs.clock import ClockLog, job_clock_log
+
+            log = ClockLog(job_clock_log(self.state_dir, key))
+            self._clock_logs[key] = log
+        return log
 
     def _maybe_preempt(self, jobs, now: float) -> None:
         """volcano ``preempt``: evict lower-priority running worlds so the
@@ -729,11 +804,16 @@ class Supervisor:
         ROADMAP unbounded-cardinality fix. A churn of N jobs leaves the
         registry bounded (pinned by tests/test_obs_analyze.py)."""
         self.metrics.retire_job(key)
+        self.watch.retire_job(key)
         self._hb_observed.pop(key, None)
         self._ckpt_observed.pop(key, None)
         self._clock_logs.pop(key, None)
+        self._probe_written.pop(key, None)
+        self._probe_seqs.pop(key, None)
         for k in [k for k in self._clock_seen if k[0] == key]:
             del self._clock_seen[k]
+        for k in [k for k in self._probe_seen if k[0] == key]:
+            del self._probe_seen[k]
 
     def _gc_ttl(self, job: TPUJob, key: str, now: float) -> None:
         """TTLSecondsAfterFinished → delete the job object (SURVEY.md §3.4)."""

@@ -1,0 +1,132 @@
+"""``tpujob chaos --record`` — turn a watched incident into a fault plan.
+
+The chaos machinery replays DECLARED failures; this module closes the
+loop for failures nobody declared: it reads the artifacts a live
+failure already recorded (per-replica status records, the event sink —
+the same surfaces ``tpujob why`` joins) and reconstructs a
+:class:`~pytorch_operator_tpu.faults.plan.FaultPlan` that re-injects
+the observed failure deterministically. A production incident becomes
+a regression test: record the plan, commit it, run ``tpujob chaos
+job.yaml --plan incident.json`` in CI forever.
+
+Reconstruction is necessarily a projection — wall-clock timing becomes
+step/occurrence indices, and only failure modes the plan language can
+express are captured:
+
+- a hung-world kill (``TPUJobHung``/``DeadlineExceeded``) maps to
+  ``drop_heartbeat`` on the replica whose beats stopped first, with
+  ``nth`` = the number of beats it produced before going silent + 1
+  (so the replay trains visibly, then goes silent at the same point);
+- a replica that failed with an exit code (the restart/fail events'
+  ``"failed with exit code N"`` message) maps to ``crash_at_step`` at
+  its last reported step + 1 with the same exit code;
+- recorded checkpoint-save failures (``checkpoint_save_failed`` status
+  records) map to ``fail_checkpoint_write`` — or the persistent
+  ``enospc_checkpoint_write`` when the recorded error names ENOSPC /
+  "no space";
+- a recorded rendezvous stall (``fault_stall`` records exist only for
+  injected stalls, but a join that measurably exceeded the gang's is
+  not reconstructable — skipped).
+
+The plan carries a ``seed`` derived from the job key so two recordings
+of the same incident serialize identically.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from .plan import Fault, FaultPlan
+
+_EXIT_RE = re.compile(r"replica (\S+) failed with exit code (\d+)")
+
+
+def _replica_target(name: str, key: str) -> str:
+    """``default/job`` + handle name → the plan's ``<type>-<index>``
+    target. Handle names are ``<fs-key>-<type>-<index>``; status files
+    are already ``<type>-<index>``."""
+    from ..controller.store import key_to_fs
+
+    prefix = key_to_fs(key) + "-"
+    return name[len(prefix):] if name.startswith(prefix) else name
+
+
+def plan_from_recording(state_dir, key: str) -> FaultPlan:
+    """Reconstruct a replayable plan from one job's recorded artifacts.
+    Returns an empty plan (no faults) when the recording shows no
+    expressible failure — the caller should tell the operator rather
+    than write a plan that replays nothing."""
+    from ..obs.analyze import build_timeline
+
+    tl = build_timeline(state_dir, key)
+    faults: List[Fault] = []
+
+    # ---- hung world -> drop_heartbeat on the first-silent replica ----
+    kill = tl.find_event("TPUJobHung", "DeadlineExceeded")
+    if kill is not None and tl.progress:
+        victim, beats = min(
+            tl.progress.items(), key=lambda kv: kv[1][-1]["aligned_ts"]
+        )
+        faults.append(
+            Fault(
+                kind="drop_heartbeat",
+                target=victim,
+                nth=len(beats) + 1,
+                times=1_000_000,
+            )
+        )
+
+    # ---- crash exits -> crash_at_step at the last reported step ----
+    seen_crash = set()
+    for e in tl.events:
+        m = _EXIT_RE.search(str(e.get("message", "")))
+        if not m:
+            continue
+        replica = _replica_target(m.group(1), key)
+        code = int(m.group(2))
+        if replica in seen_crash:
+            continue  # one fault per replica: the plan re-fires per incarnation
+        seen_crash.add(replica)
+        last_step = _last_step_before(tl, replica, float(e.get("timestamp", 0.0)))
+        faults.append(
+            Fault(
+                kind="crash_at_step",
+                target=replica,
+                at=(last_step + 1) if last_step is not None else 1,
+                exit_code=code,
+                restart=0,
+            )
+        )
+
+    # ---- checkpoint-save failures ----
+    for i, rec in enumerate(tl.records.get("checkpoint_save_failed", []), 1):
+        msg = str(rec.get("error", "")) + str(rec.get("message", ""))
+        persistent = "nospc" in msg.lower() or "no space" in msg.lower()
+        faults.append(
+            Fault(
+                kind=(
+                    "enospc_checkpoint_write"
+                    if persistent
+                    else "fail_checkpoint_write"
+                ),
+                target=str(rec.get("replica", "*")),
+                nth=int(rec.get("save_index", i) or i),
+            )
+        )
+
+    seed = sum(ord(c) for c in key) % 1000
+    return FaultPlan(seed=seed, faults=faults)
+
+
+def _last_step_before(tl, replica: str, ts: float) -> Optional[int]:
+    """The replica's newest reported step at-or-before ``ts`` (the
+    crash event); None when it never reported."""
+    best: Optional[int] = None
+    for rec in tl.progress.get(replica, []):
+        if rec.get("step") is None:
+            continue
+        if ts and rec["aligned_ts"] > ts:
+            break
+        best = int(rec["step"])
+    return best

@@ -15,23 +15,16 @@ diagnosis:
    (every kind, full history — this is offline, not the per-pass tail
    fold), the job's event sink, and (when recorded) the merged span
    files.
-3. **Detect** — a rule pass over the timeline; each
-   :class:`Finding` cites the exact records/spans that evidence it:
-
-   - ``step_time_regression`` — recent step time vs the job's OWN
-     baseline window (no fleet-wide "normal" needed);
-   - ``feed_stall_dominance`` — the device feed eats a dominant share
-     of the step (the input-bound signature);
-   - ``checkpoint_lag`` — committed step falls behind the training
-     step, or the async writer queue grows without draining;
-   - ``heartbeat_silence`` — a replica stopped beating before a
-     hang/deadline kill (names the hung replica, evidence timestamped
-     BEFORE the kill event);
-   - ``straggler`` — one replica's step-time distribution sits far
-     above the gang's (p99/p50 spread across members).
-
+3. **Detect** — the SHARED rule pass (obs/rules.py — the same code the
+   live watch evaluates every supervisor pass) over the timeline; each
+   :class:`~pytorch_operator_tpu.obs.rules.Finding` cites the exact
+   records/spans that evidence it. Per-job threshold overrides come
+   from the stored ``spec.observability.alerts`` block, so offline and
+   live judge by the same bar.
 4. **Render** — a terminal report (:func:`render_report`) and a
-   machine-readable dict (:func:`analyze`) for ``--out report.json``.
+   machine-readable dict (:func:`analyze`) for ``--out report.json``,
+   including the live engine's alert history (what was already firing
+   before death — obs/watch.py's append-only per-job alert log).
 
 Everything runs strictly OFFLINE from recorded artifacts: analysis adds
 zero span/metric calls to the step path (the bench_smoke lane pins it).
@@ -40,129 +33,50 @@ zero span/metric calls to the step path (the bench_smoke lane pins it).
 from __future__ import annotations
 
 import json
-from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List, Optional
 
 from .clock import OffsetEstimate, estimate_job_offsets, offsets_for_trace_files
 from .metrics import parse_exemplars
+from .rules import (  # noqa: F401  (re-exported: the pre-refactor public surface)
+    DEFAULT_THRESHOLDS,
+    DETECTORS,
+    SEVERITY_ORDER as _SEVERITY_ORDER,
+    Finding,
+    Thresholds,
+    detect_checkpoint_lag,
+    detect_feed_stall,
+    detect_heartbeat_silence,
+    detect_step_time_regression,
+    detect_straggler,
+    run_detectors,
+    thresholds_from_overrides,
+)
 from .trace import load_span_file, span_files
 
-# ---- detector thresholds (module constants so tests pin behavior) ----
-
-# step_time_regression: recent median must exceed the baseline median
-# by this factor AND by an absolute floor (a 0.1ms -> 0.2ms "doubling"
-# is measurement noise, not a regression).
-REGRESSION_FACTOR = 1.5
-REGRESSION_MIN_MS = 2.0
-REGRESSION_MIN_BASELINE = 6
-REGRESSION_MIN_RECENT = 3
-
-# feed_stall_dominance: median stall share of the step above this.
-FEED_STALL_SHARE = 0.5
-FEED_STALL_MIN_MS = 1.0
-FEED_MIN_SAMPLES = 4
-
-# checkpoint_lag: final (step - committed) beyond this many commit
-# cadences, or a writer queue that only grows over the last commits.
-CKPT_LAG_CADENCES = 3.0
-CKPT_QUEUE_GROWTH_COMMITS = 3
-
-# heartbeat_silence: a replica is silent when its last beat trails the
-# reference by this many median beat intervals (floored, so a 10ms test
-# cadence doesn't flag scheduler jitter).
-SILENCE_FACTOR = 3.0
-SILENCE_MIN_S = 1.0
-
-# straggler: worst replica p50 step time vs the gang median p50, plus a
-# per-replica in-distribution tail check (p99/p50).
-STRAGGLER_FACTOR = 1.5
-STRAGGLER_MIN_SAMPLES = 4
-
-
-def _median(vals: List[float]) -> float:
-    s = sorted(vals)
-    n = len(s)
-    if n == 0:
-        return 0.0
-    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
-
-
-def _quantile(vals: List[float], q: float) -> float:
-    s = sorted(vals)
-    if not s:
-        return 0.0
-    idx = q * (len(s) - 1)
-    lo = int(idx)
-    hi = min(lo + 1, len(s) - 1)
-    return s[lo] * (1 - (idx - lo)) + s[hi] * (idx - lo)
-
-
-@dataclass
-class Finding:
-    """One detector hit. ``evidence`` entries are small dicts each
-    naming their source (``status`` / ``event`` / ``span``), the
-    ALIGNED timestamp, and enough coordinates to find the artifact
-    (replica + record kind, event reason, or span name+args)."""
-
-    rule: str
-    severity: str  # "critical" | "warning" | "info"
-    summary: str
-    evidence: List[dict] = field(default_factory=list)
-    metrics: Dict[str, float] = field(default_factory=dict)
-
-    def to_dict(self) -> dict:
-        return {
-            "rule": self.rule,
-            "severity": self.severity,
-            "summary": self.summary,
-            "evidence": self.evidence,
-            "metrics": {
-                k: (round(v, 6) if isinstance(v, float) else v)
-                for k, v in self.metrics.items()
-            },
-        }
-
-
-def _ev_status(rec: dict, kind: str) -> dict:
-    out = {
-        "source": "status",
-        "kind": kind,
-        "replica": rec.get("replica", "?"),
-        "ts": round(float(rec.get("aligned_ts", rec.get("ts", 0.0))), 6),
-    }
-    for f in ("step", "step_time_ms", "feed_stall_ms", "queue_depth",
-              "commit_ms"):
-        if rec.get(f) is not None:
-            out[f] = rec[f]
-    return out
-
-
-def _ev_event(rec: dict) -> dict:
-    return {
-        "source": "event",
-        "reason": rec.get("reason", "?"),
-        "type": rec.get("type", "?"),
-        "ts": round(float(rec.get("timestamp", 0.0)), 6),
-        "message": rec.get("message", ""),
-    }
-
-
-def _ev_span(span: dict) -> dict:
-    return {
-        "source": "span",
-        "name": span.get("name", "?"),
-        "cat": span.get("cat", ""),
-        "ts": round(span.get("ts", 0) / 1e6, 6),
-        "dur_ms": round(span.get("dur", 0) / 1e3, 3),
-        "args": span.get("args", {}),
-    }
+# Back-compat aliases: the detector thresholds were module constants
+# before the rules moved to obs/rules.py (tests and external callers
+# pinned them); the Thresholds dataclass is the source of truth now.
+REGRESSION_FACTOR = DEFAULT_THRESHOLDS.regression_factor
+REGRESSION_MIN_MS = DEFAULT_THRESHOLDS.regression_min_ms
+REGRESSION_MIN_BASELINE = DEFAULT_THRESHOLDS.regression_min_baseline
+REGRESSION_MIN_RECENT = DEFAULT_THRESHOLDS.regression_min_recent
+FEED_STALL_SHARE = DEFAULT_THRESHOLDS.feed_stall_share
+FEED_STALL_MIN_MS = DEFAULT_THRESHOLDS.feed_stall_min_ms
+FEED_MIN_SAMPLES = DEFAULT_THRESHOLDS.feed_min_samples
+CKPT_LAG_CADENCES = DEFAULT_THRESHOLDS.ckpt_lag_cadences
+CKPT_QUEUE_GROWTH_COMMITS = DEFAULT_THRESHOLDS.ckpt_queue_growth_commits
+SILENCE_FACTOR = DEFAULT_THRESHOLDS.silence_factor
+SILENCE_MIN_S = DEFAULT_THRESHOLDS.silence_min_s
+STRAGGLER_FACTOR = DEFAULT_THRESHOLDS.straggler_factor
+STRAGGLER_MIN_SAMPLES = DEFAULT_THRESHOLDS.straggler_min_samples
 
 
 class Timeline:
     """The per-job causal join: status records per replica, events, and
-    spans, all on the supervisor's clock. Detectors read this; nothing
-    here touches the live system."""
+    spans, all on the supervisor's clock. The offline
+    :class:`~pytorch_operator_tpu.obs.rules.TimelineView` — detectors
+    read this; nothing here touches the live system."""
 
     def __init__(
         self,
@@ -209,6 +123,14 @@ class Timeline:
                 gaps.append(b["aligned_ts"] - a["aligned_ts"])
         return _median(gaps) if gaps else 0.0
 
+    def silence_reference(self) -> float:
+        """Offline silence is judged against the gang's NEWEST beat
+        ("someone kept beating, someone stopped") — never against the
+        recording's end, which would flag every replica of a healthy
+        finished job."""
+        last = [rs[-1]["aligned_ts"] for rs in self.progress.values() if rs]
+        return max(last) if last else 0.0
+
     def find_event(self, *reasons: str) -> Optional[dict]:
         for e in self.events:
             if e.get("reason") in reasons:
@@ -224,6 +146,12 @@ class Timeline:
             ):
                 return s
         return None
+
+
+def _median(vals: List[float]) -> float:
+    from .rules import _median as m
+
+    return m(vals)
 
 
 # ---- timeline construction ----
@@ -351,303 +279,18 @@ def _replica_of_trace_file(path) -> Optional[str]:
     return _trace_file_replica(path)
 
 
-# ---- detectors ----
-
-
-def detect_step_time_regression(tl: Timeline) -> List[Finding]:
-    """Recent step time vs the job's own earlier baseline. With a
-    --window, "recent" is the window and the baseline is everything
-    before it; without one, the newest quarter vs the rest."""
-    samples = [
-        r for r in tl.all_progress() if r.get("step_time_ms") is not None
-    ]
-    if tl.window_s is not None:
-        recent = [r for r in samples if tl.in_window(r["aligned_ts"])]
-        baseline = [r for r in samples if not tl.in_window(r["aligned_ts"])]
-    else:
-        cut = max(len(samples) - max(len(samples) // 4, REGRESSION_MIN_RECENT), 0)
-        baseline, recent = samples[:cut], samples[cut:]
-    if (
-        len(baseline) < REGRESSION_MIN_BASELINE
-        or len(recent) < REGRESSION_MIN_RECENT
-    ):
-        return []
-    base_med = _median([float(r["step_time_ms"]) for r in baseline])
-    rec_med = _median([float(r["step_time_ms"]) for r in recent])
-    if (
-        rec_med <= base_med * REGRESSION_FACTOR
-        or rec_med - base_med <= REGRESSION_MIN_MS
-    ):
-        return []
-    worst = max(recent, key=lambda r: float(r["step_time_ms"]))
-    evidence = [_ev_status(worst, "progress")]
-    if worst.get("step") is not None:
-        span = tl.find_step_span(worst["replica"], int(worst["step"]))
-        if span is not None:
-            evidence.append(_ev_span(span))
-    evidence.append(_ev_status(baseline[-1], "progress"))
-    return [
-        Finding(
-            rule="step_time_regression",
-            severity="warning",
-            summary=(
-                f"step time regressed: recent median "
-                f"{rec_med:.1f}ms vs baseline {base_med:.1f}ms "
-                f"({rec_med / max(base_med, 1e-9):.1f}x)"
-            ),
-            evidence=evidence,
-            metrics={
-                "baseline_ms": base_med,
-                "recent_ms": rec_med,
-                "factor": rec_med / max(base_med, 1e-9),
-                "baseline_n": len(baseline),
-                "recent_n": len(recent),
-            },
-        )
-    ]
-
-
-def detect_feed_stall(tl: Timeline) -> List[Finding]:
-    samples = [
-        r
-        for r in tl.all_progress()
-        if r.get("feed_stall_ms") is not None
-        and r.get("step_time_ms") is not None
-        and tl.in_window(r["aligned_ts"])
-    ]
-    if len(samples) < FEED_MIN_SAMPLES:
-        return []
-    stall_med = _median([float(r["feed_stall_ms"]) for r in samples])
-    step_med = _median([float(r["step_time_ms"]) for r in samples])
-    if step_med <= 0 or stall_med < FEED_STALL_MIN_MS:
-        return []
-    share = stall_med / step_med
-    if share <= FEED_STALL_SHARE:
-        return []
-    worst = max(samples, key=lambda r: float(r["feed_stall_ms"]))
-    return [
-        Finding(
-            rule="feed_stall_dominance",
-            severity="warning",
-            summary=(
-                f"input feed dominates the step: median stall "
-                f"{stall_med:.1f}ms is {100 * share:.0f}% of the "
-                f"{step_med:.1f}ms step — the job is input-bound"
-            ),
-            evidence=[_ev_status(worst, "progress")],
-            metrics={
-                "stall_ms": stall_med,
-                "step_ms": step_med,
-                "share": share,
-                "n": len(samples),
-            },
-        )
-    ]
-
-
-def detect_checkpoint_lag(tl: Timeline) -> List[Finding]:
-    commits = [
-        r
-        for r in tl.records.get("checkpoint_committed", [])
-        if r.get("step") is not None
-    ]
-    if not commits:
-        return []
-    findings: List[Finding] = []
-    steps = sorted(float(c["step"]) for c in commits)
-    cadence = _median([b - a for a, b in zip(steps, steps[1:])]) or 1.0
-    prog = [r for r in tl.all_progress() if r.get("step") is not None]
-    last_step = float(prog[-1]["step"]) if prog else None
-    last_commit = commits[-1]
-    if last_step is not None:
-        lag = last_step - float(last_commit["step"])
-        if lag > max(CKPT_LAG_CADENCES * cadence, CKPT_LAG_CADENCES):
-            findings.append(
-                Finding(
-                    rule="checkpoint_lag",
-                    severity="warning",
-                    summary=(
-                        f"checkpoints trail training by {lag:.0f} steps "
-                        f"(last commit step {last_commit['step']:.0f} vs "
-                        f"trained step {last_step:.0f}; commit cadence "
-                        f"~{cadence:.0f} steps) — a kill now loses that "
-                        "progress"
-                    ),
-                    evidence=[
-                        _ev_status(last_commit, "checkpoint_committed"),
-                        _ev_status(prog[-1], "progress"),
-                    ],
-                    metrics={
-                        "lag_steps": lag,
-                        "cadence_steps": cadence,
-                        "last_commit_step": float(last_commit["step"]),
-                        "last_trained_step": last_step,
-                    },
-                )
-            )
-    depths = [
-        float(c["queue_depth"])
-        for c in commits
-        if c.get("queue_depth") is not None
-    ]
-    tail = depths[-CKPT_QUEUE_GROWTH_COMMITS:]
-    if (
-        len(tail) >= CKPT_QUEUE_GROWTH_COMMITS
-        and all(b > a for a, b in zip(tail, tail[1:]))
-        and tail[-1] >= 2
-    ):
-        findings.append(
-            Finding(
-                rule="checkpoint_lag",
-                severity="warning",
-                summary=(
-                    f"async checkpoint queue growing without draining "
-                    f"(depth {tail[0]:.0f} -> {tail[-1]:.0f} over the "
-                    f"last {len(tail)} commits) — commits are slower "
-                    "than the save cadence"
-                ),
-                evidence=[_ev_status(last_commit, "checkpoint_committed")],
-                metrics={"queue_depth": tail[-1]},
-            )
-        )
-    return findings
-
-
-def detect_heartbeat_silence(tl: Timeline) -> List[Finding]:
-    """The hung-replica detector. Two triggers: a recorded hang/deadline
-    kill (name the replica whose beats stopped first, with evidence
-    timestamped BEFORE the kill), or a replica silent while the rest of
-    the gang kept beating."""
-    last_beats = {
-        replica: rs[-1] for replica, rs in tl.progress.items() if rs
-    }
-    if not last_beats:
-        return []
-    gap = tl.beat_interval()
-    threshold = max(SILENCE_FACTOR * gap, SILENCE_MIN_S)
-    findings: List[Finding] = []
-
-    kill = tl.find_event("TPUJobHung", "DeadlineExceeded")
-    if kill is not None:
-        kill_ts = float(kill.get("timestamp", 0.0))
-        # The hung replica: oldest last-beat in the gang (with
-        # drop_heartbeat or a wedged collective, the victim stops first;
-        # a fully-wedged world makes every replica a victim — name the
-        # earliest-silent one).
-        victim, rec = min(
-            last_beats.items(), key=lambda kv: kv[1]["aligned_ts"]
-        )
-        silence = kill_ts - rec["aligned_ts"]
-        evidence = [_ev_status(rec, "progress"), _ev_event(kill)]
-        if rec.get("step") is not None:
-            span = tl.find_step_span(victim, int(rec["step"]))
-            if span is not None:
-                evidence.insert(1, _ev_span(span))
-        findings.append(
-            Finding(
-                rule="heartbeat_silence",
-                severity="critical",
-                summary=(
-                    f"replica {victim} went silent {silence:.1f}s before "
-                    f"the {kill.get('reason')} kill (last beat at step "
-                    f"{rec.get('step', '?')})"
-                ),
-                evidence=evidence,
-                metrics={
-                    "silence_s": silence,
-                    "kill_ts": kill_ts,
-                    "last_beat_ts": rec["aligned_ts"],
-                },
-            )
-        )
-        return findings
-
-    # Partial silence: someone kept beating, someone stopped.
-    newest = max(r["aligned_ts"] for r in last_beats.values())
-    for replica, rec in sorted(last_beats.items()):
-        silence = newest - rec["aligned_ts"]
-        if silence > threshold:
-            findings.append(
-                Finding(
-                    rule="heartbeat_silence",
-                    severity="critical",
-                    summary=(
-                        f"replica {replica} silent for {silence:.1f}s "
-                        f"while the gang kept beating (threshold "
-                        f"{threshold:.1f}s = {SILENCE_FACTOR:g}x the "
-                        f"{gap:.2f}s beat interval)"
-                    ),
-                    evidence=[_ev_status(rec, "progress")],
-                    metrics={
-                        "silence_s": silence,
-                        "threshold_s": threshold,
-                    },
-                )
-            )
-    return findings
-
-
-def detect_straggler(tl: Timeline) -> List[Finding]:
-    per_replica: Dict[str, List[float]] = {}
-    for replica, rs in tl.progress.items():
-        vals = [
-            float(r["step_time_ms"])
-            for r in rs
-            if r.get("step_time_ms") is not None
-            and tl.in_window(r["aligned_ts"])
-        ]
-        if len(vals) >= STRAGGLER_MIN_SAMPLES:
-            per_replica[replica] = vals
-    if len(per_replica) < 2:
-        return []
-    p50s = {r: _median(v) for r, v in per_replica.items()}
-    gang_p50 = _median(list(p50s.values()))
-    worst, worst_p50 = max(p50s.items(), key=lambda kv: kv[1])
-    if gang_p50 <= 0 or worst_p50 <= STRAGGLER_FACTOR * gang_p50:
-        return []
-    p99 = _quantile(per_replica[worst], 0.99)
-    worst_rec = max(
-        (r for r in tl.progress[worst] if r.get("step_time_ms") is not None),
-        key=lambda r: float(r["step_time_ms"]),
-    )
-    evidence = [_ev_status(worst_rec, "progress")]
-    if worst_rec.get("step") is not None:
-        span = tl.find_step_span(worst, int(worst_rec["step"]))
-        if span is not None:
-            evidence.append(_ev_span(span))
-    return [
-        Finding(
-            rule="straggler",
-            severity="warning",
-            summary=(
-                f"replica {worst} straggles the gang: p50 step time "
-                f"{worst_p50:.1f}ms vs gang {gang_p50:.1f}ms "
-                f"({worst_p50 / gang_p50:.1f}x; its p99 {p99:.1f}ms)"
-            ),
-            evidence=evidence,
-            metrics={
-                "replica_p50_ms": worst_p50,
-                "gang_p50_ms": gang_p50,
-                "replica_p99_ms": p99,
-                "spread": worst_p50 / gang_p50,
-                "replicas": len(per_replica),
-            },
-        )
-    ]
-
-
-DETECTORS = (
-    detect_heartbeat_silence,
-    detect_step_time_regression,
-    detect_feed_stall,
-    detect_checkpoint_lag,
-    detect_straggler,
-)
-
-_SEVERITY_ORDER = {"critical": 0, "warning": 1, "info": 2}
-
-
 # ---- the engine ----
+
+
+def job_thresholds(job) -> Thresholds:
+    """The detector thresholds for one job: defaults overridden by its
+    ``spec.observability.alerts.thresholds`` block. Shared bar: the
+    live watch resolves the SAME way (obs/watch.py)."""
+    if job is not None:
+        ob = job.spec.observability
+        if ob is not None and ob.alerts is not None:
+            return thresholds_from_overrides(ob.alerts.thresholds)
+    return DEFAULT_THRESHOLDS
 
 
 def analyze(
@@ -673,10 +316,7 @@ def analyze(
                 phase = c.type.value
                 break
 
-    findings: List[Finding] = []
-    for det in DETECTORS:
-        findings.extend(det(tl))
-    findings.sort(key=lambda f: _SEVERITY_ORDER.get(f.severity, 9))
+    findings = run_detectors(tl, job_thresholds(job))
 
     # Exemplar cross-links (when a daemon wrote metrics.prom): the p99
     # cell's latest span id per histogram, so the report can say WHICH
@@ -696,6 +336,14 @@ def analyze(
                     exemplars[name] = hits
         except OSError:
             pass
+
+    # The live engine's verdicts (obs/watch.py alert log): what was
+    # already pending/firing before the death `why` is explaining —
+    # cross-cited so "the watch saw it live" and "the postmortem found
+    # it" are one story.
+    from .watch import load_alert_log
+
+    alerts = load_alert_log(state_dir, key)
 
     replicas = {
         replica: {
@@ -718,6 +366,7 @@ def analyze(
         "events": len(tl.events),
         "spans": len(tl.spans),
         "exemplars": exemplars,
+        "alerts": alerts,
         "findings": [f.to_dict() for f in findings],
     }
 
@@ -736,6 +385,11 @@ def _fmt_ev(ev: dict) -> str:
             f"span   {ev.get('name')} @ {ev.get('ts'):.3f} "
             f"dur={ev.get('dur_ms'):.1f}ms {blob}".rstrip()
         )
+    if src == "alert":
+        return (
+            f"alert  {ev.get('rule')} on {ev.get('job')}: "
+            f"{ev.get('summary', '')}"
+        )
     fields = " ".join(
         f"{k}={ev[k]}"
         for k in ("step", "step_time_ms", "feed_stall_ms", "queue_depth")
@@ -749,8 +403,8 @@ def _fmt_ev(ev: dict) -> str:
 
 def render_report(report: dict) -> str:
     """The terminal face of the report: findings first (most severe on
-    top), each with its evidence; clock table after; '-' free prose
-    kept short — the JSON carries the full detail."""
+    top), each with its evidence; alert history and clock table after;
+    '-' free prose kept short — the JSON carries the full detail."""
     lines: List[str] = []
     head = f"tpujob why {report['job']}"
     if report.get("phase"):
@@ -774,15 +428,35 @@ def render_report(report: dict) -> str:
             for r, e in clock.items()
         ]
         lines.append("clock:    " + "; ".join(parts))
+    alerts = report.get("alerts", [])
     findings = report.get("findings", [])
-    if not findings:
+    if not findings and not alerts:
         lines.append("")
         lines.append("no findings — the recorded window looks healthy.")
         return "\n".join(lines)
-    lines.append("")
-    lines.append(f"FINDINGS ({len(findings)}):")
-    for i, f in enumerate(findings, 1):
-        lines.append(f"{i:3d}. [{f['severity']}] {f['rule']}: {f['summary']}")
-        for ev in f.get("evidence", []):
-            lines.append(f"       - {_fmt_ev(ev)}")
+    if findings:
+        lines.append("")
+        lines.append(f"FINDINGS ({len(findings)}):")
+        for i, f in enumerate(findings, 1):
+            lines.append(
+                f"{i:3d}. [{f['severity']}] {f['rule']}: {f['summary']}"
+            )
+            for ev in f.get("evidence", []):
+                lines.append(f"       - {_fmt_ev(ev)}")
+    else:
+        lines.append("")
+        lines.append("no findings — the recorded window looks healthy.")
+    if alerts:
+        # What the live engine already said, while the job was running:
+        # every firing/resolved transition, oldest first.
+        lines.append("")
+        lines.append(f"LIVE ALERTS ({len(alerts)} transition(s)):")
+        for rec in alerts:
+            who = rec.get("replica") or "*"
+            lines.append(
+                f"  {rec.get('state', '?'):<8} [{rec.get('severity', '?')}] "
+                f"{rec.get('rule', '?')} {who} @ "
+                f"{float(rec.get('ts', 0.0)):.3f}  "
+                f"{rec.get('summary', '')}"
+            )
     return "\n".join(lines)

@@ -72,6 +72,64 @@ MIN_PAIRS_FOR_DRIFT = 4
 # corrupt corrections, so implausible slopes collapse to pure offset.
 MAX_CREDIBLE_DRIFT_PPM = 500.0
 
+# ---- round-trip probes ----
+#
+# The one-way estimator above is biased by the status-write + poll
+# delay: every (send, observe) pair satisfies observe - send = offset
+# + delay with delay >= 0, so the recovered offset sits up to ~one
+# poll interval above truth. A ROUND TRIP bounds the offset from both
+# sides: the supervisor writes a probe file at its time T0, the
+# replica reads it and echoes a ``clock_probe`` status record stamped
+# with its own clock r, and the supervisor observes the echo at T1.
+# The echo's true (supervisor-clock) send instant lies in [T0, T1], so
+#     offset = true_send - r  ∈  [T0 - r, T1 - r],
+# and the interval midpoint (T0 + T1)/2 - r is unbiased when the
+# write→read and write→observe legs are comparably delayed — no
+# systematic one-way bias left. estimate_offset prefers round-trip
+# triples whenever the log holds enough of them.
+
+# Probe file name inside a job's status dir (NOT *.jsonl — the tailer
+# must never scan it as a replica record file).
+PROBE_FILE = "clock_probe.json"
+
+# Supervisor-side rewrite cadence; gated on the job having produced a
+# NEW heartbeat that pass, so idle jobs are never probed (the
+# zero-idle-I/O invariant of the sync pass holds).
+PROBE_INTERVAL_S = 2.0
+
+# Round-trip triples needed before the estimator trusts them over the
+# (more numerous) one-way pairs.
+MIN_ROUNDTRIP = 3
+
+
+def write_probe(status_dir, now: float) -> Optional[int]:
+    """Best-effort probe-file rewrite (supervisor side); returns the
+    probe's ``seq`` (the writer remembers it and accepts only echoes of
+    seqs it wrote — a stale echo observed by a restarted daemon would
+    otherwise contribute a garbage round trip). A tiny atomic-enough
+    single write; replicas tolerate torn reads by JSON parse failure."""
+    if status_dir is None:
+        return None
+    p = Path(status_dir) / PROBE_FILE
+    seq = int(now * 1e6)
+    try:
+        p.write_text(json.dumps({"probe_ts": round(now, 6), "seq": seq}))
+    except OSError:
+        return None
+    return seq
+
+
+def read_probe(status_dir) -> Optional[dict]:
+    """The current probe, or None (no supervisor probing / torn
+    write). Replica side: rendezvous.report_progress echoes it."""
+    if status_dir is None:
+        return None
+    try:
+        rec = json.loads((Path(status_dir) / PROBE_FILE).read_text())
+        return {"probe_ts": float(rec["probe_ts"]), "seq": int(rec["seq"])}
+    except (OSError, ValueError, TypeError, KeyError):
+        return None
+
 
 def job_clock_log(state_dir, key: str) -> Path:
     """THE per-job observation-log path (write and read side agree).
@@ -96,13 +154,19 @@ class ClockLog:
         self.max_bytes = max_bytes
         self._size: Optional[int] = None  # lazily stat'ed once
 
-    def observe(self, replica: str, send_ts: float, observe_ts: float) -> None:
-        line = (
-            json.dumps(
-                {"replica": replica, "send_ts": send_ts, "observe_ts": observe_ts}
-            )
-            + "\n"
-        ).encode()
+    def observe(
+        self,
+        replica: str,
+        send_ts: float,
+        observe_ts: float,
+        probe_ts: Optional[float] = None,
+    ) -> None:
+        rec = {"replica": replica, "send_ts": send_ts, "observe_ts": observe_ts}
+        if probe_ts is not None:
+            # Round-trip sample: the supervisor's probe-write time that
+            # preceded this (echoed) send — see the module docstring.
+            rec["probe_ts"] = probe_ts
+        line = (json.dumps(rec) + "\n").encode()
         try:
             if self._size is None:
                 try:
@@ -120,13 +184,15 @@ class ClockLog:
             pass
 
 
-def load_observations(path) -> Dict[str, List[Tuple[float, float]]]:
+def load_observations(path) -> Dict[str, List[Tuple[float, ...]]]:
     """Parse an observation log (rotated generation included) into
-    ``{replica: [(send_ts, observe_ts), ...]}``, oldest first. Torn or
-    foreign lines are skipped — the log is appended by a live daemon
-    and read after kills, like every other recorded artifact."""
+    ``{replica: [(send_ts, observe_ts), ...]}``, oldest first —
+    round-trip records load as ``(send_ts, observe_ts, probe_ts)``
+    triples. Torn or foreign lines are skipped — the log is appended
+    by a live daemon and read after kills, like every other recorded
+    artifact."""
     p = Path(path)
-    out: Dict[str, List[Tuple[float, float]]] = {}
+    out: Dict[str, List[Tuple[float, ...]]] = {}
     for gen in (p.with_suffix(".jsonl.1"), p):
         try:
             data = gen.read_bytes()
@@ -138,7 +204,11 @@ def load_observations(path) -> Dict[str, List[Tuple[float, float]]]:
             try:
                 rec = json.loads(line)
                 replica = str(rec["replica"])
-                pair = (float(rec["send_ts"]), float(rec["observe_ts"]))
+                pair: Tuple[float, ...] = (
+                    float(rec["send_ts"]), float(rec["observe_ts"]),
+                )
+                if rec.get("probe_ts") is not None:
+                    pair = pair + (float(rec["probe_ts"]),)
             except (ValueError, TypeError, KeyError):
                 continue
             out.setdefault(replica, []).append(pair)
@@ -164,6 +234,9 @@ class OffsetEstimate:
     # Anchor of the drift term: offset_s is the correction AT t0 (the
     # earliest paired send_ts); offset_at extrapolates along the drift.
     t0: float = 0.0
+    # Round-trip samples behind the estimate (0 = one-way only, the
+    # delay-biased legacy path).
+    rt_n: int = 0
 
     def offset_at(self, send_ts: float) -> float:
         """Correction for a timestamp recorded at ``send_ts`` (drift
@@ -171,12 +244,15 @@ class OffsetEstimate:
         return self.offset_s + (self.drift_ppm * 1e-6) * (send_ts - self.t0)
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "offset_s": round(self.offset_s, 6),
             "drift_ppm": round(self.drift_ppm, 3),
             "n": self.n,
             "residual_s": round(self.residual_s, 6),
         }
+        if self.rt_n:
+            d["rt_n"] = self.rt_n
+        return d
 
 
 def _quantile(sorted_vals: Sequence[float], q: float) -> float:
@@ -218,15 +294,60 @@ def estimate_offset(
     """Estimate one replica's (offset, drift) from heartbeat pairs.
 
     ``pairs`` is ``[(send_ts_on_replica_clock, observe_ts_on_supervisor
-    clock), ...]`` in any order; duplicates (a re-read beat) are
+    clock), ...]`` in any order — entries may also be round-trip
+    triples ``(send_ts, observe_ts, probe_ts)`` (see the probe section
+    of the module docstring); duplicates (a re-read beat) are
     harmless. Returns None with no pairs. ``t0`` anchors the drift term
     (defaults to the earliest send_ts) so ``offset_s`` is the
     correction AT the start of the recorded window.
+
+    With at least :data:`MIN_ROUNDTRIP` triples present, the offset
+    comes from the round-trip interval midpoints
+    ``(probe_ts + observe_ts)/2 - send_ts`` — UNBIASED, unlike the
+    one-way residual band which sits up to one poll delay above truth.
     """
-    ps = sorted(set((float(s), float(o)) for s, o in pairs))
-    if not ps:
+    one_way: List[Tuple[float, float]] = []
+    rt: List[Tuple[float, float, float]] = []
+    for p in pairs:
+        if len(p) >= 3 and p[2] is not None:
+            rt.append((float(p[0]), float(p[1]), float(p[2])))
+        else:
+            one_way.append((float(p[0]), float(p[1])))
+    rt = sorted(set(rt))
+    ps = sorted(set(one_way))
+    if not ps and not rt:
         return None
-    t_ref = ps[0][0] if t0 is None else t0
+    all_sends = [s for s, _ in ps] + [s for s, _, _ in rt]
+    t_ref = min(all_sends) if t0 is None else t0
+
+    if len(rt) >= MIN_ROUNDTRIP:
+        xs = [s - t_ref for s, _, _ in rt]
+        # Interval midpoint per round trip: unbiased offset sample.
+        ys = [0.5 * (pr + o) - s for s, o, pr in rt]
+        drift = (
+            _theil_sen_slope(xs, ys)
+            if len(rt) >= MIN_PAIRS_FOR_DRIFT
+            else 0.0
+        )
+        if abs(drift) * 1e6 > MAX_CREDIBLE_DRIFT_PPM:
+            drift = 0.0
+        resid = sorted(y - drift * x for x, y in zip(xs, ys))
+        # Midpoints are already centered: the plain median is the
+        # estimator (no low-band correction needed).
+        offset = _quantile(resid, 0.50)
+        spread = _quantile(resid, 0.90) - _quantile(resid, 0.10)
+        return OffsetEstimate(
+            offset_s=offset,
+            drift_ppm=drift * 1e6,
+            n=len(ps) + len(rt),
+            residual_s=spread,
+            t0=t_ref,
+            rt_n=len(rt),
+        )
+
+    # One-way path (round trips, if any, contribute their upper-bound
+    # pair like a regular observation).
+    ps = sorted(set(ps + [(s, o) for s, o, _ in rt]))
     xs = [s - t_ref for s, _ in ps]
     ys = [o - s for s, o in ps]  # offset + delay samples
     drift = (
@@ -246,6 +367,7 @@ def estimate_offset(
         n=len(ps),
         residual_s=spread,
         t0=t_ref,
+        rt_n=len(rt),
     )
 
 

@@ -1,0 +1,611 @@
+"""The shared health-detector rules — ONE implementation, two engines.
+
+The postmortem engine (``tpujob why``, obs/analyze.py) and the live
+health engine (obs/watch.py, running inside the supervisor's steady
+phase) must agree: an alert that fired live has to reproduce offline
+from the recorded artifacts, and a finding ``why`` reports after a
+death is exactly what the watch would have alerted on before it. The
+only way those two stay in lockstep is to evaluate the identical code,
+so the rules live here and both engines import them.
+
+A rule is a function ``detect_*(view, th)`` over a :class:`TimelineView`
+— the minimal read surface both engines can provide:
+
+- offline, :class:`~pytorch_operator_tpu.obs.analyze.Timeline` is the
+  full clock-aligned artifact join (every status record, event sink,
+  merged spans);
+- live, :class:`~pytorch_operator_tpu.obs.watch.LiveWindow` is a
+  bounded rolling window of the records the supervisor's gauge fold
+  already tailed (zero extra I/O) plus the in-memory event list.
+
+The one deliberate asymmetry is the silence reference
+(:meth:`TimelineView.silence_reference`): offline, a replica is silent
+relative to the NEWEST beat in the gang (comparing to the recording's
+end would flag every healthy finished job); live, it is silent relative
+to the supervisor's wall clock — which is what lets a single-replica
+hang alert fire while the job is still running, before the gang has any
+other member to compare against.
+
+Thresholds are a :class:`Thresholds` dataclass instead of module
+constants so ``spec.observability.alerts.thresholds`` can override them
+per job — the same values feed both engines (``tpujob why`` reads the
+stored spec; the watch reads the live one).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields, replace
+from typing import Callable, Dict, List, Mapping, Optional, Protocol, Tuple
+
+# ---- thresholds ----
+
+
+@dataclass(frozen=True)
+class Thresholds:
+    """Every tunable the detector rules consume. Defaults are the values
+    the postmortem engine shipped with; ``spec.observability.alerts.
+    thresholds`` overrides any subset per job (validation rejects
+    unknown keys — see :data:`THRESHOLD_FIELDS`)."""
+
+    # step_time_regression: recent median must exceed the baseline
+    # median by this factor AND by an absolute floor (a 0.1ms -> 0.2ms
+    # "doubling" is measurement noise, not a regression).
+    regression_factor: float = 1.5
+    regression_min_ms: float = 2.0
+    regression_min_baseline: int = 6
+    regression_min_recent: int = 3
+
+    # feed_stall_dominance: median stall share of the step above this.
+    feed_stall_share: float = 0.5
+    feed_stall_min_ms: float = 1.0
+    feed_min_samples: int = 4
+
+    # checkpoint_lag: final (step - committed) beyond this many commit
+    # cadences, or a writer queue that only grows over the last commits.
+    ckpt_lag_cadences: float = 3.0
+    ckpt_queue_growth_commits: int = 3
+
+    # heartbeat_silence: a replica is silent when its last beat trails
+    # the reference by this many median beat intervals (floored, so a
+    # 10ms test cadence doesn't flag scheduler jitter).
+    silence_factor: float = 3.0
+    silence_min_s: float = 1.0
+
+    # straggler: worst replica p50 step time vs the gang median p50.
+    straggler_factor: float = 1.5
+    straggler_min_samples: int = 4
+
+    # noisy_neighbor: this many jobs regressing simultaneously on one
+    # host attributes the regression to the host, not the jobs.
+    noisy_neighbor_min_jobs: int = 2
+
+
+DEFAULT_THRESHOLDS = Thresholds()
+
+#: Valid override keys for ``spec.observability.alerts.thresholds``.
+THRESHOLD_FIELDS = frozenset(f.name for f in fields(Thresholds))
+
+_INT_FIELDS = frozenset(
+    f.name for f in fields(Thresholds) if f.type in ("int", int)
+)
+
+
+def thresholds_from_overrides(
+    overrides: Optional[Mapping[str, float]],
+) -> Thresholds:
+    """Defaults with any subset overridden. Unknown keys are ignored
+    here (validation.py rejects them at submit time; recorded specs
+    from a future version must not crash a postmortem)."""
+    if not overrides:
+        return DEFAULT_THRESHOLDS
+    known = {}
+    for k, v in overrides.items():
+        if k not in THRESHOLD_FIELDS:
+            continue
+        try:
+            known[k] = int(v) if k in _INT_FIELDS else float(v)
+        except (TypeError, ValueError):
+            continue
+    return replace(DEFAULT_THRESHOLDS, **known) if known else DEFAULT_THRESHOLDS
+
+
+# ---- small robust-stats helpers ----
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if n == 0:
+        return 0.0
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _quantile(vals: List[float], q: float) -> float:
+    s = sorted(vals)
+    if not s:
+        return 0.0
+    idx = q * (len(s) - 1)
+    lo = int(idx)
+    hi = min(lo + 1, len(s) - 1)
+    return s[lo] * (1 - (idx - lo)) + s[hi] * (idx - lo)
+
+
+# ---- findings ----
+
+
+@dataclass
+class Finding:
+    """One detector hit. ``evidence`` entries are small dicts each
+    naming their source (``status`` / ``event`` / ``span``), the
+    ALIGNED timestamp, and enough coordinates to find the artifact
+    (replica + record kind, event reason, or span name+args).
+    ``replica`` names the implicated replica when the rule is
+    replica-specific (silence victim, straggler) — the alert engine
+    dedups on (job, rule, replica)."""
+
+    rule: str
+    severity: str  # "critical" | "warning" | "info"
+    summary: str
+    evidence: List[dict] = field(default_factory=list)
+    metrics: Dict[str, float] = field(default_factory=dict)
+    replica: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        d = {
+            "rule": self.rule,
+            "severity": self.severity,
+            "summary": self.summary,
+            "evidence": self.evidence,
+            "metrics": {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in self.metrics.items()
+            },
+        }
+        if self.replica is not None:
+            d["replica"] = self.replica
+        return d
+
+
+def ev_status(rec: dict, kind: str) -> dict:
+    out = {
+        "source": "status",
+        "kind": kind,
+        "replica": rec.get("replica", "?"),
+        "ts": round(float(rec.get("aligned_ts", rec.get("ts", 0.0))), 6),
+    }
+    for f in ("step", "step_time_ms", "feed_stall_ms", "queue_depth",
+              "commit_ms"):
+        if rec.get(f) is not None:
+            out[f] = rec[f]
+    return out
+
+
+def ev_event(rec: dict) -> dict:
+    return {
+        "source": "event",
+        "reason": rec.get("reason", "?"),
+        "type": rec.get("type", "?"),
+        "ts": round(float(rec.get("timestamp", 0.0)), 6),
+        "message": rec.get("message", ""),
+    }
+
+
+def ev_span(span: dict) -> dict:
+    return {
+        "source": "span",
+        "name": span.get("name", "?"),
+        "cat": span.get("cat", ""),
+        "ts": round(span.get("ts", 0) / 1e6, 6),
+        "dur_ms": round(span.get("dur", 0) / 1e3, 3),
+        "args": span.get("args", {}),
+    }
+
+
+# ---- the view protocol both engines implement ----
+
+
+class TimelineView(Protocol):
+    """What a rule may read. obs/analyze.Timeline (full recorded
+    history, clock-aligned) and obs/watch.LiveWindow (bounded rolling
+    window, supervisor clock) both satisfy it."""
+
+    window_s: Optional[float]
+    #: {replica: [sanitized records with ``aligned_ts``], sorted}
+    progress: Dict[str, List[dict]]
+    #: {kind: [records across replicas]} for the non-progress kinds.
+    records: Dict[str, List[dict]]
+
+    def all_progress(self) -> List[dict]: ...
+
+    def in_window(self, ts: float) -> bool: ...
+
+    def beat_interval(self) -> float: ...
+
+    def find_event(self, *reasons: str) -> Optional[dict]: ...
+
+    def find_step_span(self, replica: str, step: int) -> Optional[dict]: ...
+
+    def silence_reference(self) -> float: ...
+
+
+# ---- detectors ----
+
+
+def detect_step_time_regression(
+    tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
+) -> List[Finding]:
+    """Recent step time vs the job's own earlier baseline. With a
+    --window, "recent" is the window and the baseline is everything
+    before it; without one, the newest quarter vs the rest."""
+    samples = [
+        r for r in tl.all_progress() if r.get("step_time_ms") is not None
+    ]
+    if tl.window_s is not None:
+        recent = [r for r in samples if tl.in_window(r["aligned_ts"])]
+        baseline = [r for r in samples if not tl.in_window(r["aligned_ts"])]
+    else:
+        cut = max(
+            len(samples) - max(len(samples) // 4, th.regression_min_recent), 0
+        )
+        baseline, recent = samples[:cut], samples[cut:]
+    if (
+        len(baseline) < th.regression_min_baseline
+        or len(recent) < th.regression_min_recent
+    ):
+        return []
+    base_med = _median([float(r["step_time_ms"]) for r in baseline])
+    rec_med = _median([float(r["step_time_ms"]) for r in recent])
+    if (
+        rec_med <= base_med * th.regression_factor
+        or rec_med - base_med <= th.regression_min_ms
+    ):
+        return []
+    worst = max(recent, key=lambda r: float(r["step_time_ms"]))
+    evidence = [ev_status(worst, "progress")]
+    if worst.get("step") is not None:
+        span = tl.find_step_span(worst["replica"], int(worst["step"]))
+        if span is not None:
+            evidence.append(ev_span(span))
+    evidence.append(ev_status(baseline[-1], "progress"))
+    return [
+        Finding(
+            rule="step_time_regression",
+            severity="warning",
+            summary=(
+                f"step time regressed: recent median "
+                f"{rec_med:.1f}ms vs baseline {base_med:.1f}ms "
+                f"({rec_med / max(base_med, 1e-9):.1f}x)"
+            ),
+            evidence=evidence,
+            metrics={
+                "baseline_ms": base_med,
+                "recent_ms": rec_med,
+                "factor": rec_med / max(base_med, 1e-9),
+                "baseline_n": len(baseline),
+                "recent_n": len(recent),
+            },
+        )
+    ]
+
+
+def detect_feed_stall(
+    tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
+) -> List[Finding]:
+    samples = [
+        r
+        for r in tl.all_progress()
+        if r.get("feed_stall_ms") is not None
+        and r.get("step_time_ms") is not None
+        and tl.in_window(r["aligned_ts"])
+    ]
+    if len(samples) < th.feed_min_samples:
+        return []
+    stall_med = _median([float(r["feed_stall_ms"]) for r in samples])
+    step_med = _median([float(r["step_time_ms"]) for r in samples])
+    if step_med <= 0 or stall_med < th.feed_stall_min_ms:
+        return []
+    share = stall_med / step_med
+    if share <= th.feed_stall_share:
+        return []
+    worst = max(samples, key=lambda r: float(r["feed_stall_ms"]))
+    return [
+        Finding(
+            rule="feed_stall_dominance",
+            severity="warning",
+            summary=(
+                f"input feed dominates the step: median stall "
+                f"{stall_med:.1f}ms is {100 * share:.0f}% of the "
+                f"{step_med:.1f}ms step — the job is input-bound"
+            ),
+            evidence=[ev_status(worst, "progress")],
+            metrics={
+                "stall_ms": stall_med,
+                "step_ms": step_med,
+                "share": share,
+                "n": len(samples),
+            },
+        )
+    ]
+
+
+def detect_checkpoint_lag(
+    tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
+) -> List[Finding]:
+    commits = [
+        r
+        for r in tl.records.get("checkpoint_committed", [])
+        if r.get("step") is not None
+    ]
+    if not commits:
+        return []
+    findings: List[Finding] = []
+    steps = sorted(float(c["step"]) for c in commits)
+    cadence = _median([b - a for a, b in zip(steps, steps[1:])]) or 1.0
+    prog = [r for r in tl.all_progress() if r.get("step") is not None]
+    last_step = float(prog[-1]["step"]) if prog else None
+    last_commit = commits[-1]
+    if last_step is not None:
+        lag = last_step - float(last_commit["step"])
+        if lag > max(th.ckpt_lag_cadences * cadence, th.ckpt_lag_cadences):
+            findings.append(
+                Finding(
+                    rule="checkpoint_lag",
+                    severity="warning",
+                    summary=(
+                        f"checkpoints trail training by {lag:.0f} steps "
+                        f"(last commit step {last_commit['step']:.0f} vs "
+                        f"trained step {last_step:.0f}; commit cadence "
+                        f"~{cadence:.0f} steps) — a kill now loses that "
+                        "progress"
+                    ),
+                    evidence=[
+                        ev_status(last_commit, "checkpoint_committed"),
+                        ev_status(prog[-1], "progress"),
+                    ],
+                    metrics={
+                        "lag_steps": lag,
+                        "cadence_steps": cadence,
+                        "last_commit_step": float(last_commit["step"]),
+                        "last_trained_step": last_step,
+                    },
+                )
+            )
+    depths = [
+        float(c["queue_depth"])
+        for c in commits
+        if c.get("queue_depth") is not None
+    ]
+    tail = depths[-th.ckpt_queue_growth_commits:]
+    if (
+        len(tail) >= th.ckpt_queue_growth_commits
+        and all(b > a for a, b in zip(tail, tail[1:]))
+        and tail[-1] >= 2
+    ):
+        findings.append(
+            Finding(
+                rule="checkpoint_lag",
+                severity="warning",
+                summary=(
+                    f"async checkpoint queue growing without draining "
+                    f"(depth {tail[0]:.0f} -> {tail[-1]:.0f} over the "
+                    f"last {len(tail)} commits) — commits are slower "
+                    "than the save cadence"
+                ),
+                evidence=[ev_status(last_commit, "checkpoint_committed")],
+                metrics={"queue_depth": tail[-1]},
+            )
+        )
+    return findings
+
+
+def detect_heartbeat_silence(
+    tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
+) -> List[Finding]:
+    """The hung-replica detector. Two triggers: a recorded hang/deadline
+    kill (name the replica whose beats stopped first, with evidence
+    timestamped BEFORE the kill), or a replica silent relative to the
+    view's silence reference — the gang's newest beat offline, the
+    supervisor's wall clock live (see the module docstring)."""
+    last_beats = {
+        replica: rs[-1] for replica, rs in tl.progress.items() if rs
+    }
+    if not last_beats:
+        return []
+    gap = tl.beat_interval()
+    threshold = max(th.silence_factor * gap, th.silence_min_s)
+    findings: List[Finding] = []
+
+    kill = tl.find_event("TPUJobHung", "DeadlineExceeded")
+    if kill is not None:
+        kill_ts = float(kill.get("timestamp", 0.0))
+        # The hung replica: oldest last-beat in the gang (with
+        # drop_heartbeat or a wedged collective, the victim stops first;
+        # a fully-wedged world makes every replica a victim — name the
+        # earliest-silent one).
+        victim, rec = min(
+            last_beats.items(), key=lambda kv: kv[1]["aligned_ts"]
+        )
+        silence = kill_ts - rec["aligned_ts"]
+        evidence = [ev_status(rec, "progress"), ev_event(kill)]
+        if rec.get("step") is not None:
+            span = tl.find_step_span(victim, int(rec["step"]))
+            if span is not None:
+                evidence.insert(1, ev_span(span))
+        findings.append(
+            Finding(
+                rule="heartbeat_silence",
+                severity="critical",
+                summary=(
+                    f"replica {victim} went silent {silence:.1f}s before "
+                    f"the {kill.get('reason')} kill (last beat at step "
+                    f"{rec.get('step', '?')})"
+                ),
+                evidence=evidence,
+                metrics={
+                    "silence_s": silence,
+                    "kill_ts": kill_ts,
+                    "last_beat_ts": rec["aligned_ts"],
+                },
+                replica=victim,
+            )
+        )
+        return findings
+
+    # Silence vs the reference: newest gang beat offline ("someone kept
+    # beating, someone stopped"), supervisor now live (a single hung
+    # replica has nobody else to compare against before the kill).
+    newest = tl.silence_reference()
+    for replica, rec in sorted(last_beats.items()):
+        silence = newest - rec["aligned_ts"]
+        if silence > threshold:
+            findings.append(
+                Finding(
+                    rule="heartbeat_silence",
+                    severity="critical",
+                    summary=(
+                        f"replica {replica} silent for {silence:.1f}s "
+                        f"(threshold {threshold:.1f}s = "
+                        f"{th.silence_factor:g}x the {gap:.2f}s beat "
+                        "interval)"
+                    ),
+                    evidence=[ev_status(rec, "progress")],
+                    metrics={
+                        "silence_s": silence,
+                        "threshold_s": threshold,
+                    },
+                    replica=replica,
+                )
+            )
+    return findings
+
+
+def detect_straggler(
+    tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
+) -> List[Finding]:
+    per_replica: Dict[str, List[float]] = {}
+    for replica, rs in tl.progress.items():
+        vals = [
+            float(r["step_time_ms"])
+            for r in rs
+            if r.get("step_time_ms") is not None
+            and tl.in_window(r["aligned_ts"])
+        ]
+        if len(vals) >= th.straggler_min_samples:
+            per_replica[replica] = vals
+    if len(per_replica) < 2:
+        return []
+    p50s = {r: _median(v) for r, v in per_replica.items()}
+    gang_p50 = _median(list(p50s.values()))
+    worst, worst_p50 = max(p50s.items(), key=lambda kv: kv[1])
+    if gang_p50 <= 0 or worst_p50 <= th.straggler_factor * gang_p50:
+        return []
+    p99 = _quantile(per_replica[worst], 0.99)
+    worst_rec = max(
+        (r for r in tl.progress[worst] if r.get("step_time_ms") is not None),
+        key=lambda r: float(r["step_time_ms"]),
+    )
+    evidence = [ev_status(worst_rec, "progress")]
+    if worst_rec.get("step") is not None:
+        span = tl.find_step_span(worst, int(worst_rec["step"]))
+        if span is not None:
+            evidence.append(ev_span(span))
+    return [
+        Finding(
+            rule="straggler",
+            severity="warning",
+            summary=(
+                f"replica {worst} straggles the gang: p50 step time "
+                f"{worst_p50:.1f}ms vs gang {gang_p50:.1f}ms "
+                f"({worst_p50 / gang_p50:.1f}x; its p99 {p99:.1f}ms)"
+            ),
+            evidence=evidence,
+            metrics={
+                "replica_p50_ms": worst_p50,
+                "gang_p50_ms": gang_p50,
+                "replica_p99_ms": p99,
+                "spread": worst_p50 / gang_p50,
+                "replicas": len(per_replica),
+            },
+            replica=worst,
+        )
+    ]
+
+
+DETECTORS: Tuple[Callable[..., List[Finding]], ...] = (
+    detect_heartbeat_silence,
+    detect_step_time_regression,
+    detect_feed_stall,
+    detect_checkpoint_lag,
+    detect_straggler,
+)
+
+#: Every rule either engine can produce (the alert/report inventory).
+RULES = (
+    "heartbeat_silence",
+    "step_time_regression",
+    "feed_stall_dominance",
+    "checkpoint_lag",
+    "straggler",
+    "noisy_neighbor",
+)
+
+SEVERITY_ORDER = {"critical": 0, "warning": 1, "info": 2}
+
+
+def run_detectors(
+    tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
+) -> List[Finding]:
+    """Evaluate every per-job rule over one view, most severe first —
+    THE shared entry point: ``tpujob why`` and the live watch both call
+    exactly this."""
+    findings: List[Finding] = []
+    for det in DETECTORS:
+        findings.extend(det(tl, th))
+    findings.sort(key=lambda f: SEVERITY_ORDER.get(f.severity, 9))
+    return findings
+
+
+# ---- the cross-job rule (watch-level: needs the whole fleet) ----
+
+
+def correlate_noisy_neighbor(
+    regressing: Dict[str, Finding],
+    host: str,
+    th: Thresholds = DEFAULT_THRESHOLDS,
+) -> Dict[str, Finding]:
+    """Attribute SIMULTANEOUS step-time regressions across jobs sharing
+    one host to a noisy neighbor (MLPerf TPU-pod study: host-level
+    interference dominates tails). ``regressing`` maps job key -> its
+    live step_time_regression finding this pass; when at least
+    ``noisy_neighbor_min_jobs`` regress together, each gets a
+    ``noisy_neighbor`` finding citing the others — the per-job
+    regression alone would blame the job for the host's problem."""
+    if len(regressing) < th.noisy_neighbor_min_jobs:
+        return {}
+    out: Dict[str, Finding] = {}
+    for key, finding in regressing.items():
+        others = sorted(k for k in regressing if k != key)
+        out[key] = Finding(
+            rule="noisy_neighbor",
+            severity="warning",
+            summary=(
+                f"step-time regression correlates across "
+                f"{len(regressing)} jobs on host {host} "
+                f"(also regressing: {', '.join(others)}) — likely a "
+                "noisy neighbor, not this job"
+            ),
+            evidence=[
+                {
+                    "source": "alert",
+                    "job": k,
+                    "rule": "step_time_regression",
+                    "summary": regressing[k].summary,
+                }
+                for k in others
+            ],
+            metrics={
+                "jobs_regressing": len(regressing),
+                "factor": finding.metrics.get("factor", 0.0),
+            },
+        )
+    return out

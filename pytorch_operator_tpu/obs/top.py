@@ -24,6 +24,7 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from .metrics import histogram_quantile, parse_exemplars, parse_prometheus_text
+from .watch import fold_alert_log, load_alert_log
 
 STEP_HIST = "tpujob_step_time_seconds"
 
@@ -39,9 +40,16 @@ COLUMNS = (
     ("CKPT LAG", "ckpt_lag"),
     ("FEED(ms)", "feed_stall_ms"),
     ("HB AGE", "age_s"),
+    ("ALERTS", "alerts"),
     ("RESTARTS", "restarts"),
     ("P99 SPAN", "p99_span"),
 )
+
+# ANSI for the firing-row highlight (only applied when the renderer is
+# asked to color — a TTY repaint loop; piped output and the /top HTTP
+# route stay plain text).
+_RED = "\x1b[31m"
+_RESET = "\x1b[0m"
 
 
 def _hist_quantiles(
@@ -100,6 +108,15 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
         q = _hist_quantiles(metrics, STEP_HIST, key)
         step = hb.get("step")
         ck_step = ck.get("step")
+        # Live health engine state (obs/watch.py alert log): the rules
+        # currently FIRING for this job, folded from the on-disk
+        # transition log so `tpujob top` answers with or without a
+        # daemon (same contract as the heartbeat columns).
+        firing = [
+            r["rule"]
+            for r in fold_alert_log(load_alert_log(state, key))
+            if r.get("state") == "firing"
+        ]
         rows.append(
             {
                 "job": key,
@@ -114,6 +131,8 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
                 ),
                 "feed_stall_ms": hb.get("feed_stall_ms"),
                 "age_s": (now - hb["ts"]) if hb.get("ts") else None,
+                "alerts": len(firing) or None,
+                "alert_rules": sorted(firing),
                 "restarts": job.status.restart_count,
                 # Exemplar linking: the latest span that landed in the
                 # job's slowest populated step-time bucket — the jump
@@ -176,38 +195,52 @@ def _fmt(v, spec: str = "", dash: str = "-") -> str:
     return format(v, spec) if spec else str(v)
 
 
+def _cells(r: dict) -> tuple:
+    return (
+        r["job"],
+        _fmt(None if r["step"] is None else int(r["step"])),
+        _fmt(r["steps_per_sec"], ".2f"),
+        _fmt(r["p50_ms"], ".1f"),
+        _fmt(r["p99_ms"], ".1f"),
+        _fmt(r["ckpt_lag"]),
+        _fmt(r["feed_stall_ms"], ".2f"),
+        _fmt(None if r["age_s"] is None else f"{r['age_s']:.0f}s"),
+        (
+            f"{r['alerts']}:{','.join(r.get('alert_rules', []))}"
+            if r.get("alerts")
+            else "-"
+        ),
+        str(r["restarts"]),
+        _fmt(r.get("p99_span")),
+    )
+
+
 def render_table(
     rows: List[dict],
     now: Optional[float] = None,
     sort_key: Optional[str] = None,
     filter_str: Optional[str] = None,
+    color: bool = False,
 ) -> str:
     """The one-screen table. Columns stay stable so watch-mode diffs
     visually; '-' means "not reported", never 0. ``sort_key`` marks the
     sorted column with '▾' (the interactive loop passes it; one-shot
-    callers don't)."""
+    callers don't). ``color=True`` (TTY repaint loop) paints rows with
+    firing alerts red — the width math runs BEFORE the escape codes so
+    alignment survives."""
     header = tuple(
         h + " ▾" if key == sort_key else h for h, key in COLUMNS
     )
     table = [header]
     for r in rows:
-        table.append(
-            (
-                r["job"],
-                _fmt(None if r["step"] is None else int(r["step"])),
-                _fmt(r["steps_per_sec"], ".2f"),
-                _fmt(r["p50_ms"], ".1f"),
-                _fmt(r["p99_ms"], ".1f"),
-                _fmt(r["ckpt_lag"]),
-                _fmt(r["feed_stall_ms"], ".2f"),
-                _fmt(None if r["age_s"] is None else f"{r['age_s']:.0f}s"),
-                str(r["restarts"]),
-                _fmt(r.get("p99_span")),
-            )
-        )
+        table.append(_cells(r))
     widths = [max(len(row[i]) for row in table) for i in range(len(header))]
-    lines = ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
-             for row in table]
+    lines = []
+    for i, row in enumerate(table):
+        line = "  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+        if color and i > 0 and rows[i - 1].get("alerts"):
+            line = f"{_RED}{line}{_RESET}"
+        lines.append(line)
     if not rows:
         lines.append(
             f"(no jobs matching {filter_str!r})" if filter_str
@@ -218,13 +251,55 @@ def render_table(
     return "\n".join(lines)
 
 
+def diff_rows(prev: List[dict], rows: List[dict]) -> List[str]:
+    """``tpujob top --diff``: what CHANGED since the previous repaint,
+    as human lines — new/gone jobs, step-rate moves, checkpoint-lag
+    growth, heartbeat-age jumps, and alert transitions — instead of a
+    full-table repaint. Pure (no I/O, no clock) so the delta semantics
+    are unit-testable."""
+    by_job_prev = {r["job"]: r for r in prev}
+    by_job_cur = {r["job"]: r for r in rows}
+    lines: List[str] = []
+    for job in sorted(set(by_job_prev) | set(by_job_cur)):
+        p, c = by_job_prev.get(job), by_job_cur.get(job)
+        if p is None:
+            lines.append(f"{job}: appeared (step {_fmt(c.get('step'))})")
+            continue
+        if c is None:
+            lines.append(f"{job}: gone (finished or deleted)")
+            continue
+        changes: List[str] = []
+        ps, cs = p.get("steps_per_sec"), c.get("steps_per_sec")
+        if ps is not None and cs is not None and abs(cs - ps) > 0.05 * max(ps, 1e-9):
+            arrow = "▼" if cs < ps else "▲"
+            changes.append(f"steps/s {ps:.2f}→{cs:.2f} {arrow}")
+        for key, label in (("ckpt_lag", "ckpt lag"), ("restarts", "restarts")):
+            if p.get(key) != c.get(key) and c.get(key) is not None:
+                changes.append(f"{label} {_fmt(p.get(key))}→{_fmt(c.get(key))}")
+        pa, ca = p.get("age_s"), c.get("age_s")
+        if pa is not None and ca is not None and ca > max(3 * pa, pa + 2.0):
+            changes.append(f"hb age {pa:.0f}s→{ca:.0f}s (going silent?)")
+        prev_alerts = set(p.get("alert_rules") or ())
+        cur_alerts = set(c.get("alert_rules") or ())
+        for rule in sorted(cur_alerts - prev_alerts):
+            changes.append(f"ALERT firing: {rule}")
+        for rule in sorted(prev_alerts - cur_alerts):
+            changes.append(f"alert resolved: {rule}")
+        if changes:
+            lines.append(f"{job}: " + "; ".join(changes))
+    return lines
+
+
 def render(
     state_dir,
     now: Optional[float] = None,
     sort_key: Optional[str] = None,
     reverse: bool = True,
     filter_str: Optional[str] = None,
+    color: bool = False,
 ) -> str:
     rows = filter_rows(gather_rows(state_dir, now), filter_str)
     rows = sort_rows(rows, sort_key, reverse)
-    return render_table(rows, now, sort_key=sort_key, filter_str=filter_str)
+    return render_table(
+        rows, now, sort_key=sort_key, filter_str=filter_str, color=color
+    )

@@ -1,0 +1,699 @@
+"""The live health engine — streaming detector rules + alert lifecycle.
+
+The postmortem engine (obs/analyze.py) can explain a dead job; this
+module notices the dying one. Production pretraining treats degradation
+as routine and detects it ONLINE (TorchTitan), and per-host stragglers
+dominate tail behavior long before they become failures (MLPerf on
+TPU-v3 pods) — so the same detector rules ``tpujob why`` runs offline
+(obs/rules.py) are evaluated incrementally inside the supervisor's
+steady phase, over telemetry the per-pass gauge fold ALREADY tailed:
+
+- :meth:`WatchEngine.observe` ingests the newest per-replica records
+  straight from :meth:`ProgressTailer.replica_latest` poll state —
+  zero extra file I/O, ever (the bench_smoke lane pins zero alert-log
+  appends and zero store reads/writes on an idle healthy pass);
+- :meth:`WatchEngine.evaluate` runs the shared rule pass over a
+  bounded rolling window per job (:class:`LiveWindow` — the live
+  :class:`~pytorch_operator_tpu.obs.rules.TimelineView`);
+- findings feed an alert LIFECYCLE with hysteresis: ``pending`` while
+  younger than ``for_s`` (a one-pass blip never pages), ``firing``
+  after, ``resolved`` once the finding has been absent ``clear_s``
+  seconds — deduplicated by (job, rule, replica);
+- every firing/resolved TRANSITION is appended to a per-job alert log
+  (``<state>/alerts/<ns>_<job>/alerts.jsonl`` — an artifact root, so
+  ``delete --purge`` reclaims it and ``tpujob why`` cites it after a
+  death); steady states write nothing;
+- the fleet view exports as ``tpujob_alerts{job,rule,severity}``
+  gauges, the ``/alerts`` monitoring route, the ``tpujob alerts``
+  verb, and the ALERTS column in ``tpujob top``.
+
+Cross-job correlation (:meth:`WatchEngine.correlate`, end of each
+pass): simultaneous step-time regressions across jobs sharing this
+host raise ``noisy_neighbor`` alerts attributing the regression to the
+host rather than the jobs.
+
+Per-job tuning comes from ``spec.observability.alerts`` (api/types:
+``enabled`` / ``for_s`` / ``clear_s`` / ``thresholds``), resolved the
+same way ``tpujob why`` resolves it offline — one bar, two engines.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Deque, Dict, Iterable, List, Optional, Tuple
+
+from .rules import (
+    DEFAULT_THRESHOLDS,
+    Finding,
+    SEVERITY_ORDER,
+    Thresholds,
+    correlate_noisy_neighbor,
+    run_detectors,
+    thresholds_from_overrides,
+)
+
+# Subdirectory of the supervisor state dir holding per-job alert logs
+# (a sibling of jobs/, status/, events/, clock/ — and an ARTIFACT_ROOT,
+# so `delete --purge` sweeps it).
+ALERTS_DIR = "alerts"
+
+# Rolling-window bounds: enough history for every rule's minimum sample
+# counts with headroom, small enough that a pass over N jobs stays
+# O(N * constant). The live regression baseline is therefore the last
+# ~WINDOW_BEATS observed beats, not all time — a week-long drift shows
+# up offline in `tpujob why`, which reads the full recording.
+WINDOW_BEATS = 240
+WINDOW_RECORDS = 64
+
+# Lifecycle defaults (spec.observability.alerts overrides per job).
+# for_s=0 fires on first detection — the rules already embed their own
+# persistence (minimum sample counts, silence thresholds), so by the
+# time a rule matches, the condition has lasted; jobs that want calmer
+# paging raise it. clear_s keeps a flapping signal from resolving and
+# re-firing every other pass.
+DEFAULT_FOR_S = 0.0
+DEFAULT_CLEAR_S = 5.0
+
+# Alert-log size cap, rotated once like the clock log: lifecycle
+# transitions are rare, but a pathological flapper must not fill a disk.
+LOG_MAX_BYTES = 1 << 20
+
+
+def job_alert_log(state_dir, key: str) -> Path:
+    """THE per-job alert-log path (write and read side agree)."""
+    from ..controller.store import key_to_fs
+
+    return Path(state_dir) / ALERTS_DIR / key_to_fs(key) / "alerts.jsonl"
+
+
+def load_alert_log(state_dir, key: str) -> List[dict]:
+    """Parse one job's alert log (rotated generation included), oldest
+    first. Torn/foreign lines skipped — appended by a live daemon, read
+    after kills, like every recorded artifact."""
+    p = job_alert_log(state_dir, key)
+    out: List[dict] = []
+    for gen in (p.with_suffix(".jsonl.1"), p):
+        try:
+            data = gen.read_bytes()
+        except OSError:
+            continue
+        for line in data.splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                float(rec.get("ts", 0.0))
+            except (ValueError, TypeError, AttributeError):
+                continue
+            if not isinstance(rec, dict) or "rule" not in rec:
+                continue
+            out.append(rec)
+    return out
+
+
+def fold_alert_log(records: Iterable[dict]) -> List[dict]:
+    """Collapse a transition log to the LATEST state per (rule,
+    replica) — the "what is the situation now" view a daemon-less CLI
+    reconstructs from disk. Sorted most-severe-first, firing before
+    resolved."""
+    cur: Dict[Tuple[str, str], dict] = {}
+    for rec in records:
+        cur[(str(rec.get("rule")), str(rec.get("replica") or "*"))] = rec
+    return sorted(
+        cur.values(),
+        key=lambda r: (
+            r.get("state") != "firing",
+            SEVERITY_ORDER.get(r.get("severity", ""), 9),
+            r.get("rule", ""),
+        ),
+    )
+
+
+def list_alert_jobs(state_dir) -> List[str]:
+    """Job keys with an alert log on disk (the `tpujob alerts` fleet
+    scan)."""
+    from ..controller.store import fs_to_key
+
+    root = Path(state_dir) / ALERTS_DIR
+    if not root.is_dir():
+        return []
+    return sorted(
+        fs_to_key(d.name)
+        for d in root.iterdir()
+        if d.is_dir()
+        and (
+            (d / "alerts.jsonl").exists()
+            or (d / "alerts.jsonl.1").exists()
+        )
+    )
+
+
+# ---- the live TimelineView ----
+
+
+class LiveWindow:
+    """The rules' read surface over one job's rolling window. Same
+    duck-typed protocol as obs/analyze.Timeline; timestamps are raw
+    replica send times on the supervisor's frame-of-reference pass
+    (``aligned_ts == ts`` — the live engine trades clock alignment for
+    zero latency; the offline engine re-judges with alignment)."""
+
+    window_s: Optional[float] = None
+
+    def __init__(
+        self,
+        progress: Dict[str, List[dict]],
+        records: Dict[str, List[dict]],
+        events: Iterable,
+        now: float,
+    ):
+        self.progress = progress
+        self.records = records
+        self.events = events
+        self.now = now
+
+    def all_progress(self) -> List[dict]:
+        out = [r for rs in self.progress.values() for r in rs]
+        out.sort(key=lambda r: r["aligned_ts"])
+        return out
+
+    def in_window(self, ts: float) -> bool:
+        return True
+
+    def beat_interval(self) -> float:
+        gaps: List[float] = []
+        for rs in self.progress.values():
+            for a, b in zip(rs, rs[1:]):
+                gaps.append(b["aligned_ts"] - a["aligned_ts"])
+        gaps.sort()
+        n = len(gaps)
+        if n == 0:
+            return 0.0
+        return gaps[n // 2] if n % 2 else 0.5 * (gaps[n // 2 - 1] + gaps[n // 2])
+
+    def silence_reference(self) -> float:
+        """Live silence is judged against the supervisor's wall clock —
+        a hung single-replica job has nobody else to compare against,
+        and the whole point is alerting BEFORE the deadline kill."""
+        return self.now
+
+    def find_event(self, *reasons: str) -> Optional[dict]:
+        for e in self.events:
+            r = e.get("reason") if isinstance(e, dict) else getattr(e, "reason", None)
+            if r in reasons:
+                if isinstance(e, dict):
+                    return e
+                return {
+                    "reason": e.reason,
+                    "type": e.type,
+                    "timestamp": e.timestamp,
+                    "message": e.message,
+                }
+        return None
+
+    def find_step_span(self, replica: str, step: int) -> Optional[dict]:
+        return None  # spans are an offline artifact
+
+
+# ---- alerts ----
+
+
+@dataclass
+class Alert:
+    """One lifecycle instance: created pending at first detection,
+    firing after ``for_s``, resolved after ``clear_s`` of absence (or
+    at job finish). Dedup key is (job, rule, replica) — a re-detection
+    after resolve starts a NEW instance."""
+
+    job: str
+    rule: str
+    replica: str  # "*" when the rule is not replica-specific
+    severity: str
+    state: str  # pending | firing | resolved
+    since: float  # first detection
+    last_seen: float
+    summary: str
+    evidence: List[dict] = field(default_factory=list)
+    metrics: Dict[str, float] = field(default_factory=dict)
+    fired_at: Optional[float] = None
+    resolved_at: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        d = {
+            "job": self.job,
+            "rule": self.rule,
+            "replica": self.replica,
+            "severity": self.severity,
+            "state": self.state,
+            "since": round(self.since, 6),
+            "last_seen": round(self.last_seen, 6),
+            "summary": self.summary,
+            "metrics": {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in self.metrics.items()
+            },
+        }
+        if self.fired_at is not None:
+            d["fired_at"] = round(self.fired_at, 6)
+        if self.resolved_at is not None:
+            d["resolved_at"] = round(self.resolved_at, 6)
+        return d
+
+
+class WatchIOCounters:
+    """Watch-side I/O accounting, snapshot like StoreIOCounters — the
+    bench_smoke lane pins ``log_appends`` at zero across idle healthy
+    passes (the engine must stay write-free when nothing transitions)."""
+
+    __slots__ = ("log_appends", "evaluations")
+
+    def __init__(self) -> None:
+        self.log_appends = 0
+        self.evaluations = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "log_appends": self.log_appends,
+            "evaluations": self.evaluations,
+        }
+
+
+class _JobWatch:
+    """Per-job rolling state: bounded sample windows, dedup watermarks,
+    and the live alert instances."""
+
+    __slots__ = ("progress", "records", "seen", "alerts", "cfg")
+
+    def __init__(self) -> None:
+        self.progress: Dict[str, Deque[dict]] = {}
+        self.records: Dict[str, Deque[dict]] = {}
+        self.seen: Dict[Tuple[str, str], float] = {}
+        self.alerts: Dict[Tuple[str, str], Alert] = {}
+        # (enabled, for_s, clear_s, thresholds) as of the last evaluate
+        # — correlate() runs after the per-job pass and reuses it.
+        self.cfg: Tuple[bool, float, float, Thresholds] = (
+            True, DEFAULT_FOR_S, DEFAULT_CLEAR_S, DEFAULT_THRESHOLDS,
+        )
+
+
+# The record kinds the live window accumulates (a subset of
+# progress.TAILED_KINDS — clock_probe is the estimator's, not a rule's).
+_WATCHED_KINDS = ("progress", "checkpoint_committed")
+
+
+class WatchEngine:
+    """The supervisor-resident streaming evaluator. One instance per
+    supervisor; all methods are called from the sync pass (single
+    logical writer — the steady phase parallelizes RECONCILES, the
+    gauge fold that feeds this stays on the pass thread)."""
+
+    def __init__(self, state_dir, host: Optional[str] = None):
+        self.state_dir = Path(state_dir)
+        self.host = host or socket.gethostname()
+        self._jobs: Dict[str, _JobWatch] = {}
+        # job -> its step_time_regression finding this pass (the
+        # noisy-neighbor correlation input).
+        self._regressing: Dict[str, Finding] = {}
+        self.io = WatchIOCounters()
+
+    # ---- ingest ----
+
+    def observe(self, key: str, by_replica: Dict[str, dict]) -> None:
+        """Fold the newest per-replica records (the dict
+        :meth:`ProgressTailer.replica_latest` returns — already-polled
+        state, zero I/O) into the job's rolling window. A record is
+        ingested once, by its ``ts`` watermark; a job with no telemetry
+        never allocates state (idle fleets stay O(0) here)."""
+        if not by_replica:
+            return
+        jw = None
+        for replica, kinds in by_replica.items():
+            for kind in _WATCHED_KINDS:
+                rec = kinds.get(kind)
+                if rec is None:
+                    continue
+                if jw is None:
+                    jw = self._jobs.get(key)
+                    if jw is None:
+                        jw = self._jobs[key] = _JobWatch()
+                wm = jw.seen.get((replica, kind))
+                if wm is not None and rec["ts"] <= wm:
+                    continue
+                jw.seen[(replica, kind)] = rec["ts"]
+                self._ingest(jw, replica, kind, rec)
+
+    def ingest_record(self, key: str, replica: str, kind: str, rec: dict) -> None:
+        """Feed one raw status record (replay/tests — and the
+        offline-vs-live parity contract: replaying a recorded timeline
+        through here must reproduce ``tpujob why``'s findings)."""
+        if kind not in _WATCHED_KINDS:
+            return
+        jw = self._jobs.get(key)
+        if jw is None:
+            jw = self._jobs[key] = _JobWatch()
+        self._ingest(jw, replica, kind, rec)
+
+    @staticmethod
+    def _ingest(jw: _JobWatch, replica: str, kind: str, rec: dict) -> None:
+        r = dict(rec)
+        r["replica"] = replica
+        r.setdefault("aligned_ts", float(r.get("ts", 0.0)))
+        if kind == "progress":
+            win = jw.progress.get(replica)
+            if win is None:
+                win = jw.progress[replica] = deque(maxlen=WINDOW_BEATS)
+            win.append(r)
+        else:
+            win = jw.records.get(kind)
+            if win is None:
+                win = jw.records[kind] = deque(maxlen=WINDOW_RECORDS)
+            win.append(r)
+
+    def tracked(self, key: str) -> bool:
+        """Cheap pre-check so the supervisor skips evaluation (and the
+        per-job event-list copy) for jobs that never reported."""
+        return key in self._jobs
+
+    # ---- evaluate ----
+
+    @staticmethod
+    def _resolve_cfg(job) -> Tuple[bool, float, float, Thresholds]:
+        if job is not None:
+            ob = job.spec.observability
+            if ob is not None and ob.alerts is not None:
+                al = ob.alerts
+                return (
+                    al.enabled,
+                    float(al.for_s),
+                    float(al.clear_s),
+                    thresholds_from_overrides(al.thresholds),
+                )
+        return (True, DEFAULT_FOR_S, DEFAULT_CLEAR_S, DEFAULT_THRESHOLDS)
+
+    def evaluate(
+        self,
+        key: str,
+        job=None,
+        events: Iterable = (),
+        now: Optional[float] = None,
+    ) -> List[Alert]:
+        """Run the shared rule pass over the job's window and step the
+        alert lifecycle. Returns the job's live (pending|firing)
+        alerts. Pure compute plus at most one log append per
+        transition; an unchanged healthy job costs rule evaluation over
+        its bounded window and zero I/O."""
+        jw = self._jobs.get(key)
+        if jw is None:
+            return []
+        now = time.time() if now is None else now
+        enabled, for_s, clear_s, th = self._resolve_cfg(job)
+        jw.cfg = (enabled, for_s, clear_s, th)
+        if not enabled:
+            # Alerting turned off mid-flight: resolve what's firing so
+            # the surfaces don't show frozen alerts forever.
+            self._step(jw, key, {}, now, for_s, 0.0, _per_job_rule)
+            self._regressing.pop(key, None)
+            return []
+        view = LiveWindow(
+            progress={r: list(d) for r, d in jw.progress.items()},
+            records={k: list(d) for k, d in jw.records.items()},
+            events=events,
+            now=now,
+        )
+        findings = run_detectors(view, th)
+        self.io.evaluations += 1
+        reg = next(
+            (f for f in findings if f.rule == "step_time_regression"), None
+        )
+        if reg is not None:
+            self._regressing[key] = reg
+        else:
+            self._regressing.pop(key, None)
+        keyed: Dict[Tuple[str, str], Finding] = {}
+        for f in findings:
+            keyed.setdefault((f.rule, f.replica or "*"), f)
+        return self._step(jw, key, keyed, now, for_s, clear_s, _per_job_rule)
+
+    def correlate(self, now: Optional[float] = None) -> None:
+        """End-of-pass cross-job rule: simultaneous regressions on this
+        host become ``noisy_neighbor`` alerts (per affected job, with
+        that job's lifecycle config)."""
+        now = time.time() if now is None else now
+        findings = correlate_noisy_neighbor(self._regressing, self.host)
+        for key, jw in self._jobs.items():
+            f = findings.get(key)
+            enabled, for_s, clear_s, _ = jw.cfg
+            keyed = (
+                {(f.rule, "*"): f} if f is not None and enabled else {}
+            )
+            self._step(jw, key, keyed, now, for_s, clear_s, _cross_job_rule)
+
+    def _step(
+        self,
+        jw: _JobWatch,
+        key: str,
+        findings: Dict[Tuple[str, str], Finding],
+        now: float,
+        for_s: float,
+        clear_s: float,
+        in_scope,
+    ) -> List[Alert]:
+        """One lifecycle step over the alerts whose rule ``in_scope``
+        covers: pending→firing after ``for_s`` of persistence,
+        firing→resolved after ``clear_s`` of absence, pending dropped
+        on the first miss (the condition must hold continuously to
+        fire). Transitions append to the job's log; steady states
+        don't."""
+        for k, f in findings.items():
+            a = jw.alerts.get(k)
+            if a is None:
+                a = Alert(
+                    job=key,
+                    rule=f.rule,
+                    replica=k[1],
+                    severity=f.severity,
+                    state="pending",
+                    since=now,
+                    last_seen=now,
+                    summary=f.summary,
+                    evidence=f.evidence,
+                    metrics=f.metrics,
+                )
+                jw.alerts[k] = a
+            else:
+                a.last_seen = now
+                a.summary = f.summary
+                a.evidence = f.evidence
+                a.metrics = f.metrics
+                a.severity = f.severity
+            if a.state == "pending" and now - a.since >= for_s:
+                a.state = "firing"
+                a.fired_at = now
+                self._append(key, a, now)
+        for k, a in list(jw.alerts.items()):
+            if k in findings or not in_scope(a.rule):
+                continue
+            if a.state == "pending":
+                del jw.alerts[k]
+            elif a.state == "firing" and now - a.last_seen >= clear_s:
+                a.state = "resolved"
+                a.resolved_at = now
+                self._append(key, a, now)
+                del jw.alerts[k]
+        return [a for a in jw.alerts.values()]
+
+    # ---- lifecycle edges ----
+
+    def finalize(self, key: str, now: Optional[float] = None) -> None:
+        """The job finished: resolve anything still firing (logged — a
+        postmortem must see the alert CLOSED by the death, not left
+        dangling) and drop the rolling state. Idempotent."""
+        jw = self._jobs.pop(key, None)
+        self._regressing.pop(key, None)
+        if jw is None:
+            return
+        now = time.time() if now is None else now
+        for a in jw.alerts.values():
+            if a.state == "firing":
+                a.state = "resolved"
+                a.resolved_at = now
+                a.summary += " (job finished)"
+                self._append(key, a, now)
+
+    def retire_job(self, key: str) -> None:
+        """The job was DELETED: drop state without logging — the alert
+        log on disk stays as the postmortem surface unless the delete
+        purged artifacts."""
+        self._jobs.pop(key, None)
+        self._regressing.pop(key, None)
+
+    def _append(self, key: str, a: Alert, now: float) -> None:
+        rec = {
+            "ts": round(now, 6),
+            "state": a.state,
+            "job": key,
+            "rule": a.rule,
+            "replica": a.replica,
+            "severity": a.severity,
+            "summary": a.summary,
+            "since": round(a.since, 6),
+        }
+        if a.state == "firing":
+            rec["evidence"] = a.evidence
+            rec["metrics"] = {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in a.metrics.items()
+            }
+        line = (json.dumps(rec) + "\n").encode()
+        path = job_alert_log(self.state_dir, key)
+        try:
+            try:
+                if path.stat().st_size + len(line) > LOG_MAX_BYTES:
+                    path.replace(path.with_suffix(".jsonl.1"))
+            except OSError:
+                pass
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with path.open("ab") as f:
+                f.write(line)
+            self.io.log_appends += 1
+        except OSError:
+            pass  # best-effort, like the event sink
+
+    # ---- read surfaces ----
+
+    def active_alerts(self, key: Optional[str] = None) -> List[Alert]:
+        """Live pending/firing alerts, firing first then most severe."""
+        out: List[Alert] = []
+        if key is not None:
+            jw = self._jobs.get(key)
+            if jw is not None:
+                out = list(jw.alerts.values())
+        else:
+            for jw in self._jobs.values():
+                out.extend(jw.alerts.values())
+        out.sort(
+            key=lambda a: (
+                a.state != "firing",
+                SEVERITY_ORDER.get(a.severity, 9),
+                a.job,
+                a.rule,
+                a.replica,
+            )
+        )
+        return out
+
+    def export_gauge(self, gauge) -> None:
+        """Rebuild ``tpujob_alerts{job,rule,severity}`` from the live
+        state (cleared per pass like the other per-job gauges — a
+        resolved alert's series must not linger)."""
+        gauge.clear()
+        counts: Dict[Tuple[str, str, str], int] = {}
+        for a in self.active_alerts():
+            if a.state != "firing":
+                continue
+            k = (a.job, a.rule, a.severity)
+            counts[k] = counts.get(k, 0) + 1
+        for (job, rule, severity), n in counts.items():
+            gauge.set(n, job=job, rule=rule, severity=severity)
+
+    def render_text(self, now: Optional[float] = None) -> str:
+        """The ``/alerts`` monitoring route body."""
+        now = time.time() if now is None else now
+        alerts = self.active_alerts()
+        firing = sum(1 for a in alerts if a.state == "firing")
+        lines = [
+            f"alerts: {firing} firing, {len(alerts) - firing} pending "
+            f"(host {self.host})"
+        ]
+        rows = [("STATE", "AGE", "JOB", "RULE", "REPLICA", "SEV", "SUMMARY")]
+        for a in alerts:
+            rows.append(
+                (
+                    a.state,
+                    f"{max(now - a.since, 0.0):.0f}s",
+                    a.job,
+                    a.rule,
+                    a.replica,
+                    a.severity,
+                    a.summary,
+                )
+            )
+        widths = [max(len(r[i]) for r in rows) for i in range(6)]
+        for r in rows:
+            lead = "  ".join(c.ljust(w) for c, w in zip(r[:6], widths))
+            lines.append(f"{lead}  {r[6]}".rstrip())
+        if not alerts:
+            lines.append("(no active alerts)")
+        return "\n".join(lines)
+
+
+def _per_job_rule(rule: str) -> bool:
+    return rule != "noisy_neighbor"
+
+
+def _cross_job_rule(rule: str) -> bool:
+    return rule == "noisy_neighbor"
+
+
+# ---- CLI-side (daemon-less) rendering from the on-disk logs ----
+
+
+def format_alert_record(rec: dict, now: Optional[float] = None) -> str:
+    """One transition record as a human line (`tpujob alerts [-f]`)."""
+    who = rec.get("replica") or "*"
+    return (
+        f"[{rec.get('state', '?')}] {rec.get('severity', '?')} "
+        f"{rec.get('rule', '?')} {rec.get('job', '?')}/{who}: "
+        f"{rec.get('summary', '')}"
+    )
+
+
+def gather_alert_rows(
+    state_dir, key: Optional[str] = None, now: Optional[float] = None
+) -> List[dict]:
+    """Current alert state per (job, rule, replica) folded from the
+    on-disk logs — works with or without a daemon, like `tpujob top`."""
+    keys = [key] if key is not None else list_alert_jobs(state_dir)
+    rows: List[dict] = []
+    for k in keys:
+        rows.extend(fold_alert_log(load_alert_log(state_dir, k)))
+    rows.sort(
+        key=lambda r: (
+            r.get("state") != "firing",
+            SEVERITY_ORDER.get(r.get("severity", ""), 9),
+            r.get("job", ""),
+            r.get("rule", ""),
+        )
+    )
+    return rows
+
+
+def render_alert_table(rows: List[dict], now: Optional[float] = None) -> str:
+    """The `tpujob alerts` table (current state per job/rule/replica)."""
+    now = time.time() if now is None else now
+    table = [("AGE", "STATE", "JOB", "RULE", "REPLICA", "SEV", "SUMMARY")]
+    for r in rows:
+        age = max(now - float(r.get("ts", now)), 0.0)
+        table.append(
+            (
+                f"{age:.0f}s",
+                str(r.get("state", "?")),
+                str(r.get("job", "?")),
+                str(r.get("rule", "?")),
+                str(r.get("replica") or "*"),
+                str(r.get("severity", "?")),
+                str(r.get("summary", "")),
+            )
+        )
+    if len(table) == 1:
+        return "no alerts"
+    widths = [max(len(r[i]) for r in table) for i in range(6)]
+    return "\n".join(
+        "  ".join(c.ljust(w) for c, w in zip(r[:6], widths)) + f"  {r[6]}"
+        for r in table
+    ).rstrip()

@@ -107,6 +107,17 @@ def build_cluster_env(
                 env["TPUJOB_TRACE_FLUSH_EVERY"] = str(ob.trace_flush_every)
     else:
         env["TPUJOB_TRACE_DIR"] = ""
+    # Live health-engine policy (spec.observability.alerts): evaluated
+    # by the SUPERVISOR, but threaded into replicas like the trace
+    # knobs so replica-side tooling (an in-container `tpujob why`, a
+    # sidecar evaluating the same rules) resolves the identical bar.
+    ob = job.spec.observability
+    if ob is not None and ob.alerts is not None:
+        import json as _json
+
+        env["TPUJOB_ALERTS"] = _json.dumps(
+            ob.alerts.to_dict(), sort_keys=True
+        )
     # Data-plane policy (spec.data_plane): workloads read these as the
     # defaults for --async-checkpoint / --prefetch, so host-I/O overlap
     # is a SPEC property, not per-workload args plumbing.

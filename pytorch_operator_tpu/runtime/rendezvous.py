@@ -176,6 +176,33 @@ def progress_enabled() -> bool:
     return _status_path() is not None
 
 
+# The last supervisor clock-probe seq this process echoed (each probe
+# is answered exactly once — a re-echo would hand the estimator a
+# stale round trip whose [probe, observe] interval spans seconds).
+_probe_echoed_seq: Optional[int] = None
+
+
+def _maybe_echo_probe() -> None:
+    """Echo the supervisor's round-trip clock probe (obs/clock.py):
+    read ``clock_probe.json`` from the status dir and, for a probe not
+    yet answered, append a ``clock_probe`` record whose own ``ts`` is
+    this replica's send time — the (probe write, echo send, echo
+    observe) triple lets the offset estimator cancel the one-way delay
+    bias. Piggybacks on the heartbeat cadence: one stat+read per beat,
+    nothing without a supervisor."""
+    global _probe_echoed_seq
+    d = os.environ.get("TPUJOB_STATUS_DIR")
+    if not d:
+        return
+    from ..obs.clock import read_probe
+
+    probe = read_probe(d)
+    if probe is None or probe["seq"] == _probe_echoed_seq:
+        return
+    _probe_echoed_seq = probe["seq"]
+    report("clock_probe", probe_ts=probe["probe_ts"], seq=probe["seq"])
+
+
 def report_first_step(step: int = 0) -> None:
     report("first_step", step=step)
 
@@ -221,6 +248,9 @@ def report_progress(
     if feed_stall_ms is not None:
         fields["feed_stall_ms"] = round(float(feed_stall_ms), 3)
     report("progress", step=step, **fields)
+    # Round-trip clock probe: answered on the heartbeat cadence, AFTER
+    # the beat (the supervisor probes jobs it just saw beating).
+    _maybe_echo_probe()
 
 
 def report_checkpoint_committed(

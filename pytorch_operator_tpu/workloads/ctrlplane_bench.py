@@ -130,12 +130,14 @@ def bench_mode(
         # ---- steady-state idle passes (the headline) ----
         latencies_ms: List[float] = []
         io_per_pass: List[Dict[str, int]] = []
+        watch_before = sup.watch.io.snapshot()
         for _ in range(passes):
             before = sup.store.io.snapshot()
             t0 = time.perf_counter()
             daemon_pass()
             latencies_ms.append(1000 * (time.perf_counter() - t0))
             io_per_pass.append(_io_delta(sup.store, before))
+        watch_after = sup.watch.io.snapshot()
 
         # ---- finish churn: every master succeeds, jobs complete ----
         for h in sup.runner.list_all():
@@ -162,6 +164,16 @@ def bench_mode(
             "idle_writes_per_pass": round(idle_writes, 2),
             "idle_scans_per_pass": round(idle_scans, 2),
             "idle_serializations_per_pass": round(idle_serializations, 2),
+            # Live health engine (obs/watch.py): idle jobs never report,
+            # so the watch must neither append alert-log lines nor even
+            # evaluate rules across the idle passes — both pinned at
+            # zero by the bench_smoke lane.
+            "idle_watch_log_appends": (
+                watch_after["log_appends"] - watch_before["log_appends"]
+            ),
+            "idle_watch_evaluations": (
+                watch_after["evaluations"] - watch_before["evaluations"]
+            ),
             "submit_s": round(submit_s, 3),
             "launch_pass_s": round(launch_pass_s, 3),
             "finish_pass_s": round(finish_pass_s, 3),

@@ -118,6 +118,7 @@ def _run_steps(
     step_time: float,
     async_checkpoint: bool = False,
     commit_time: float = 0.0,
+    feed_stall_ms: float = 0.0,
 ) -> int:
     with obs.span("rendezvous_join", cat="rendezvous"):
         rendezvous.fault_stall_if_armed()  # the rendezvous-join stand-in
@@ -144,6 +145,7 @@ def _run_steps(
                 step,
                 steps_per_sec=1.0 / max(step_time, 1e-6),
                 step_time_ms=1000.0 * step_time,
+                feed_stall_ms=feed_stall_ms or None,
             )
             faults.crash_if_due(step)
             if root is not None:
@@ -178,6 +180,10 @@ def main() -> int:
     p.add_argument("--step-time", type=float, default=0.0)
     p.add_argument("--async-checkpoint", action="store_true")
     p.add_argument("--commit-time", type=float, default=0.0)
+    # Reported feed stall per heartbeat: makes the input-bound signature
+    # (obs rule feed_stall_dominance) drivable by a real subprocess
+    # world without a jax data pipeline.
+    p.add_argument("--feed-stall-ms", type=float, default=0.0)
     args = p.parse_args()
     if args.sleep:
         time.sleep(args.sleep)
@@ -187,6 +193,7 @@ def main() -> int:
             args.step_time,
             async_checkpoint=args.async_checkpoint,
             commit_time=args.commit_time,
+            feed_stall_ms=args.feed_stall_ms,
         )
         sys.stdout.flush()
         return rc

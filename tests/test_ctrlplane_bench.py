@@ -44,6 +44,17 @@ class TestBenchSmoke:
         # One scandir snapshot serves rescan + all marker scans.
         assert cached["idle_scans_per_pass"] <= 1.0
 
+    def test_watch_engine_is_free_on_idle_fleets(self, smoke_result):
+        # The live health engine (obs/watch.py) rides the same pass:
+        # jobs that never reported must cost it NOTHING — no alert-log
+        # appends, and not even a rule evaluation (untracked jobs skip
+        # the evaluator entirely). Both modes, since the watch runs
+        # regardless of the store flavor.
+        for mode in ("cached", "legacy"):
+            c = cell(smoke_result, mode)
+            assert c["idle_watch_log_appends"] == 0
+            assert c["idle_watch_evaluations"] == 0
+
     def test_legacy_mode_still_measures_the_old_profile(self, smoke_result):
         legacy = cell(smoke_result, "legacy")
         # The baseline must stay honest: N reads and N writes per idle

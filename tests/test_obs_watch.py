@@ -1,0 +1,1083 @@
+"""Live health engine (obs/watch.py + obs/rules.py) tests.
+
+- lifecycle units: pending→firing→resolved hysteresis (``for_s`` /
+  ``clear_s``), dedup by (job, rule, replica), one log append per
+  transition, re-detection = a new instance, finalize-on-finish;
+- every rule fires LIVE from a synthetic rolling window, and a healthy
+  window alerts nothing;
+- the cross-job noisy-neighbor correlation;
+- spec overrides: ``spec.observability.alerts`` thresholds suppress a
+  live alert AND an offline ``tpujob why`` finding (one bar, two
+  engines), validation rejects typo'd threshold names, the policy
+  threads into replica env;
+- offline-vs-live parity: the same recorded timeline produces the same
+  rule set from ``analyze()`` and from a watch replay;
+- subprocess e2e: drop_heartbeat fires a heartbeat_silence alert
+  BEFORE the TPUJobHung kill and the alert is cited (resolved) in the
+  subsequent ``tpujob why``; a bounded drop resolves after recovery; a
+  persistent-ENOSPC world fires checkpoint_lag; a feed-stalled world
+  fires feed_stall_dominance;
+- bench_smoke: a healthy world's watch evaluates rules with zero
+  alerts and ZERO log appends (the idle-I/O pin rides
+  test_ctrlplane_bench for the store side).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from pytorch_operator_tpu import faults
+from pytorch_operator_tpu.api import (
+    AlertPolicy,
+    ObjectMeta,
+    ObservabilityPolicy,
+    ProcessTemplate,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    TPUJob,
+    TPUJobSpec,
+    set_defaults,
+)
+from pytorch_operator_tpu.api.defaults import HANG_DEADLINE_ANNOTATION
+from pytorch_operator_tpu.controller.metrics import Gauge
+from pytorch_operator_tpu.controller.store import key_to_fs
+from pytorch_operator_tpu.controller.supervisor import Supervisor
+from pytorch_operator_tpu.faults import Fault, FaultPlan
+from pytorch_operator_tpu.obs import analyze as obs_analyze
+from pytorch_operator_tpu.obs import rules as obs_rules
+from pytorch_operator_tpu.obs import watch as obs_watch
+
+KEY = "default/w"
+
+
+def _beat(ts, step, step_time_ms=10.0, **extra):
+    return {
+        "ts": float(ts),
+        "step": float(step),
+        "steps_per_sec": 1000.0 / step_time_ms,
+        "step_time_ms": float(step_time_ms),
+        **extra,
+    }
+
+
+def _feed(eng, key, replica, beats, kind="progress"):
+    for b in beats:
+        eng.ingest_record(key, replica, kind, b)
+
+
+def _steady(eng, key, replica="master-0", n=12, t0=100.0, dt=0.1,
+            step_time_ms=10.0, **extra):
+    _feed(
+        eng, key, replica,
+        [_beat(t0 + i * dt, i + 1, step_time_ms, **extra) for i in range(n)],
+    )
+    return t0 + (n - 1) * dt
+
+
+def _policy_job(name="test-job", alerts=None, workers=0):
+    from tests.testutil import new_job
+
+    job = new_job(name=name, workers=workers)
+    if alerts is not None:
+        job.spec.observability = ObservabilityPolicy(alerts=alerts)
+    return job
+
+
+def _rules_of(alerts):
+    return sorted({a.rule for a in alerts})
+
+
+# ---- lifecycle ----
+
+
+class TestLifecycle:
+    def test_silence_fires_immediately_by_default(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, KEY)  # beats every 0.1s -> threshold 1.0s
+        assert eng.evaluate(KEY, now=t_end + 0.3) == []
+        alerts = eng.evaluate(KEY, now=t_end + 1.5)
+        assert [a.state for a in alerts] == ["firing"]
+        a = alerts[0]
+        assert a.rule == "heartbeat_silence"
+        assert a.replica == "master-0"
+        assert a.severity == "critical"
+        assert a.evidence  # cites the last beat
+        # The transition (and only the transition) hit the log.
+        assert eng.io.log_appends == 1
+        recs = obs_watch.load_alert_log(tmp_path, KEY)
+        assert len(recs) == 1 and recs[0]["state"] == "firing"
+
+    def test_steady_firing_appends_nothing(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, KEY)
+        eng.evaluate(KEY, now=t_end + 1.5)
+        for i in range(10):
+            eng.evaluate(KEY, now=t_end + 1.6 + 0.1 * i)
+        assert eng.io.log_appends == 1  # dedup: one instance, one record
+
+    def test_resolve_after_clear_duration(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, KEY)
+        eng.evaluate(KEY, now=t_end + 1.5)
+        # Recovery: beats resume (and KEEP coming — a one-off beat
+        # followed by nothing would be a fresh silence)...
+        _feed(eng, KEY, "master-0",
+              [_beat(t_end + 1.6 + 0.1 * i, 20 + i) for i in range(60)])
+        still = eng.evaluate(KEY, now=t_end + 1.75)
+        # ...but clear_s (default 5s) hysteresis keeps it firing first.
+        assert [a.state for a in still] == ["firing"]
+        assert eng.evaluate(KEY, now=t_end + 3.0) != []
+        assert eng.evaluate(KEY, now=t_end + 7.5) == []
+        recs = obs_watch.load_alert_log(tmp_path, KEY)
+        assert [r["state"] for r in recs] == ["firing", "resolved"]
+
+    def test_for_s_hysteresis_and_blip_drop(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        job = _policy_job(alerts=AlertPolicy(for_s=1.0))
+        key = "default/test-job"
+        t_end = _steady(eng, key)
+        # First detection: pending, not firing (must persist for_s).
+        alerts = eng.evaluate(key, job=job, now=t_end + 1.5)
+        assert [a.state for a in alerts] == ["pending"]
+        assert eng.io.log_appends == 0
+        alerts = eng.evaluate(key, job=job, now=t_end + 1.9)
+        assert [a.state for a in alerts] == ["pending"]
+        # A blip: the condition clears one pass -> pending is dropped.
+        _feed(eng, key, "master-0", [_beat(t_end + 2.0, 99)])
+        assert eng.evaluate(key, job=job, now=t_end + 2.1) == []
+        assert eng.io.log_appends == 0
+        # Persistent silence: pending ages past for_s -> firing.
+        eng.evaluate(key, job=job, now=t_end + 3.5)
+        alerts = eng.evaluate(key, job=job, now=t_end + 4.6)
+        assert [a.state for a in alerts] == ["firing"]
+        assert eng.io.log_appends == 1
+
+    def test_dedup_is_per_replica(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        _steady(eng, KEY, replica="worker-0")
+        t_end = _steady(eng, KEY, replica="worker-1")
+        alerts = eng.evaluate(KEY, now=t_end + 2.0)
+        assert len(alerts) == 2
+        assert {a.replica for a in alerts} == {"worker-0", "worker-1"}
+        assert eng.io.log_appends == 2
+
+    def test_redetection_is_a_new_instance(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        job = _policy_job(alerts=AlertPolicy(clear_s=0.5))
+        key = "default/test-job"
+        t_end = _steady(eng, key)
+        eng.evaluate(key, job=job, now=t_end + 1.5)  # firing #1
+        _feed(eng, key, "master-0", [_beat(t_end + 1.6, 99)])
+        eng.evaluate(key, job=job, now=t_end + 1.7)
+        eng.evaluate(key, job=job, now=t_end + 2.5)  # resolved #1
+        alerts = eng.evaluate(key, job=job, now=t_end + 4.0)  # firing #2
+        assert [a.state for a in alerts] == ["firing"]
+        recs = obs_watch.load_alert_log(tmp_path, key)
+        assert [r["state"] for r in recs] == ["firing", "resolved", "firing"]
+
+    def test_finalize_resolves_firing(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, KEY)
+        eng.evaluate(KEY, now=t_end + 1.5)
+        eng.finalize(KEY, now=t_end + 2.0)
+        eng.finalize(KEY, now=t_end + 2.1)  # idempotent
+        recs = obs_watch.load_alert_log(tmp_path, KEY)
+        assert [r["state"] for r in recs] == ["firing", "resolved"]
+        assert "(job finished)" in recs[-1]["summary"]
+        assert eng.active_alerts(KEY) == []
+
+    def test_export_gauge_counts_firing_only(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, KEY)
+        g = Gauge("tpujob_alerts")
+        eng.evaluate(KEY, now=t_end + 0.2)  # healthy: nothing
+        eng.export_gauge(g)
+        assert g.series_count() == 0
+        eng.evaluate(KEY, now=t_end + 1.5)
+        eng.export_gauge(g)
+        assert g.get(
+            job=KEY, rule="heartbeat_silence", severity="critical"
+        ) == 1
+        eng.finalize(KEY, now=t_end + 2.0)
+        eng.export_gauge(g)
+        assert g.series_count() == 0
+
+    def test_disabled_policy_resolves_and_stops(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, KEY)
+        eng.evaluate(KEY, now=t_end + 1.5)
+        job = _policy_job(alerts=AlertPolicy(enabled=False))
+        assert eng.evaluate(KEY, job=job, now=t_end + 1.6) == []
+        recs = obs_watch.load_alert_log(tmp_path, KEY)
+        assert [r["state"] for r in recs] == ["firing", "resolved"]
+
+    def test_retire_drops_state_without_logging(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, KEY)
+        eng.evaluate(KEY, now=t_end + 1.5)
+        before = eng.io.log_appends
+        eng.retire_job(KEY)
+        assert eng.io.log_appends == before
+        assert not eng.tracked(KEY)
+
+
+# ---- every rule, live ----
+
+
+class TestLiveRules:
+    def test_healthy_window_is_clean(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, KEY, n=30)
+        _feed(
+            eng, KEY, "master-0",
+            [{"ts": 100.0 + i, "step": float(5 * (i + 1)), "commit_ms": 4.0}
+             for i in range(5)],
+            kind="checkpoint_committed",
+        )
+        # Evaluated right at the newest beat: every rule ran, none hit.
+        assert eng.evaluate(KEY, now=t_end + 0.1) == []
+        assert eng.io.evaluations == 1
+        assert eng.io.log_appends == 0
+
+    def test_step_time_regression_fires(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        _steady(eng, KEY, n=24, step_time_ms=10.0)
+        _feed(
+            eng, KEY, "master-0",
+            [_beat(102.4 + 0.1 * i, 30 + i, 40.0) for i in range(8)],
+        )
+        alerts = eng.evaluate(KEY, now=103.2)
+        assert _rules_of(alerts) == ["step_time_regression"]
+        assert alerts[0].metrics["factor"] > 2.0
+
+    def test_feed_stall_dominance_fires(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, KEY, n=10, feed_stall_ms=8.0)
+        alerts = eng.evaluate(KEY, now=t_end)
+        assert _rules_of(alerts) == ["feed_stall_dominance"]
+
+    def test_checkpoint_lag_fires(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, KEY, n=20, dt=0.1)  # steps 1..20
+        _feed(
+            eng, KEY, "master-0",
+            [{"ts": 100.0 + i * 0.2, "step": float(2 * (i + 1)),
+              "commit_ms": 4.0} for i in range(3)],  # commits 2, 4, 6
+            kind="checkpoint_committed",
+        )
+        alerts = eng.evaluate(KEY, now=t_end)
+        assert _rules_of(alerts) == ["checkpoint_lag"]
+        assert alerts[0].metrics["lag_steps"] == 14
+
+    def test_straggler_fires(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        _steady(eng, KEY, replica="worker-0", n=8, step_time_ms=10.0)
+        _steady(eng, KEY, replica="worker-1", n=8, step_time_ms=10.0)
+        t_end = _steady(eng, KEY, replica="worker-2", n=8, step_time_ms=30.0)
+        alerts = eng.evaluate(KEY, now=t_end)
+        assert _rules_of(alerts) == ["straggler"]
+        assert alerts[0].replica == "worker-2"
+
+
+# ---- noisy neighbor ----
+
+
+class TestNoisyNeighbor:
+    def _regress(self, eng, key):
+        _steady(eng, key, n=24, step_time_ms=10.0)
+        _feed(
+            eng, key, "master-0",
+            [_beat(102.4 + 0.1 * i, 30 + i, 40.0) for i in range(8)],
+        )
+        eng.evaluate(key, now=103.2)
+
+    def test_two_jobs_regressing_attribute_to_host(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path, host="tpu-host-7")
+        self._regress(eng, "default/a")
+        self._regress(eng, "default/b")
+        eng.correlate(now=103.2)
+        for key in ("default/a", "default/b"):
+            rules = _rules_of(eng.active_alerts(key))
+            assert rules == ["noisy_neighbor", "step_time_regression"]
+            nn = next(
+                a for a in eng.active_alerts(key) if a.rule == "noisy_neighbor"
+            )
+            assert "tpu-host-7" in nn.summary
+            other = "default/b" if key == "default/a" else "default/a"
+            assert other in nn.summary
+            assert any(ev.get("job") == other for ev in nn.evidence)
+
+    def test_single_regression_stays_unattributed(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        self._regress(eng, "default/a")
+        eng.correlate(now=103.2)
+        assert _rules_of(eng.active_alerts("default/a")) == [
+            "step_time_regression"
+        ]
+
+    def test_neighbor_alert_resolves_when_partner_recovers(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        self._regress(eng, "default/a")
+        self._regress(eng, "default/b")
+        eng.correlate(now=103.2)
+        # b recovers (its regression drops out of the pass verdicts).
+        _feed(
+            eng, "default/b", "master-0",
+            [_beat(103.3 + 0.1 * i, 60 + i, 10.0) for i in range(30)],
+        )
+        eng.evaluate("default/b", now=106.3)
+        eng.correlate(now=106.3)
+        eng.correlate(now=112.0)  # past clear_s
+        assert "noisy_neighbor" not in _rules_of(
+            eng.active_alerts("default/a")
+        )
+
+
+# ---- spec overrides: one bar for live and offline ----
+
+
+def _write_status(state, key, replica, recs) -> None:
+    d = state / "status" / key_to_fs(key)
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / f"{replica}.jsonl", "a") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _status_beats(t0, n, interval, step0=1, step_time_ms=10.0, **extra):
+    return [
+        {
+            "event": "progress",
+            "ts": t0 + i * interval,
+            "step": step0 + i,
+            "steps_per_sec": 1000.0 / step_time_ms,
+            "step_time_ms": step_time_ms,
+            **extra,
+        }
+        for i in range(n)
+    ]
+
+
+class TestSpecOverrides:
+    REGRESSING = (
+        _status_beats(100.0, 24, 0.1, step_time_ms=10.0)
+        + _status_beats(102.4, 8, 0.1, step0=25, step_time_ms=40.0)
+    )
+
+    def test_threshold_override_suppresses_live_alert(self, tmp_path):
+        eng = obs_watch.WatchEngine(tmp_path)
+        loose = _policy_job(
+            alerts=AlertPolicy(thresholds={"regression_factor": 10.0})
+        )
+        key = "default/test-job"
+        for b in self.REGRESSING:
+            eng.ingest_record(key, "master-0", "progress", b)
+        assert eng.evaluate(key, job=loose, now=103.2) == []
+        # The identical window under defaults DOES alert.
+        assert _rules_of(eng.evaluate(key, now=103.2)) == [
+            "step_time_regression"
+        ]
+
+    def test_why_respects_stored_override(self, tmp_path):
+        from pytorch_operator_tpu.controller.store import JobStore
+
+        state = tmp_path / "state"
+        key = "default/test-job"
+        _write_status(state, key, "master-0", self.REGRESSING)
+        # Default bar: the offline engine flags the regression.
+        report = obs_analyze.analyze(state, key)
+        assert "step_time_regression" in [
+            f["rule"] for f in report["findings"]
+        ]
+        # Store the job WITH a loosened bar: same artifacts, no finding.
+        job = _policy_job(
+            alerts=AlertPolicy(thresholds={"regression_factor": 10.0})
+        )
+        JobStore(persist_dir=state / "jobs").add(job)
+        report = obs_analyze.analyze(state, key)
+        assert "step_time_regression" not in [
+            f["rule"] for f in report["findings"]
+        ]
+
+    def test_validation_rejects_typos_and_negatives(self):
+        from pytorch_operator_tpu.api.validation import validate
+
+        job = _policy_job(
+            alerts=AlertPolicy(thresholds={"regresion_factor": 2.0})
+        )
+        with pytest.raises(Exception) as ei:
+            validate(job)
+        assert "unknown rule threshold" in str(ei.value)
+        job = _policy_job(alerts=AlertPolicy(for_s=-1.0))
+        with pytest.raises(Exception) as ei:
+            validate(job)
+        assert "for_s" in str(ei.value)
+        # A correctly-spelled override validates.
+        validate(_policy_job(
+            alerts=AlertPolicy(thresholds={"silence_min_s": 5.0})
+        ))
+
+    def test_policy_roundtrips_and_threads_into_env(self):
+        from pytorch_operator_tpu.api.serialization import job_from_dict
+        from pytorch_operator_tpu.runtime.env import build_cluster_env
+
+        job = _policy_job(
+            alerts=AlertPolicy(
+                for_s=2.0, clear_s=10.0,
+                thresholds={"silence_min_s": 5.0},
+            )
+        )
+        back = job_from_dict(job.to_dict())
+        al = back.spec.observability.alerts
+        assert al.for_s == 2.0 and al.clear_s == 10.0
+        assert al.thresholds == {"silence_min_s": 5.0}
+        env = build_cluster_env(back, ReplicaType.MASTER, 0)
+        threaded = json.loads(env["TPUJOB_ALERTS"])
+        assert threaded["for_s"] == 2.0
+        assert threaded["thresholds"]["silence_min_s"] == 5.0
+        # No block -> no env key (replicas see only what the spec set).
+        assert "TPUJOB_ALERTS" not in build_cluster_env(
+            _policy_job(), ReplicaType.MASTER, 0
+        )
+
+    def test_thresholds_from_overrides_ignores_unknown(self):
+        th = obs_rules.thresholds_from_overrides(
+            {"regression_factor": 3.0, "bogus": 1.0,
+             "straggler_min_samples": 6.0}
+        )
+        assert th.regression_factor == 3.0
+        assert th.straggler_min_samples == 6  # int field coerced
+        assert th.silence_min_s == obs_rules.DEFAULT_THRESHOLDS.silence_min_s
+
+
+# ---- offline-vs-live parity: same timeline -> same findings ----
+
+
+class TestParity:
+    def _scenarios(self):
+        return {
+            "step_time_regression": {
+                "master-0": (
+                    _status_beats(100.0, 24, 0.1, step_time_ms=10.0)
+                    + _status_beats(102.4, 8, 0.1, step0=25, step_time_ms=40.0)
+                ),
+            },
+            "feed_stall_dominance": {
+                "master-0": _status_beats(
+                    100.0, 10, 0.1, step_time_ms=10.0, feed_stall_ms=8.0
+                ),
+            },
+            "straggler": {
+                "worker-0": _status_beats(100.0, 8, 0.1, step_time_ms=10.0),
+                "worker-1": _status_beats(100.0, 8, 0.1, step_time_ms=10.0),
+                "worker-2": _status_beats(100.0, 8, 0.1, step_time_ms=30.0),
+            },
+            "heartbeat_silence": {
+                "worker-0": _status_beats(100.0, 5, 0.5),
+                "worker-1": _status_beats(100.0, 21, 0.5),
+            },
+            "healthy": {
+                "master-0": _status_beats(100.0, 30, 0.1, step_time_ms=10.0),
+            },
+        }
+
+    @pytest.mark.parametrize(
+        "scenario",
+        ["step_time_regression", "feed_stall_dominance", "straggler",
+         "heartbeat_silence", "healthy"],
+    )
+    def test_same_timeline_same_findings(self, tmp_path, scenario):
+        recs_by_replica = self._scenarios()[scenario]
+        state = tmp_path / "state"
+        key = f"default/{scenario.replace('_', '-')}"
+        t_end = 0.0
+        for replica, recs in recs_by_replica.items():
+            _write_status(state, key, replica, recs)
+            t_end = max(t_end, recs[-1]["ts"])
+
+        # Offline: the postmortem engine over the recorded artifacts.
+        offline = {
+            f["rule"] for f in obs_analyze.analyze(state, key)["findings"]
+        }
+
+        # Live: replay the identical records through the watch and
+        # evaluate at the recording's end (the live silence reference —
+        # the supervisor clock — coincides with the newest beat there).
+        eng = obs_watch.WatchEngine(tmp_path / "watch-state")
+        for replica, recs in recs_by_replica.items():
+            for r in recs:
+                eng.ingest_record(key, replica, "progress", r)
+        live = {a.rule for a in eng.evaluate(key, now=t_end)}
+
+        assert offline == live
+        if scenario == "healthy":
+            assert offline == set()
+        else:
+            assert scenario in offline
+
+    def test_checkpoint_lag_parity(self, tmp_path):
+        state = tmp_path / "state"
+        key = "default/lag"
+        beats = _status_beats(100.0, 20, 0.1)
+        commits = [
+            {"event": "checkpoint_committed", "ts": 100.05 + i * 0.2,
+             "step": 2 * (i + 1), "commit_ms": 4.0}
+            for i in range(3)
+        ]
+        _write_status(state, key, "master-0", beats + commits)
+        offline = {
+            f["rule"] for f in obs_analyze.analyze(state, key)["findings"]
+        }
+        eng = obs_watch.WatchEngine(tmp_path / "watch-state")
+        for r in beats:
+            eng.ingest_record(key, "master-0", "progress", r)
+        for r in commits:
+            eng.ingest_record(key, "master-0", "checkpoint_committed", r)
+        live = {a.rule for a in eng.evaluate(key, now=beats[-1]["ts"])}
+        assert offline == live == {"checkpoint_lag"}
+
+
+# ---- surfaces: log fold, CLI table, top column, diff ----
+
+
+class TestSurfaces:
+    def _seed_log(self, tmp_path, key=KEY):
+        eng = obs_watch.WatchEngine(tmp_path)
+        t_end = _steady(eng, key)
+        eng.evaluate(key, now=t_end + 1.5)
+        return eng, t_end
+
+    def test_fold_keeps_latest_state_per_key(self, tmp_path):
+        eng, t_end = self._seed_log(tmp_path)
+        _feed(eng, KEY, "master-0",
+              [_beat(t_end + 1.6 + 0.1 * i, 20 + i) for i in range(70)])
+        eng.evaluate(KEY, now=t_end + 1.75)
+        eng.evaluate(KEY, now=t_end + 8.0)  # resolved
+        folded = obs_watch.fold_alert_log(
+            obs_watch.load_alert_log(tmp_path, KEY)
+        )
+        assert len(folded) == 1
+        assert folded[0]["state"] == "resolved"
+
+    def test_alert_table_and_render_text(self, tmp_path):
+        eng, _ = self._seed_log(tmp_path)
+        rows = obs_watch.gather_alert_rows(tmp_path)
+        assert rows and rows[0]["rule"] == "heartbeat_silence"
+        table = obs_watch.render_alert_table(rows)
+        assert "heartbeat_silence" in table and "firing" in table
+        live = eng.render_text()
+        assert "1 firing" in live and KEY in live
+        assert obs_watch.render_alert_table([]) == "no alerts"
+
+    def test_top_rows_show_firing_alerts(self, tmp_path):
+        from pytorch_operator_tpu.controller.store import JobStore
+        from pytorch_operator_tpu.obs import top as obs_top
+
+        state = tmp_path / "state"
+        job = _policy_job()
+        key = "default/test-job"
+        JobStore(persist_dir=state / "jobs").add(job)
+        _write_status(state, key, "master-0", _status_beats(100.0, 3, 0.1))
+        eng = obs_watch.WatchEngine(state)
+        t_end = _steady(eng, key)
+        eng.evaluate(key, now=t_end + 1.5)
+        rows = obs_top.gather_rows(state)
+        row = next(r for r in rows if r["job"] == key)
+        assert row["alerts"] == 1
+        assert row["alert_rules"] == ["heartbeat_silence"]
+        plain = obs_top.render_table(rows)
+        assert "1:heartbeat_silence" in plain
+        assert "\x1b[31m" not in plain
+        colored = obs_top.render_table(rows, color=True)
+        assert "\x1b[31m" in colored
+
+    def test_diff_rows_semantics(self):
+        from pytorch_operator_tpu.obs.top import diff_rows
+
+        base = {
+            "job": "default/a", "step": 10, "steps_per_sec": 5.0,
+            "p50_ms": 10.0, "p99_ms": 12.0, "ckpt_lag": 1,
+            "feed_stall_ms": 0.1, "age_s": 1.0, "alerts": None,
+            "alert_rules": [], "restarts": 0, "p99_span": None,
+        }
+        cur = dict(base)
+        cur["steps_per_sec"] = 2.0
+        cur["alerts"] = 1
+        cur["alert_rules"] = ["heartbeat_silence"]
+        cur["age_s"] = 9.0
+        lines = diff_rows([base], [cur])
+        assert len(lines) == 1
+        assert "steps/s 5.00→2.00 ▼" in lines[0]
+        assert "ALERT firing: heartbeat_silence" in lines[0]
+        assert "going silent" in lines[0]
+        # Unchanged -> no output; appear/gone -> named.
+        assert diff_rows([base], [dict(base)]) == []
+        assert diff_rows([], [base]) == ["default/a: appeared (step 10)"]
+        assert diff_rows([base], []) == [
+            "default/a: gone (finished or deleted)"
+        ]
+        recovered = dict(base)
+        lines = diff_rows([cur], [recovered])
+        assert any("alert resolved: heartbeat_silence" in ln for ln in lines)
+
+    def test_purge_reclaims_alert_log(self, tmp_path):
+        from pytorch_operator_tpu.controller.store import purge_job_artifacts
+
+        self._seed_log(tmp_path)
+        assert obs_watch.job_alert_log(tmp_path, KEY).exists()
+        purge_job_artifacts(tmp_path, KEY)
+        assert not obs_watch.job_alert_log(tmp_path, KEY).exists()
+
+
+# ---- round-trip clock probe ----
+
+
+class TestRoundTripProbe:
+    def test_estimator_prefers_roundtrip_midpoints(self):
+        from pytorch_operator_tpu.obs.clock import estimate_offset
+
+        # Replica clock 3s behind; one-way delay a biased 0.4s.
+        one_way = [(100.0 + i, 100.0 + i + 3.0 + 0.4) for i in range(10)]
+        est = estimate_offset(one_way)
+        assert est.rt_n == 0
+        assert est.offset_s > 3.2  # the one-way bias, visible
+        # Round trips bracket the echo: probe at send+3-0.1 (supervisor
+        # clock), observe at send+3+0.1 -> midpoint exactly offset.
+        rt = [
+            (100.0 + i, 100.0 + i + 3.0 + 0.1, 100.0 + i + 3.0 - 0.1)
+            for i in range(5)
+        ]
+        est = estimate_offset(one_way + rt)
+        assert est.rt_n == 5
+        assert est.offset_s == pytest.approx(3.0, abs=0.02)
+        assert est.to_dict()["rt_n"] == 5
+
+    def test_clock_log_roundtrip_records(self, tmp_path):
+        from pytorch_operator_tpu.obs.clock import (
+            ClockLog, job_clock_log, load_observations,
+        )
+
+        log = ClockLog(job_clock_log(tmp_path, KEY))
+        log.observe("master-0", 100.0, 100.5)
+        log.observe("master-0", 101.0, 101.5, probe_ts=100.9)
+        obs = load_observations(job_clock_log(tmp_path, KEY))["master-0"]
+        assert (100.0, 100.5) in obs
+        assert (101.0, 101.5, 100.9) in obs
+
+    def test_probe_write_and_replica_echo(self, tmp_path, monkeypatch):
+        from pytorch_operator_tpu.obs.clock import read_probe, write_probe
+        from pytorch_operator_tpu.runtime import rendezvous
+
+        status = tmp_path / "status"
+        status.mkdir()
+        assert read_probe(status) is None
+        write_probe(status, 123.456)
+        probe = read_probe(status)
+        assert probe["probe_ts"] == 123.456
+        # The replica echoes it once per seq on the heartbeat cadence.
+        monkeypatch.setenv("TPUJOB_STATUS_DIR", str(status))
+        monkeypatch.setenv("TPUJOB_REPLICA_TYPE", "Master")
+        monkeypatch.setenv("TPUJOB_REPLICA_INDEX", "0")
+        monkeypatch.setattr(rendezvous, "_probe_echoed_seq", None)
+        rendezvous.report_progress(1, steps_per_sec=10.0)
+        rendezvous.report_progress(2, steps_per_sec=10.0)
+        lines = (status / "master-0.jsonl").read_text().splitlines()
+        echoes = [
+            json.loads(ln) for ln in lines
+            if json.loads(ln)["event"] == "clock_probe"
+        ]
+        assert len(echoes) == 1  # one echo per probe seq, not per beat
+        assert echoes[0]["probe_ts"] == 123.456
+        # A NEW probe gets a new echo.
+        write_probe(status, 200.0)
+        rendezvous.report_progress(3, steps_per_sec=10.0)
+        lines = (status / "master-0.jsonl").read_text().splitlines()
+        echoes = [
+            json.loads(ln) for ln in lines
+            if json.loads(ln)["event"] == "clock_probe"
+        ]
+        assert len(echoes) == 2
+
+    def test_supervisor_folds_echo_into_roundtrip_log(self, tmp_path):
+        from pytorch_operator_tpu.controller import FakeRunner
+        from pytorch_operator_tpu.obs.clock import (
+            job_clock_log, load_observations, read_probe,
+        )
+
+        sup = Supervisor(state_dir=tmp_path / "state", runner=FakeRunner())
+        try:
+            d = tmp_path / "state" / "status" / key_to_fs(KEY)
+            d.mkdir(parents=True, exist_ok=True)
+
+            def write(rec):
+                with open(d / "master-0.jsonl", "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+
+            # A fresh beat makes the supervisor write its first probe.
+            write({"event": "progress", "ts": 100.0, "step": 1})
+            sup._progress.poll(d)
+            sup._record_clock_observations(KEY, d)
+            probe = read_probe(d)
+            assert probe is not None  # the beat triggered the probe
+
+            # The replica's echo of THAT seq is folded as a round trip
+            # (no priming: the seq proves it answers this daemon).
+            write({"event": "clock_probe", "ts": 101.0,
+                   "probe_ts": probe["probe_ts"], "seq": probe["seq"]})
+            sup._progress.poll(d)
+            sup._record_clock_observations(KEY, d)
+            got = load_observations(job_clock_log(tmp_path / "state", KEY))
+            assert len(got["master-0"]) == 1
+            send, _observed, echoed = got["master-0"][0]
+            assert (send, echoed) == (101.0, probe["probe_ts"])
+
+            # An echo of a seq this daemon never wrote (a pre-restart
+            # straggler) is rejected.
+            write({"event": "clock_probe", "ts": 102.0,
+                   "probe_ts": 50.0, "seq": 999})
+            sup._progress.poll(d)
+            sup._record_clock_observations(KEY, d)
+            got = load_observations(job_clock_log(tmp_path / "state", KEY))
+            assert len(got["master-0"]) == 1
+        finally:
+            sup.shutdown()
+
+
+# ---- chaos --record ----
+
+
+class TestChaosRecord:
+    def test_no_failure_recorded_is_an_error(self, tmp_path, capsys):
+        from pytorch_operator_tpu.client.cli import main
+
+        state = tmp_path / "state"
+        _write_status(state, "default/ok", "master-0",
+                      _status_beats(100.0, 5, 0.1))
+        assert main(
+            ["--state-dir", str(state), "chaos", "ok", "--record"]
+        ) == 1
+        assert "no replayable failure" in capsys.readouterr().err
+
+    def test_crash_exit_maps_to_crash_at_step(self, tmp_path):
+        from pytorch_operator_tpu.faults.record import plan_from_recording
+
+        state = tmp_path / "state"
+        key = "default/crash"
+        _write_status(state, key, "master-0",
+                      _status_beats(100.0, 7, 0.1))
+        ev_dir = state / "events"
+        ev_dir.mkdir(parents=True, exist_ok=True)
+        with open(ev_dir / (key_to_fs(key) + ".events.jsonl"), "a") as f:
+            f.write(json.dumps({
+                "timestamp": 101.0, "type": "Warning",
+                "reason": "TPUJobRestarting",
+                "message": "replica default_crash-master-0 failed with "
+                           "exit code 9 (restart #1).",
+                "count": 1,
+            }) + "\n")
+        plan = plan_from_recording(state, key)
+        crash = next(f for f in plan.faults if f.kind == "crash_at_step")
+        assert crash.target == "master-0"
+        assert crash.exit_code == 9
+        assert crash.at == 8  # last reported step 7 -> crash replays at 8
+        # The plan serializes/loads like any hand-written one.
+        assert FaultPlan.from_json(plan.to_json()).faults[0].kind == (
+            plan.faults[0].kind
+        )
+
+
+# ---- subprocess e2e ----
+
+
+def _exit_with_job(name, args, annotations=None, backoff=None, alerts=None):
+    job = TPUJob(
+        metadata=ObjectMeta(name=name, annotations=dict(annotations or {})),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.MASTER: ReplicaSpec(
+                    replicas=1,
+                    restart_policy=RestartPolicy.ON_FAILURE,
+                    template=ProcessTemplate(
+                        module="pytorch_operator_tpu.workloads.exit_with",
+                        args=[str(a) for a in args],
+                    ),
+                ),
+            },
+            run_policy=RunPolicy(backoff_limit=backoff),
+            observability=(
+                ObservabilityPolicy(alerts=alerts) if alerts else None
+            ),
+        ),
+    )
+    set_defaults(job)
+    return job
+
+
+def _run_watched(sup, key, timeout=45.0, on_pass=None):
+    """Daemon-style passes to completion; ``on_pass(job)`` sampled each
+    pass. Returns the final job object (one extra pass runs after the
+    finish so the watch finalizes)."""
+    deadline = time.time() + timeout
+    j = None
+    while time.time() < deadline:
+        sup.sync_once()
+        j = sup.store.get(key)
+        if on_pass is not None:
+            on_pass(j)
+        if j is None or j.is_finished():
+            sup.sync_once()  # the finalize pass
+            break
+        time.sleep(0.03)
+    return j
+
+
+@pytest.mark.chaos
+def test_drop_heartbeat_alert_fires_before_deadline_kill(tmp_path, capsys):
+    """THE acceptance e2e: under a drop_heartbeat world with a 2s
+    hang-deadline, the heartbeat_silence alert reaches ``firing`` —
+    visible in the live state, the tpujob_alerts gauge, and the on-disk
+    log — strictly BEFORE the TPUJobHung kill; afterward the same alert
+    appears resolved and cited in ``tpujob why``, and ``chaos
+    --record`` reconstructs the replayable drop_heartbeat plan."""
+    from pytorch_operator_tpu.client.cli import main
+
+    faults.disarm()
+    state = tmp_path / "state"
+    sup = Supervisor(state_dir=state, poll_interval=0.03)
+    key = "default/hang-e2e"
+    seen = {"firing_before_kill": False}
+    try:
+        faults.arm(FaultPlan(seed=1, faults=[
+            Fault(kind="drop_heartbeat", target="master-0",
+                  nth=3, times=100000),
+        ]))
+        job = _exit_with_job(
+            "hang-e2e", ["--steps", "400", "--step-time", "0.05"],
+            annotations={HANG_DEADLINE_ANNOTATION: "2"}, backoff=0,
+        )
+        sup.submit(job)
+
+        def on_pass(j):
+            if seen["firing_before_kill"]:
+                return
+            firing = [
+                a for a in sup.watch.active_alerts(key)
+                if a.state == "firing" and a.rule == "heartbeat_silence"
+            ]
+            if firing:
+                # The kill has NOT happened yet: the operator saw the
+                # alert first.
+                assert "TPUJobHung" not in [
+                    e.reason for e in sup.events.for_job(key)
+                ]
+                assert sup.metrics.alerts_firing.get(
+                    job=key, rule="heartbeat_silence", severity="critical"
+                ) == 1
+                assert firing[0].replica == "master-0"
+                seen["firing_before_kill"] = True
+
+        j = _run_watched(sup, key, on_pass=on_pass)
+        reasons = [e.reason for e in sup.events.for_job(key)]
+    finally:
+        faults.disarm()
+        sup.shutdown()
+    assert seen["firing_before_kill"], "alert never fired before the kill"
+    assert "TPUJobHung" in reasons
+    assert j is not None and j.is_failed()
+
+    # The on-disk log holds the full lifecycle: firing, then resolved
+    # (closed by the job's death, not left dangling).
+    recs = obs_watch.load_alert_log(state, key)
+    states = [r["state"] for r in recs
+              if r["rule"] == "heartbeat_silence"]
+    assert states == ["firing", "resolved"]
+
+    # `tpujob alerts` renders it (daemon-less, from the log)...
+    assert main(["--state-dir", str(state), "alerts"]) == 0
+    out = capsys.readouterr().out
+    assert "heartbeat_silence" in out
+    # ...and the JSON surface carries the transitions.
+    assert main(
+        ["--state-dir", str(state), "alerts", "hang-e2e", "--json"]
+    ) == 0
+    records = json.loads(capsys.readouterr().out)
+    assert [r["state"] for r in records] == ["firing", "resolved"]
+
+    # `tpujob why` cites the live alerts next to its own finding.
+    report = obs_analyze.analyze(state, key)
+    assert "heartbeat_silence" in [f["rule"] for f in report["findings"]]
+    assert [a["state"] for a in report["alerts"]] == ["firing", "resolved"]
+    rendered = obs_analyze.render_report(report)
+    assert "LIVE ALERTS" in rendered and "resolved" in rendered
+
+    # `tpujob chaos --record`: the watched incident becomes a plan.
+    plan_path = tmp_path / "incident.json"
+    assert main(
+        ["--state-dir", str(state), "chaos", "hang-e2e", "--record",
+         "--out", str(plan_path)]
+    ) == 0
+    plan = FaultPlan.load(plan_path)
+    drop = next(f for f in plan.faults if f.kind == "drop_heartbeat")
+    assert drop.target == "master-0"
+    assert drop.nth == 3  # 2 beats observed -> silence starts at the 3rd
+
+
+@pytest.mark.chaos
+def test_bounded_drop_resolves_after_recovery(tmp_path):
+    """A bounded heartbeat drop (the world recovers on its own): the
+    alert fires during the silence and resolves — while the job is
+    STILL RUNNING — once beats resume past clear_s."""
+    faults.disarm()
+    sup = Supervisor(state_dir=tmp_path / "state", poll_interval=0.03)
+    key = "default/recover-e2e"
+    seen = {"fired": False, "resolved_live": False}
+    try:
+        faults.arm(FaultPlan(seed=1, faults=[
+            Fault(kind="drop_heartbeat", target="master-0",
+                  nth=10, times=40),
+        ]))
+        job = _exit_with_job(
+            "recover-e2e", ["--steps", "150", "--step-time", "0.05"],
+            alerts=AlertPolicy(clear_s=0.5),
+        )
+        sup.submit(job)
+
+        def on_pass(j):
+            rules = {
+                a.rule: a.state for a in sup.watch.active_alerts(key)
+            }
+            if rules.get("heartbeat_silence") == "firing":
+                seen["fired"] = True
+            if (
+                seen["fired"]
+                and "heartbeat_silence" not in rules
+                and j is not None
+                and not j.is_finished()
+            ):
+                seen["resolved_live"] = True
+
+        j = _run_watched(sup, key, on_pass=on_pass)
+        # The pass-sampled flags: walk the log for the ground truth too.
+        recs = obs_watch.load_alert_log(tmp_path / "state", key)
+    finally:
+        faults.disarm()
+        sup.shutdown()
+    assert j is not None and j.is_succeeded()
+    assert seen["fired"], "the silence alert never fired during the drop"
+    states = [r["state"] for r in recs if r["rule"] == "heartbeat_silence"]
+    assert states[:2] == ["firing", "resolved"]
+    # Resolution came from RECOVERY, not from the job finishing.
+    resolved = next(r for r in recs if r["state"] == "resolved")
+    assert "(job finished)" not in resolved["summary"]
+
+
+@pytest.mark.chaos
+def test_enospc_world_fires_checkpoint_lag_live(tmp_path):
+    """Persistent disk-full after the 3rd save: commits stop, training
+    continues — the checkpoint_lag alert fires while the job runs."""
+    faults.disarm()
+    sup = Supervisor(state_dir=tmp_path / "state", poll_interval=0.03)
+    key = "default/enospc-e2e"
+    seen = {"lag_fired": False}
+    try:
+        faults.arm(FaultPlan(seed=1, faults=[
+            Fault(kind="enospc_checkpoint_write", target="master-0",
+                  nth=4, times=100000),
+        ]))
+        job = _exit_with_job(
+            "enospc-e2e",
+            ["--steps", "60", "--step-time", "0.05",
+             "--async-checkpoint"],
+        )
+        sup.submit(job)
+
+        def on_pass(j):
+            if any(
+                a.rule == "checkpoint_lag" and a.state == "firing"
+                for a in sup.watch.active_alerts(key)
+            ):
+                seen["lag_fired"] = True
+
+        j = _run_watched(sup, key, on_pass=on_pass)
+    finally:
+        faults.disarm()
+        sup.shutdown()
+    assert j is not None and j.is_succeeded()
+    assert seen["lag_fired"], "checkpoint_lag never fired live"
+    recs = obs_watch.load_alert_log(tmp_path / "state", key)
+    assert "checkpoint_lag" in [r["rule"] for r in recs]
+
+
+def test_feed_stalled_world_fires_feed_dominance_live(tmp_path):
+    """A world whose heartbeats report a dominant feed stall trips the
+    input-bound rule live (no fault plan needed — the workload flag IS
+    the stall)."""
+    sup = Supervisor(state_dir=tmp_path / "state", poll_interval=0.03)
+    key = "default/feed-e2e"
+    seen = {"fired": False}
+    try:
+        job = _exit_with_job(
+            "feed-e2e",
+            ["--steps", "40", "--step-time", "0.05",
+             "--feed-stall-ms", "40"],
+        )
+        sup.submit(job)
+
+        def on_pass(j):
+            if any(
+                a.rule == "feed_stall_dominance" and a.state == "firing"
+                for a in sup.watch.active_alerts(key)
+            ):
+                seen["fired"] = True
+
+        j = _run_watched(sup, key, on_pass=on_pass)
+    finally:
+        sup.shutdown()
+    assert j is not None and j.is_succeeded()
+    assert seen["fired"], "feed_stall_dominance never fired live"
+
+
+# ---- bench_smoke: healthy world = all rules, zero alerts, zero I/O ----
+
+
+@pytest.mark.bench_smoke
+def test_healthy_world_evaluates_clean_with_zero_added_io(tmp_path):
+    """Acceptance pin: a healthy real-subprocess run under the daemon
+    loop EVALUATES the rules (the engine ran) yet raises zero alerts,
+    appends zero alert-log lines, and creates no alerts dir at all —
+    the live health engine is free when nothing is wrong. (The
+    idle-fleet store-I/O pin rides test_ctrlplane_bench.)"""
+    sup = Supervisor(state_dir=tmp_path / "state", poll_interval=0.03)
+    key = "default/healthy-e2e"
+    try:
+        job = _exit_with_job(
+            "healthy-e2e", ["--steps", "12", "--step-time", "0.03"]
+        )
+        sup.submit(job)
+        j = _run_watched(sup, key)
+        evaluations = sup.watch.io.evaluations
+        appends = sup.watch.io.log_appends
+    finally:
+        sup.shutdown()
+    assert j is not None and j.is_succeeded()
+    assert evaluations > 0, "the watch never ran on a reporting job"
+    assert appends == 0
+    assert obs_watch.load_alert_log(tmp_path / "state", key) == []
+    assert not (tmp_path / "state" / "alerts").exists()
+    # And the round-trip probe rode along: the clock log holds at least
+    # one round-trip triple (probe file written, echoed, folded).
+    from pytorch_operator_tpu.obs.clock import (
+        job_clock_log, load_observations,
+    )
+
+    obs_pairs = load_observations(
+        job_clock_log(tmp_path / "state", key)
+    ).get("master-0", [])
+    assert any(len(p) == 3 for p in obs_pairs), (
+        "no round-trip clock sample recorded"
+    )
