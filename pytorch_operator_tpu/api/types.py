@@ -265,13 +265,19 @@ class SchedulingPolicy:
     ``min_available`` defaults to the total replica count — all-or-nothing.
     ``priority`` orders jobs competing for capacity (higher wins; volcano
     priorityClass analog); ``queue`` names a capacity pool enforced by the
-    supervisor's ``--queue-slots`` (volcano queue analog).
+    supervisor's ``--queue-slots`` (volcano queue analog). ``shard`` pins
+    the job to an explicit control-plane shard (modulo the state dir's
+    shard count) instead of the key hash — co-locates related jobs (a
+    wide gang and its feeders) on ONE reconciler under a sharded
+    multi-supervisor control plane; ignored when the control plane runs
+    unsharded.
     """
 
     gang: bool = True
     min_available: Optional[int] = None
     queue: Optional[str] = None
     priority: int = 0
+    shard: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"gang": self.gang}
@@ -281,6 +287,8 @@ class SchedulingPolicy:
             d["queue"] = self.queue
         if self.priority:
             d["priority"] = self.priority
+        if self.shard is not None:
+            d["shard"] = self.shard
         return d
 
     @classmethod
@@ -298,6 +306,7 @@ class SchedulingPolicy:
                 if d.get("priority") is not None
                 else 0
             ),
+            shard=_parse_opt_int(d, "shard", "scheduling_policy.shard"),
         )
 
 

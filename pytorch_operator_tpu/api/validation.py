@@ -116,6 +116,12 @@ def validate_spec(spec: TPUJobSpec) -> List[str]:
                 "spec.run_policy.scheduling_policy.min_available: "
                 f"({rp.scheduling_policy.min_available}) exceeds total replicas ({total})"
             )
+    if rp.scheduling_policy.shard is not None and rp.scheduling_policy.shard < 0:
+        errs.append(
+            "spec.run_policy.scheduling_policy.shard: must be >= 0 "
+            "(an explicit control-plane shard pin; taken modulo the "
+            "state dir's shard count)"
+        )
 
     if spec.elastic_policy is not None:
         errs.extend(_validate_elastic(spec.elastic_policy, spec))

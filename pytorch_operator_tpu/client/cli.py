@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -316,15 +317,34 @@ def cmd_supervisor(args) -> int:
 
     signal.signal(signal.SIGTERM, _sigterm)
     _arm_cli_tracing(args)
+    shards = getattr(args, "shards", None)
+    sync_workers_max = getattr(args, "sync_workers_max", None)
+    if sync_workers_max is None and os.environ.get("TPUJOB_SYNC_WORKERS_MAX"):
+        try:
+            sync_workers_max = int(os.environ["TPUJOB_SYNC_WORKERS_MAX"])
+        except ValueError:
+            pass
     sup = Supervisor(
         state_dir=_state_dir(args),
         gang_enabled=not args.no_gang,
         max_slots=args.max_slots,
-        leader_elect=not args.no_leader_elect,
+        # Sharding replaces leader election: N ACTIVE reconcilers, one
+        # per shard set, is the whole point.
+        leader_elect=not args.no_leader_elect and not shards,
         queue_slots=_parse_queue_slots(getattr(args, "queue_slots", None)),
         preempt=getattr(args, "preempt", False),
         standby=getattr(args, "standby", 0) or 0,
+        shards=shards,
+        supervisor_id=getattr(args, "supervisor_id", None),
+        lease_ttl=getattr(args, "lease_ttl", 5.0),
+        sync_workers_max=sync_workers_max,
     )
+    if shards:
+        print(
+            f"tpujob supervisor: sharded control plane — identity "
+            f"{sup.identity}, {shards} shards, lease ttl "
+            f"{getattr(args, 'lease_ttl', 5.0):g}s"
+        )
     # Monitoring comes up BEFORE the lease wait: a standby must answer
     # /healthz while blocked (it reports is_leader=false), or liveness
     # probes would kill the hot spare.
@@ -1175,11 +1195,16 @@ def cmd_resume(args) -> int:
 
 
 def cmd_metrics(args) -> int:
-    path = _state_dir(args) / "metrics.prom"
-    if not path.exists():
+    # Unsharded daemons write metrics.prom; sharded ones write one
+    # metrics-<identity>.prom each — print the union.
+    paths = sorted(_state_dir(args).glob("metrics*.prom"))
+    if not paths:
         print("no metrics recorded yet", file=sys.stderr)
         return 1
-    sys.stdout.write(path.read_text())
+    for path in paths:
+        if len(paths) > 1:
+            sys.stdout.write(f"# ---- {path.name} ----\n")
+        sys.stdout.write(path.read_text())
     return 0
 
 
@@ -1244,10 +1269,18 @@ def cmd_serve_request(args) -> int:
 
 def cmd_bench_control_plane(args) -> int:
     """Control-plane benchmark: supervisor pass latency + store I/O for N
-    synthetic jobs, cached vs legacy store (workloads/ctrlplane_bench)."""
+    synthetic jobs, cached vs legacy store plus multi-supervisor sharded
+    cells (workloads/ctrlplane_bench)."""
     from pytorch_operator_tpu.workloads import ctrlplane_bench
 
     argv = ["--jobs", args.jobs, "--passes", str(args.passes)]
+    for flag, value in (
+        ("--sharded-cells", args.sharded_cells),
+        ("--gang-cells", args.gang_cells),
+        ("--churn-cells", args.churn_cells),
+    ):
+        if value is not None:
+            argv += [flag, value]
     if args.out:
         argv += ["--out", args.out]
     return ctrlplane_bench.main(argv)
@@ -1387,6 +1420,39 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-leader-elect",
         action="store_true",
         help="skip the leader lease (single-daemon setups)",
+    )
+    sp.add_argument(
+        "--shards",
+        type=int,
+        default=None,
+        help="shard the job space N ways across multiple supervisors "
+        "sharing this state dir (per-shard store leases with fencing "
+        "tokens; every supervisor must pass the same N). Replaces "
+        "leader election: each daemon reconciles only the shards it "
+        "holds, and shards rebalance within one lease TTL on "
+        "join/death/drain",
+    )
+    sp.add_argument(
+        "--supervisor-id",
+        default=None,
+        help="identity for shard leases and per-supervisor metrics "
+        "(default: <hostname>-<pid>)",
+    )
+    sp.add_argument(
+        "--lease-ttl",
+        type=float,
+        default=5.0,
+        help="shard-lease TTL in seconds: the failover bound — an "
+        "orphaned shard is re-claimed within one TTL (default 5)",
+    )
+    sp.add_argument(
+        "--sync-workers-max",
+        type=int,
+        default=None,
+        help="ceiling for the latency-driven steady-pool autoscaler "
+        "(grows the reconcile pool when the measured steady-phase "
+        "latency climbs, shrinks to the floor on an idle fleet; "
+        "default min(8, ncpu); env TPUJOB_SYNC_WORKERS_MAX)",
     )
     sp.add_argument(
         "--standby",
@@ -1596,6 +1662,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument(
         "--passes", type=int, default=30, help="idle passes per cell"
+    )
+    sp.add_argument(
+        "--sharded-cells", default=None,
+        help="multi-supervisor cells as N:S (jobs:supervisors), e.g. "
+        "'10000:2,10000:4' (default: 10000:1,10000:2,10000:4; '' "
+        "disables)",
+    )
+    sp.add_argument(
+        "--gang-cells", default=None,
+        help="wide-gang cells as NxM:S, e.g. '500x16:2' ('' disables)",
+    )
+    sp.add_argument(
+        "--churn-cells", default=None,
+        help="marker-heavy churn cells as N:S, e.g. '2000:2' ('' "
+        "disables)",
     )
     sp.add_argument(
         "--out", default=None,

@@ -1,0 +1,85 @@
+"""Latency-driven autoscaling of the supervisor's steady-phase pool.
+
+The fixed ``min(8, ncpu)`` reconcile pool was sized for a thousand-job
+fleet; at pod scale it is either too small (steady phase grows with job
+count) or pure overhead (idle fleet keeps 8 threads warm for nothing).
+This controller resizes the pool against the MEASURED steady-phase
+latency — the ``tpujob_sync_pass_seconds{phase="steady"}`` histogram the
+flight recorder already exports — bounded by ``--sync-workers-max``.
+
+Control law (work-conserving estimate, deliberately boring):
+
+- each pass observes ``(steady_seconds, jobs_in_phase)``; the serialized
+  work estimate is ``steady_seconds × current_size``;
+- desired = ``ceil(work / target_s)`` clamped to ``[floor, ceiling]``
+  and to the phase's job count (more threads than jobs is waste);
+- GROW immediately to desired (latency pain is paid per pass — react in
+  one), SHRINK by at most half after ``shrink_patience`` consecutive
+  passes of lower demand (hysteresis: one quiet pass must not thrash
+  the pool an active fleet still needs).
+
+An idle fleet therefore converges to ``floor`` within
+``shrink_patience × log2(ceiling)`` passes, and the pool can NEVER
+exceed ``ceiling`` — both pinned by the bench_smoke tier-1 lane.
+"""
+
+from __future__ import annotations
+
+import math
+
+# Target steady-phase latency: half the default daemon poll interval —
+# the pass should never dominate the loop it runs in.
+DEFAULT_TARGET_S = 0.1
+DEFAULT_SHRINK_PATIENCE = 8
+
+
+class PoolAutoscaler:
+    """Pure decision logic (no threads, no clock) so the control law is
+    unit-testable; the supervisor applies ``size`` to its executor."""
+
+    def __init__(
+        self,
+        floor: int,
+        ceiling: int,
+        target_s: float = DEFAULT_TARGET_S,
+        shrink_patience: int = DEFAULT_SHRINK_PATIENCE,
+    ):
+        self.floor = max(1, int(floor))
+        self.ceiling = max(self.floor, int(ceiling))
+        self.target_s = target_s
+        self.shrink_patience = max(1, int(shrink_patience))
+        self.size = self.floor
+        self._below = 0
+
+    @property
+    def fixed(self) -> bool:
+        return self.floor == self.ceiling
+
+    def desired(self, steady_s: float, jobs_in_phase: int) -> int:
+        """The unclamped-by-hysteresis target for one observation."""
+        if steady_s <= 0.0 or jobs_in_phase <= 0:
+            return self.floor
+        work = steady_s * self.size
+        want = math.ceil(work / self.target_s)
+        want = min(want, max(jobs_in_phase, self.floor))
+        return max(self.floor, min(self.ceiling, want))
+
+    def observe(self, steady_s: float, jobs_in_phase: int) -> int:
+        """Feed one pass's measurement; returns the pool size to use for
+        the NEXT pass."""
+        if self.fixed:
+            return self.size
+        want = self.desired(steady_s, jobs_in_phase)
+        if want > self.size:
+            self.size = want
+            self._below = 0
+        elif want < self.size:
+            self._below += 1
+            if self._below >= self.shrink_patience:
+                # Halve toward the demand, never below it in one step —
+                # a transiently idle fleet keeps headroom on the way down.
+                self.size = max(want, (self.size + 1) // 2)
+                self._below = 0
+        else:
+            self._below = 0
+        return self.size

@@ -1,15 +1,37 @@
-"""Leader election for supervisors sharing one state dir.
+"""Leases for supervisors sharing one state dir.
 
-Reference: the operator runs ``leaderelection.RunOrDie`` so that replicated
-operator Deployments have exactly one active reconciler (SURVEY.md §2
-"Entrypoint/CLI", §3.1 startup stack). The failure mode it prevents maps
-1:1 here: two ``tpujob supervisor`` daemons pointed at the same state dir
-would both claim jobs and double-spawn replica worlds.
+Two regimes live here:
 
-Rebuild: an ``fcntl.flock`` lease on ``<state-dir>/leader.lock``. The OS
-releases the lock when the holder dies (crash included), which gives the
-standby automatic fail-over — the same property the k8s lease renewal loop
-provides, minus the clock-skew caveats, since this is a single-host lock.
+- :class:`LeaderLease` — exclusive leadership (reference:
+  ``leaderelection.RunOrDie``, SURVEY.md §2 "Entrypoint/CLI", §3.1): ONE
+  active reconciler per state dir, enforced by an ``fcntl.flock`` on
+  ``<state-dir>/leader.lock``. The OS releases the lock when the holder
+  dies, which gives the standby automatic fail-over. This is the default
+  single-supervisor path and is unchanged.
+
+- :class:`ShardLease` / :class:`ShardManager` — job-space sharding: N
+  ``tpujob supervisor`` daemons against one state dir, each holding
+  per-shard lease FILES (``<state-dir>/leases/shard-*.lease``) with
+  renew/expiry and monotonic FENCING TOKENS, so every job (hash of its
+  key → shard) has exactly one reconciler and shards rebalance within
+  one lease TTL when a supervisor joins, dies, or is drained. File-based
+  rather than flock-based on purpose: the lease must be observable and
+  stealable across hosts sharing the state dir, and the exactly-once
+  takeover arbitration reuses the claim-by-rename discipline the marker
+  machinery proved out (tests/test_store_cache.py::TestMarkerExactlyOnce).
+
+Lease state machine (one shard)::
+
+      (no file)──claim──▶ HELD(holder=A, token=t)
+          ▲                   │ renew (while now < expires): expires += ttl
+          │                   │ release: holder="", token kept   ──▶ RELEASED
+          │                   ▼ expiry (holder died / stopped renewing)
+      bootstrap           EXPIRED ──steal (claim file arbitrates)──▶
+                                    HELD(holder=B, token=t+1)
+
+    A's next renew after the steal reads token t+1 ≠ t and is REJECTED
+    (fencing): A drops the shard without ever writing, so a stale holder
+    can never double-reconcile a job the new owner already claimed.
 """
 
 from __future__ import annotations
@@ -17,11 +39,14 @@ from __future__ import annotations
 import errno
 import fcntl
 import json
+import math
 import os
 import socket
+import threading
 import time
+import zlib
 from pathlib import Path
-from typing import Optional
+from typing import Dict, List, Optional, Set
 
 
 def _pid_alive(pid: int) -> bool:
@@ -143,3 +168,585 @@ class LeaderLease:
 
     def __exit__(self, *exc) -> None:
         self.release()
+
+
+# ---- job-space sharding ----
+
+# Event-sink pseudo key the supervisor records shard hand-offs under:
+# one bounded global log (NOT one event per job — a 5000-job shard
+# hand-off must not write 5000 sink files), which `tpujob why` filters
+# by the job's shard to cite an ownership change.
+SHARD_EVENT_KEY = "_system/shards"
+
+
+def default_identity() -> str:
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
+def shard_of_key(key: str, num_shards: int, pin: Optional[int] = None) -> int:
+    """Job key → shard. Stable hash (crc32 — cheap, deterministic across
+    processes and runs, unlike ``hash()``); ``pin`` is the optional
+    ``scheduling_policy.shard`` override that co-locates related jobs
+    (a wide gang's feeders) on one reconciler."""
+    if pin is not None:
+        return pin % num_shards
+    return zlib.crc32(key.encode()) % num_shards
+
+
+class ShardLeaseLost(Exception):
+    """Raised by no one by default — exported for callers that want to
+    treat a mid-pass fencing rejection as exceptional."""
+
+
+class ShardLease:
+    """One shard's lease file: ``{holder, token, expires}`` JSON.
+
+    The fencing ``token`` increments on every OWNERSHIP change (claim of
+    a free/expired/released lease), never on renewal — a holder whose
+    recorded token no longer matches the file has been superseded and
+    must treat every pending write as rejected.
+
+    Takeover arbitration: a ``.claim`` file created with ``O_EXCL``
+    decides WHO may rewrite an expired/free lease (two simultaneous
+    joiners race the create; exactly one wins — the same exactly-once
+    property the marker rename-claim provides). Stale claims (a claimant
+    crashed mid-takeover) are swept after ``ttl``.
+    """
+
+    def __init__(
+        self, leases_dir: Path, shard_id: int, identity: str, ttl: float = 5.0
+    ):
+        self.dir = Path(leases_dir)
+        self.shard_id = shard_id
+        self.identity = identity
+        self.ttl = ttl
+        self.path = self.dir / f"shard-{shard_id:05d}.lease"
+        # In-memory view while held; token 0 = not held.
+        self.token = 0
+        self.expires = 0.0
+        # Whose EXPIRED lease the last successful acquire stole (None
+        # for a free/released claim) — feeds the hand-off event so a
+        # postmortem (and `tpujob chaos --record`) can name the dead
+        # supervisor.
+        self.takeover_from: Optional[str] = None
+
+    # -- on-disk record --
+
+    def read(self) -> Optional[dict]:
+        try:
+            rec = json.loads(self.path.read_text())
+            return rec if isinstance(rec, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _write(self, rec: dict) -> None:
+        tmp = self.path.with_name(f"{self.path.name}.{os.getpid()}.tmp")
+        tmp.write_text(json.dumps(rec))
+        tmp.replace(self.path)
+
+    def _record(self, holder: str, token: int, expires: float, now: float) -> dict:
+        return {
+            "shard": self.shard_id,
+            "holder": holder,
+            "token": token,
+            "expires": expires,
+            "renewed": now,
+        }
+
+    # -- protocol --
+
+    def held(self, now: Optional[float] = None, margin: float = 0.0) -> bool:
+        """Whether THIS process may act as the shard's owner right now.
+        ``margin`` guards long passes: a reconcile admitted with less
+        than ``margin`` seconds of lease left could outlive the lease."""
+        now = time.time() if now is None else now
+        return self.token > 0 and now + margin < self.expires
+
+    def try_acquire(self, now: Optional[float] = None) -> bool:
+        """Claim the shard if it is free, released, expired, or already
+        ours on disk (same-identity daemon restart). Returns False when
+        it is validly held elsewhere or a rival holds the takeover claim."""
+        now = time.time() if now is None else now
+        rec = self.read()
+        if rec is not None:
+            holder = rec.get("holder") or ""
+            try:
+                expires = float(rec.get("expires", 0.0))
+                rec_token = int(rec.get("token", 0))
+            except (TypeError, ValueError):
+                expires, rec_token = 0.0, 0
+            if holder == self.identity and now < expires:
+                # Our own surviving lease (daemon restart, same identity).
+                self.token, self.expires = rec_token, expires
+                return True
+            if holder and now < expires:
+                return False  # validly held elsewhere
+        claim = self.path.with_suffix(".claim")
+        try:
+            fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+        except FileExistsError:
+            # A rival is mid-takeover. Sweep only a STALE claim (claimant
+            # crashed between claim and lease write); back off otherwise.
+            try:
+                if time.time() - claim.stat().st_mtime > max(self.ttl, 2.0):
+                    claim.unlink(missing_ok=True)
+            except OSError:
+                pass
+            return False
+        except OSError:
+            return False
+        try:
+            os.write(fd, self.identity.encode())
+            os.close(fd)
+            # Re-read UNDER the claim: the lease may have been renewed (or
+            # stolen) between our first read and the claim create.
+            rec = self.read()
+            token = 0
+            self.takeover_from = None
+            if rec is not None:
+                holder = rec.get("holder") or ""
+                try:
+                    expires = float(rec.get("expires", 0.0))
+                    token = int(rec.get("token", 0))
+                except (TypeError, ValueError):
+                    expires, token = 0.0, 0
+                if holder and holder != self.identity and now < expires:
+                    return False
+                if holder and holder != self.identity:
+                    self.takeover_from = holder  # stole an expired lease
+            token += 1  # fencing: every ownership change bumps it
+            self._write(self._record(self.identity, token, now + self.ttl, now))
+            self.token, self.expires = token, now + self.ttl
+            return True
+        finally:
+            claim.unlink(missing_ok=True)
+
+    def renew(self, now: Optional[float] = None) -> bool:
+        """Extend a held lease. Returns False — and drops the in-memory
+        hold — when the lease expired (a renewal after expiry must go
+        through the contended acquire path, not quietly overwrite a
+        stealer) or the on-disk token/holder no longer matches (fencing
+        rejection of this now-stale holder)."""
+        now = time.time() if now is None else now
+        if self.token <= 0:
+            return False
+        if now >= self.expires:
+            self.token, self.expires = 0, 0.0
+            return False
+        rec = self.read()
+        try:
+            disk_expires = float(rec.get("expires", 0.0)) if rec else 0.0
+        except (TypeError, ValueError):
+            disk_expires = 0.0
+        if (
+            rec is None
+            or (rec.get("holder") or "") != self.identity
+            or int(rec.get("token", -1)) != self.token
+            or disk_expires <= now
+        ):
+            # Fencing: someone else owns a newer incarnation of this
+            # lease, or the DISK record expired under us (drop_lease
+            # fault, external tampering) while our in-memory view was
+            # still valid. Either way a rival may already be mid-steal
+            # — never renew-over it; drop and re-contend.
+            self.token, self.expires = 0, 0.0
+            return False
+        self._write(self._record(self.identity, self.token, now + self.ttl, now))
+        self.expires = now + self.ttl
+        return True
+
+    def release(self, now: Optional[float] = None) -> None:
+        """Voluntary hand-back (drain/rebalance): the record keeps the
+        token (monotonicity survives release→claim cycles) with holder
+        cleared and expiry zeroed, so a claimant takes it immediately."""
+        now = time.time() if now is None else now
+        if self.token <= 0:
+            return
+        rec = self.read()
+        if (
+            rec is not None
+            and (rec.get("holder") or "") == self.identity
+            and int(rec.get("token", -1)) == self.token
+        ):
+            self._write(self._record("", self.token, 0.0, now))
+        self.token, self.expires = 0, 0.0
+
+    def force_expire(self) -> None:
+        """Chaos hook (``drop_lease`` fault): rewrite the ON-DISK record
+        as expired without touching the in-memory hold — the holder
+        keeps believing it owns the shard until its next renew is
+        fencing-rejected, which is exactly the stale-holder scenario the
+        token exists to contain."""
+        rec = self.read()
+        if rec is not None:
+            rec["expires"] = 0.0
+            self._write(rec)
+
+
+class ShardIOCounters:
+    """Lease-layer I/O accounting for the control-plane bench: idle
+    steady-state cost is O(owned shards / ttl), never O(jobs)."""
+
+    __slots__ = ("renews", "claims", "releases", "guard_skips")
+
+    def __init__(self) -> None:
+        self.renews = 0
+        self.claims = 0
+        self.releases = 0
+        # Reconciles REFUSED because the shard lease was no longer valid
+        # at admission time — each one is a double-reconcile that did
+        # not happen.
+        self.guard_skips = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "renews": self.renews,
+            "claims": self.claims,
+            "releases": self.releases,
+            "guard_skips": self.guard_skips,
+        }
+
+
+class ShardManager:
+    """One supervisor's view of the sharded job space.
+
+    ``tick()`` once per sync pass: heartbeat our presence, renew owned
+    leases (at half-TTL cadence — idle lease I/O is O(shards/ttl), not
+    O(passes)), release down to the fair share when members joined, and
+    claim up to it when shards are free/expired. Fair share =
+    ``ceil(num_shards / live_members)``, so a join rebalances within
+    ~one tick and a death is absorbed as soon as the dead supervisor's
+    leases expire — both within one lease TTL.
+
+    Renewal additionally runs on a BACKGROUND thread (``auto_renew``,
+    the k8s leader-election pattern): a reconcile pass that takes
+    longer than the TTL — a 10k-job launch pass, a slow disk — must not
+    cost the supervisor its shards mid-pass. The thread only renews and
+    heartbeats presence (idempotent, guarded by one lock shared with
+    ``tick``); membership changes stay on the pass cadence. Tests that
+    need deterministic renewal interleavings pass ``auto_renew=False``
+    and drive ``tick(now)`` with a synthetic clock.
+    """
+
+    # Presence files older than this many TTLs are swept.
+    _PRESENCE_SWEEP_TTLS = 3.0
+
+    def __init__(
+        self,
+        state_dir: Path,
+        num_shards: int,
+        identity: Optional[str] = None,
+        ttl: float = 5.0,
+        auto_renew: bool = True,
+    ):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        self.state_dir = Path(state_dir)
+        self.leases_dir = self.state_dir / "leases"
+        self.members_dir = self.leases_dir / "members"
+        self.members_dir.mkdir(parents=True, exist_ok=True)
+        self.identity = identity or default_identity()
+        self.ttl = float(ttl)
+        self.num_shards = self._pin_config(num_shards)
+        self.leases: Dict[int, ShardLease] = {
+            i: ShardLease(self.leases_dir, i, self.identity, self.ttl)
+            for i in range(self.num_shards)
+        }
+        self.owned: Set[int] = set()
+        self._last_presence = 0.0
+        self._last_orphan_scan = 0.0
+        self.io = ShardIOCounters()
+        self.auto_renew = auto_renew
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._renew_thread: Optional[threading.Thread] = None
+        # Shards the renewal thread lost (fencing rejection) — surfaced
+        # through the next tick() so the owner can emit events/cleanup.
+        self._lost_async: List[int] = []
+
+    def _ensure_renew_thread(self) -> None:
+        if (
+            not self.auto_renew
+            or self._stop.is_set()
+            or (self._renew_thread is not None and self._renew_thread.is_alive())
+        ):
+            return
+        t = threading.Thread(
+            target=self._renew_loop,
+            name="tpujob-shard-renew",
+            daemon=True,
+        )
+        self._renew_thread = t
+        t.start()
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(self.ttl / 3.0):
+            now = time.time()
+            with self._lock:
+                self._write_presence(now)
+                self._last_presence = now
+                self._renew_owned(now)
+
+    def _renew_owned(self, now: float) -> None:
+        """Renew every owned lease nearing half-TTL; record losses.
+        Caller holds the lock."""
+        for i in sorted(self.owned):
+            lease = self.leases[i]
+            if now >= lease.expires - self.ttl / 2.0:
+                self.io.renews += 1
+                if not lease.renew(now):
+                    self.owned.discard(i)
+                    self._lost_async.append(i)
+
+    def halt(self) -> None:
+        """Crash semantics (kill_supervisor in-process): stop renewing
+        WITHOUT releasing anything — the leases must expire and be
+        stolen, exactly as if the process died."""
+        self._stop.set()
+
+    def _pin_config(self, num_shards: int) -> int:
+        """First supervisor pins the shard count for the state dir;
+        joiners must agree (a split-brain shard map would assign one job
+        two owners). O_EXCL create, read-back on conflict."""
+        cfg = self.leases_dir / "config.json"
+        try:
+            fd = os.open(cfg, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+            os.write(fd, json.dumps({"num_shards": num_shards}).encode())
+            os.close(fd)
+            return num_shards
+        except FileExistsError:
+            pass
+        try:
+            pinned = int(json.loads(cfg.read_text())["num_shards"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return num_shards
+        if pinned != num_shards:
+            raise ValueError(
+                f"state dir is sharded {pinned} ways; --shards {num_shards} "
+                "does not match (every supervisor on one state dir must "
+                "agree on the shard count)"
+            )
+        return pinned
+
+    # -- membership --
+
+    def _presence_path(self, identity: Optional[str] = None) -> Path:
+        import re as _re
+
+        safe = _re.sub(r"[^A-Za-z0-9._-]", "_", identity or self.identity)
+        return self.members_dir / (safe + ".json")
+
+    def _write_presence(self, now: float) -> None:
+        p = self._presence_path()
+        tmp = p.with_name(f"{p.name}.{os.getpid()}.tmp")
+        tmp.write_text(json.dumps({"identity": self.identity, "ts": now}))
+        tmp.replace(p)
+
+    def live_members(self, now: Optional[float] = None) -> List[str]:
+        """Identities with a fresh presence heartbeat (self included even
+        before the first write). Stale presence files are swept."""
+        now = time.time() if now is None else now
+        out = {self.identity}
+        try:
+            entries = list(os.scandir(self.members_dir))
+        except OSError:
+            return sorted(out)
+        for e in entries:
+            if not e.name.endswith(".json"):
+                continue
+            try:
+                rec = json.loads(Path(e.path).read_text())
+                ident = str(rec["identity"])
+                ts = float(rec["ts"])
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+            if now - ts <= self.ttl:
+                out.add(ident)
+            elif now - ts > self._PRESENCE_SWEEP_TTLS * self.ttl:
+                Path(e.path).unlink(missing_ok=True)
+        return sorted(out)
+
+    def fair_share(self, members: int) -> int:
+        return math.ceil(self.num_shards / max(1, members))
+
+    def _pref(self, shard_id: int) -> int:
+        """Deterministic per-identity shard preference: different
+        supervisors walk the claimable shards in different orders, so
+        simultaneous joiners mostly avoid contending on the same claim
+        file (collisions are still resolved exactly-once by O_EXCL)."""
+        return zlib.crc32(f"{shard_id}:{self.identity}".encode())
+
+    # -- the per-pass step --
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """Returns ``{"acquired": [...], "released": [...], "lost":
+        [...], "members": int}`` — the supervisor turns acquisitions
+        into store reloads / runner adoption and hand-off events."""
+        now = time.time() if now is None else now
+        self._ensure_renew_thread()
+        acquired: List[int] = []
+        released: List[int] = []
+        with self._lock:
+            if now - self._last_presence >= self.ttl / 3.0:
+                self._write_presence(now)
+                self._last_presence = now
+            members = self.live_members(now)
+            fair = self.fair_share(len(members))
+            # Renew what we own (backup path; the renewal thread keeps
+            # this a no-op while it runs), THEN drain losses — renewal
+            # fencing rejections from this very tick must surface now,
+            # not one pass late.
+            self._renew_owned(now)
+            lost, self._lost_async = self._lost_async, []
+            # Release down to fair share (a joiner appeared): hand back
+            # the shards we are LEAST preferred for, deterministically.
+            if len(self.owned) > fair:
+                keep = sorted(self.owned, key=self._pref)[:fair]
+                for i in sorted(self.owned - set(keep)):
+                    self.io.releases += 1
+                    self.leases[i].release(now)
+                    self.owned.discard(i)
+                    released.append(i)
+            # Claim up to fair share (bootstrap, member death, releases).
+            if len(self.owned) < fair:
+                for i in sorted(range(self.num_shards), key=self._pref):
+                    if len(self.owned) >= fair:
+                        break
+                    if i in self.owned:
+                        continue
+                    self.io.claims += 1
+                    if self.leases[i].try_acquire(now):
+                        self.owned.add(i)
+                        acquired.append(i)
+            # Orphan rescue, BEYOND fair share: a shard whose holder
+            # stopped renewing (death, drop_lease) must be re-claimed
+            # within one TTL of its last renewal — not whenever the dead
+            # member's presence ages out. Over-claiming rebalances back
+            # down on later ticks. Throttled: O(num_shards) tiny reads
+            # at most every ttl/3, never per pass.
+            if now - self._last_orphan_scan >= self.ttl / 3.0:
+                self._last_orphan_scan = now
+                for i in range(self.num_shards):
+                    if i in self.owned:
+                        continue
+                    lease = self.leases[i]
+                    rec = lease.read()
+                    if rec is None or not rec.get("holder"):
+                        continue  # free/released: fair-share territory
+                    try:
+                        expires = float(rec.get("expires", 0.0))
+                    except (TypeError, ValueError):
+                        expires = 0.0
+                    if now < expires:
+                        continue
+                    self.io.claims += 1
+                    if lease.try_acquire(now):
+                        self.owned.add(i)
+                        acquired.append(i)
+        return {
+            "acquired": acquired,
+            "released": released,
+            "lost": lost,
+            "members": len(members),
+        }
+
+    # -- ownership queries --
+
+    def shard_of(self, key: str, pin: Optional[int] = None) -> int:
+        return shard_of_key(key, self.num_shards, pin)
+
+    def owns_shard(
+        self, shard_id: int, now: Optional[float] = None, margin: float = 0.0
+    ) -> bool:
+        return shard_id in self.owned and self.leases[shard_id].held(
+            now, margin
+        )
+
+    def owns_key(
+        self,
+        key: str,
+        now: Optional[float] = None,
+        pin: Optional[int] = None,
+        margin: float = 0.0,
+    ) -> bool:
+        return self.owns_shard(self.shard_of(key, pin), now, margin)
+
+    def owner_of(self, shard_id: int) -> Optional[str]:
+        """Best-effort on-disk owner (observer surfaces: top, healthz)."""
+        rec = self.leases[shard_id].read()
+        if rec is None:
+            return None
+        holder = rec.get("holder") or ""
+        try:
+            expires = float(rec.get("expires", 0.0))
+        except (TypeError, ValueError):
+            return None
+        return holder if holder and time.time() < expires else None
+
+    def drain(self, now: Optional[float] = None) -> List[int]:
+        """Voluntary shutdown: release every lease and withdraw presence
+        so the survivors rebalance immediately instead of waiting out
+        the TTL."""
+        now = time.time() if now is None else now
+        self._stop.set()
+        with self._lock:
+            dropped = sorted(self.owned)
+            for i in dropped:
+                self.io.releases += 1
+                self.leases[i].release(now)
+            self.owned.clear()
+            self._presence_path().unlink(missing_ok=True)
+        return dropped
+
+    def inject_drop(self, target: str = "*") -> List[int]:
+        """Chaos hook (``drop_lease``): force-expire the on-disk lease of
+        the targeted owned shard(s) without updating in-memory state —
+        this process becomes the stale holder whose next renew must be
+        fencing-rejected."""
+        with self._lock:
+            doomed = sorted(
+                i
+                for i in self.owned
+                if target in ("*", str(i))
+            )
+            for i in doomed:
+                self.leases[i].force_expire()
+        return doomed
+
+
+def read_shard_config(state_dir) -> Optional[int]:
+    """The state dir's pinned shard count, or None when the control
+    plane has never run sharded (observer surfaces: `tpujob top`,
+    `tpujob why`)."""
+    try:
+        return int(
+            json.loads(
+                (Path(state_dir) / "leases" / "config.json").read_text()
+            )["num_shards"]
+        )
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def read_shard_owners(state_dir) -> Dict[int, str]:
+    """Best-effort {shard: live holder} snapshot from the lease files."""
+    leases_dir = Path(state_dir) / "leases"
+    now = time.time()
+    out: Dict[int, str] = {}
+    try:
+        entries = list(os.scandir(leases_dir))
+    except OSError:
+        return out
+    for e in entries:
+        if not (e.name.startswith("shard-") and e.name.endswith(".lease")):
+            continue
+        try:
+            rec = json.loads(Path(e.path).read_text())
+            shard = int(rec["shard"])
+            holder = rec.get("holder") or ""
+            expires = float(rec.get("expires", 0.0))
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+        if holder and now < expires:
+            out[shard] = holder
+    return out

@@ -191,7 +191,8 @@ class MetricsRegistry:
         self.sync_pass_seconds = self.histogram(
             "tpujob_sync_pass_seconds",
             "Supervisor sync-pass latency by phase (serial scheduling vs "
-            "parallel steady vs total)",
+            "steady — the parallel-pool phase the autoscaler drives — "
+            "vs total)",
         )
         self.reconcile_seconds = self.histogram(
             "tpujob_reconcile_seconds",
@@ -245,6 +246,60 @@ class MetricsRegistry:
             "tpujob_alerts",
             "Firing live-health alerts per job/rule/severity "
             "(obs/watch.py; pending/resolved states are not exported)",
+        )
+        # ---- sharded control plane (controller/leases.py) ----
+        self.shard_jobs = self.gauge(
+            "tpujob_shard_jobs",
+            "Unfinished jobs per owned shard, labeled with the owning "
+            "supervisor identity — rebuilt per pass; the fleet view is "
+            "the union across every supervisor's /metrics",
+        )
+        self.supervisor_pass_seconds = self.gauge(
+            "tpujob_supervisor_pass_seconds",
+            "This supervisor's last full sync-pass latency (per-daemon "
+            "gauge; the pooled distribution is tpujob_sync_pass_seconds)",
+        )
+        self.shards_owned = self.gauge(
+            "tpujob_shards_owned",
+            "Shard leases this supervisor currently holds (0 when the "
+            "control plane runs unsharded)",
+        )
+        self.shard_acquisitions = self.counter(
+            "tpujob_shard_acquisitions_total",
+            "Shard leases acquired (bootstrap, takeover after expiry, "
+            "rebalance claim)",
+        )
+        self.shard_releases = self.counter(
+            "tpujob_shard_releases_total",
+            "Shard leases voluntarily released (rebalance on member "
+            "join, drain)",
+        )
+        self.shard_losses = self.counter(
+            "tpujob_shard_losses_total",
+            "Shard leases LOST: renewal fencing-rejected (a newer owner "
+            "took over) or expired before renewal",
+        )
+        self.shard_guard_skips = self.counter(
+            "tpujob_shard_guard_skips_total",
+            "Reconciles refused because the shard lease was no longer "
+            "valid at admission — each one is a double reconcile that "
+            "did not happen",
+        )
+        # ---- steady-pool autoscaler (controller/autoscale.py) ----
+        self.sync_pool_size = self.gauge(
+            "tpujob_sync_pool_size",
+            "Current steady-phase reconcile pool size (latency-driven "
+            "autoscaler; floor on an idle fleet)",
+        )
+        self.sync_pool_max = self.gauge(
+            "tpujob_sync_pool_max",
+            "Configured steady-phase pool ceiling (--sync-workers-max)",
+        )
+        self.steady_fast_skips = self.counter(
+            "tpujob_steady_fast_skips_total",
+            "Steady jobs whose full reconcile was skipped because "
+            "nothing changed since the last pass (replica set, job "
+            "generation, and status files all unchanged)",
         )
         self.job_feed_stall = self.gauge(
             "tpujob_job_feed_stall_ms",

@@ -132,4 +132,12 @@ def supervisor_health(supervisor) -> Dict:
     if lease is not None:
         doc["leader"] = lease.holder()  # the actual holder, not necessarily us
         doc["is_leader"] = lease.is_held()
+    shards = getattr(supervisor, "shards", None)
+    if shards is not None:
+        doc["identity"] = supervisor.identity
+        doc["shards"] = {
+            "num_shards": shards.num_shards,
+            "owned": sorted(shards.owned),
+            "members": shards.live_members(),
+        }
     return doc

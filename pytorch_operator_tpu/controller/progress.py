@@ -53,7 +53,7 @@ def job_status_dir(status_root, key: str) -> Optional[Path]:
     return _job_status_dir_cached(str(status_root), key)
 
 
-@functools.lru_cache(maxsize=8192)
+@functools.lru_cache(maxsize=65536)
 def _job_status_dir_cached(status_root: str, key: str) -> Path:
     # Memoized: the supervisor resolves this twice per job per pass
     # (status scan + gauge fold) and pathlib construction is the cost.
@@ -183,6 +183,13 @@ class ProgressTailer:
         # is O(this job's files), not O(every tailed file in the fleet)
         # — the per-pass clock fold must not undo the O(1) idle pass.
         self._dir_files: dict = {}
+        # Whether the LAST poll() consumed new bytes or saw the file set
+        # change — the supervisor's steady fast path reads it right
+        # after polling to decide if a full reconcile is warranted —
+        # and how many replica files it saw (0 = the job has never
+        # reported; the supervisor throttles re-scans of such dirs).
+        self.last_poll_consumed = False
+        self.last_poll_files = 0
         self.io = TailerIOCounters()
 
     def _drop_dir(self, d: Path) -> None:
@@ -246,7 +253,7 @@ class ProgressTailer:
         if status_dir is None:
             return {}
         out: dict = {}
-        for path in self._dir_files.get(str(Path(status_dir)), ()):
+        for path in self._dir_files.get(str(status_dir), ()):
             st = self._files.get(path)
             if st is not None and st[1]:
                 out[Path(path).stem] = st[1]
@@ -256,9 +263,14 @@ class ProgressTailer:
         """One incremental scan; returns the newest record per tailed
         kind across the job's replica files, e.g. ``{"progress": {...},
         "checkpoint_committed": {...}}`` (kinds never seen are absent)."""
+        self.last_poll_consumed = False
+        self.last_poll_files = 0
         if status_dir is None:
             return {}
-        d = Path(status_dir)
+        # No Path re-parse on the hot path: the supervisor hands in the
+        # cached Path (job_status_dir); re-constructing it per job per
+        # pass was measurable at 10k jobs.
+        d = status_dir if isinstance(status_dir, Path) else Path(status_dir)
         try:
             entries = [
                 (e.path, e.stat().st_size)
@@ -269,6 +281,7 @@ class ProgressTailer:
         except OSError:
             self._drop_dir(d)
             return {}
+        self.last_poll_files = len(entries)
         seen = set()
         best: dict = {}
         for path, size in entries:
@@ -278,12 +291,14 @@ class ProgressTailer:
                 st = [max(0, size - TAIL_BYTES), {}]
                 self._files[path] = st
                 first_sight = st[0] > 0
+                self.last_poll_consumed = True  # new replica file
             else:
                 first_sight = False
                 if size < st[0]:
                     # Truncated/replaced (new incarnation): start over.
                     st[0], st[1] = 0, {}
             if size > st[0]:
+                self.last_poll_consumed = True
                 recs, st[0] = self._consume(path, st[0], first_sight)
                 for kind, rec in recs.items():
                     cur = st[1].get(kind)

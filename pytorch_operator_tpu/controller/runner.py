@@ -214,9 +214,26 @@ class ProcessRunner:
         """Free scheduling slots, or None for unlimited (gang admission input)."""
         return None
 
-    def rescan(self) -> None:
+    def rescan(self, key_filter=None) -> None:
         """Adopt state left by another incarnation (hot-standby takeover);
-        no-op for runners without persistence."""
+        no-op for runners without persistence. ``key_filter`` (job key →
+        bool) limits adoption to owned jobs — a SHARDED supervisor must
+        not start tracking (and counting against its capacity) replicas
+        another shard owner reconciles."""
+
+    def take_changed_keys(self) -> Optional[set]:
+        """Job keys whose replica set changed (create/delete/phase
+        transition/kill) since the last call, consumed. Returns None
+        when this runner does not track changes — callers must then
+        assume EVERYTHING changed (disables the supervisor's steady
+        fast path, never its correctness)."""
+        return None
+
+    def forget_job(self, job_key: str) -> None:
+        """Drop in-memory tracking of a job's replicas WITHOUT touching
+        the processes or their persisted records — the shard hand-off
+        primitive: the releasing supervisor forgets, the new owner
+        adopts via ``rescan``."""
 
     def capacity_slots(self) -> Optional[int]:
         """Total device-slot capacity, or None for unbounded."""
@@ -262,6 +279,9 @@ class FakeRunner(ProcessRunner):
         # locks serialize same-key access, but different keys hit the shared
         # dicts concurrently (tests/test_stress.py).
         self._lock = threading.RLock()
+        # Job keys with replica-set changes since the last drain — feeds
+        # the supervisor's steady fast path.
+        self._changed_keys: set = set()
 
     def create(self, job_key, rtype, index, template, env):
         from .. import faults
@@ -299,6 +319,7 @@ class FakeRunner(ProcessRunner):
             self.envs[name] = dict(env)
             self.templates[name] = template
             self.actions.append(("create", name))
+            self._changed_keys.add(job_key)
             return h
 
     def _index_pop(self, name: str) -> Optional[ReplicaHandle]:
@@ -318,9 +339,22 @@ class FakeRunner(ProcessRunner):
             if h is not None:
                 self.envs.pop(name, None)
                 self.templates.pop(name, None)
+                self._changed_keys.add(h.job_key)
 
     def sync(self):
         pass
+
+    def take_changed_keys(self):
+        with self._lock:
+            out, self._changed_keys = self._changed_keys, set()
+            return out
+
+    def forget_job(self, job_key):
+        with self._lock:
+            for name in list(self._by_job.get(job_key, {})):
+                self._index_pop(name)
+                self.envs.pop(name, None)
+                self.templates.pop(name, None)
 
     def list_for_job(self, job_key):
         with self._lock:
@@ -332,7 +366,9 @@ class FakeRunner(ProcessRunner):
 
     def remove_record(self, name):
         with self._lock:
-            self._index_pop(name)
+            h = self._index_pop(name)
+            if h is not None:
+                self._changed_keys.add(h.job_key)
 
     def schedulable_slots(self):
         with self._lock:
@@ -355,6 +391,7 @@ class FakeRunner(ProcessRunner):
                 h.phase = ReplicaPhase.FAILED
                 h.exit_code = 137  # signal death, retryable
                 h.finished_at = time.time()
+                self._changed_keys.add(h.job_key)
 
     # --- test helpers ---
 
@@ -366,12 +403,14 @@ class FakeRunner(ProcessRunner):
                 h.exit_code = exit_code
             if phase in (ReplicaPhase.SUCCEEDED, ReplicaPhase.FAILED):
                 h.finished_at = time.time()
+            self._changed_keys.add(h.job_key)
 
     def set_all_running(self, job_key: str):
         with self._lock:
             for h in self.list_for_job(job_key):
                 if h.phase == ReplicaPhase.PENDING:
                     h.phase = ReplicaPhase.RUNNING
+                    self._changed_keys.add(job_key)
 
 
 class SubprocessRunner(ProcessRunner):
@@ -424,6 +463,12 @@ class SubprocessRunner(ProcessRunner):
         # replica survives — liveness for these is pid-only (persisted in
         # the record for adoption across supervisor restarts).
         self._wrapperless: set = set()
+        # Job keys with replica-set changes since the last drain (steady
+        # fast path), and reaped-but-untracked Popen objects left by
+        # forget_job (a disowned child must still be wait()ed or it
+        # lingers as a zombie until this process exits).
+        self._changed_keys: set = set()
+        self._disowned: List[subprocess.Popen] = []
         self._lock = threading.RLock()
         self._load_records()
 
@@ -464,6 +509,7 @@ class SubprocessRunner(ProcessRunner):
     def _index_add(self, h: ReplicaHandle) -> None:
         self.handles[h.name] = h
         self._by_job.setdefault(h.job_key, {})[h.name] = h
+        self._changed_keys.add(h.job_key)
 
     def _index_pop(self, name: str) -> Optional[ReplicaHandle]:
         h = self.handles.pop(name, None)
@@ -473,25 +519,54 @@ class SubprocessRunner(ProcessRunner):
                 per_job.pop(name, None)
                 if not per_job:
                     self._by_job.pop(h.job_key, None)
+            self._changed_keys.add(h.job_key)
         return h
 
-    def rescan(self) -> None:
+    def rescan(self, key_filter=None) -> None:
         """Adopt the worlds another incarnation left behind — the
         hot-standby takeover step. The standby's startup snapshot (taken
         while the old leader was still mutating records) is DISCARDED for
         every replica that is not this runner's own live child: the disk
         records the dead leader wrote are strictly fresher (it may have
         restarted replicas under new pids since we loaded). Own children
-        (``self._procs``) keep their live Popen state."""
+        (``self._procs``) keep their live Popen state. ``key_filter``
+        (sharded takeover) adopts only owned jobs' records."""
         with self._lock:
             for name in list(self.handles):
                 if name not in self._procs:
                     self._index_pop(name)
                     self._adopted.pop(name, None)
                     self._pid_starts.pop(name, None)
-            self._load_records(persist_classification=True)
+            self._load_records(
+                persist_classification=True, key_filter=key_filter
+            )
 
-    def _load_records(self, persist_classification: bool = False) -> None:
+    def take_changed_keys(self):
+        with self._lock:
+            out, self._changed_keys = self._changed_keys, set()
+            return out
+
+    def forget_job(self, job_key):
+        """Shard hand-off: stop tracking this job's replicas. Processes
+        and persisted records are untouched (the new owner adopts both);
+        our OWN live children move to a reap list so they cannot
+        zombify if they exit before this process does."""
+        with self._lock:
+            for name in list(self._by_job.get(job_key, {})):
+                self._index_pop(name)
+                proc = self._procs.pop(name, None)
+                if proc is not None:
+                    self._disowned.append(proc)
+                f = self._log_files.pop(name, None)
+                if f is not None:
+                    f.close()
+                self._adopted.pop(name, None)
+                self._pid_starts.pop(name, None)
+                self._wrapperless.discard(name)
+
+    def _load_records(
+        self, persist_classification: bool = False, key_filter=None
+    ) -> None:
         """Adopt persisted replicas: live pids (same /proc start time) come
         back RUNNING; dead ones get their exit code from the exit-capture
         file, or 137 (signal death, retryable) if none was written.
@@ -506,6 +581,10 @@ class SubprocessRunner(ProcessRunner):
             try:
                 rec = json.loads(rec_file.read_text())
                 if rec.get("name") in self.handles:
+                    continue
+                if key_filter is not None and not key_filter(
+                    rec.get("job_key", "")
+                ):
                     continue
                 h = ReplicaHandle(
                     name=rec["name"],
@@ -562,6 +641,7 @@ class SubprocessRunner(ProcessRunner):
             ReplicaPhase.SUCCEEDED if h.exit_code == 0 else ReplicaPhase.FAILED
         )
         h.finished_at = time.time()
+        self._changed_keys.add(h.job_key)
         if save:
             self._save(h, only_if_tracked=True)
 
@@ -698,6 +778,12 @@ class SubprocessRunner(ProcessRunner):
             # Outside the handle lock: replenish spawns processes.
             self._standby_pool.replenish()
         with self._lock:
+            # Reap children disowned by a shard hand-off (forget_job):
+            # still our OS children until they exit, never our replicas.
+            if self._disowned:
+                self._disowned = [
+                    p for p in self._disowned if p.poll() is None
+                ]
             for name, proc in list(self._procs.items()):
                 code = proc.poll()
                 if code is None:
@@ -731,6 +817,7 @@ class SubprocessRunner(ProcessRunner):
                     else ReplicaPhase.FAILED
                 )
                 h.finished_at = time.time()
+                self._changed_keys.add(h.job_key)
                 self._save(h, only_if_tracked=True)
             # Adopted replicas (previous incarnation's children): when the
             # exit-capture file exists the replica's main process is done

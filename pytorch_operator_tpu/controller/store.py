@@ -28,6 +28,7 @@ measurable baseline for ``tpujob bench-control-plane``.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import threading
@@ -122,6 +123,12 @@ class JobStore:
         # take_*/deletion_markers call consumes its kind once, then falls
         # back to a fresh glob (standalone callers never see stale lists).
         self._pass_markers: Optional[dict] = None
+        # Optional job-key predicate over marker candidates: a SHARDED
+        # supervisor must not rename-claim (and thereby consume) a
+        # marker for a job another shard owner reconciles — the claim is
+        # exactly-once, so a wrong claimant would act on replicas it
+        # does not own. None = claim everything (single-supervisor).
+        self.key_filter = None
         self._last_sweep = 0.0
         self.io = StoreIOCounters()
         # Optional latency histograms (obs/metrics.Histogram — anything
@@ -146,9 +153,12 @@ class JobStore:
             print(f"[tpujob] warning: {message}")
 
     @staticmethod
+    @functools.lru_cache(maxsize=65536)
     def _key_from_filename(name: str) -> str:
         """Best-effort job key from a persistence filename (strip every
-        extension: ``ns_job.json``, ``ns_job.json.1234.tmp``, ...)."""
+        extension: ``ns_job.json``, ``ns_job.json.1234.tmp``, ...).
+        Memoized: rescan resolves every filename every pass — at 10k
+        jobs the string ops alone were measurable."""
         return fs_to_key(name.split(".", 1)[0])
 
     def _sweep_stale_tmp(self, paths=None) -> int:
@@ -340,6 +350,13 @@ class JobStore:
         with self._lock:
             return list(self._jobs.keys())
 
+    def items(self) -> List[tuple]:
+        """(key, job) pairs in one snapshot — the supervisor's pass loop
+        iterates every key every pass; a keys() + N×get() walk is two
+        dict traversals where one suffices."""
+        with self._lock:
+            return list(self._jobs.items())
+
     def rescan(self) -> List[str]:
         """Pick up job files written by other processes (``tpujob submit``).
 
@@ -370,9 +387,13 @@ class JobStore:
         with self._lock:
             self.io.scans += 1
             try:
-                entries = sorted(
-                    ((e.name, e.path) for e in os.scandir(self.persist_dir)),
-                )
+                # Directory order, not sorted: sorting 10k names per
+                # pass is pure overhead — known files are skipped by
+                # name, and marker claims / new-key discovery don't
+                # depend on scan order (claim-by-rename arbitrates).
+                entries = [
+                    (e.name, e.path) for e in os.scandir(self.persist_dir)
+                ]
             except OSError:
                 return []
             for name, epath in entries:
@@ -404,13 +425,23 @@ class JobStore:
         """Marker files of one kind: the rescan snapshot's list when one
         is armed (consumed — at most once per pass), else a fresh glob.
         Claim-by-rename downstream keeps consumption exactly-once even
-        when a snapshot raced another supervisor."""
+        when a snapshot raced another supervisor. ``key_filter`` (shard
+        ownership) drops candidates for jobs this supervisor must not
+        act on — they stay at the marker path for their owner's pass."""
         with self._lock:
             pm = self._pass_markers
             if pm is not None and pm.get(kind) is not None:
-                return pm.pop(kind)
-        self.io.scans += 1
-        return sorted(self.persist_dir.glob("*." + kind))
+                # The snapshot collects in directory order; markers are
+                # few — sort here, not the 10k-entry snapshot.
+                paths = sorted(pm.pop(kind))
+            else:
+                paths = None
+        if paths is None:
+            self.io.scans += 1
+            paths = sorted(self.persist_dir.glob("*." + kind))
+        if self.key_filter is not None:
+            paths = [p for p in paths if self.key_filter(fs_to_key(p.stem))]
+        return paths
 
     def reload(self, key: str) -> Optional[TPUJob]:
         """Re-read one job's record from disk, replacing the cached object.

@@ -12,6 +12,7 @@ import os
 import socket
 import threading
 import time
+import zlib
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 from typing import List, Optional
@@ -19,19 +20,27 @@ from typing import List, Optional
 from ..api.defaults import (
     AUTO_PORT_ANNOTATION,
     ELASTIC_TARGET_ANNOTATION,
+    HANG_DEADLINE_ANNOTATION,
     set_defaults,
 )
 from ..api.types import ConditionType, ReplicaType, TPUJob
 from ..api.validation import ValidationError, validate
+from .autoscale import PoolAutoscaler
 from .events import EventRecorder
 from .expectations import ControllerExpectations
 from .gang import GangScheduler
-from .leases import LeaderLease
+from .leases import SHARD_EVENT_KEY, LeaderLease, ShardManager, default_identity
 from .metrics import MetricsRegistry
-from .progress import ProgressTailer
+from .progress import ProgressTailer, job_status_dir
 from .reconciler import Reconciler
 from .runner import ProcessRunner, SubprocessRunner, replica_name
 from .store import JobStore, job_key, purge_job_artifacts
+
+
+class SupervisorKilledError(RuntimeError):
+    """Raised by :meth:`Supervisor.simulate_crash` — the in-process
+    stand-in for an abrupt daemon death (``kill_supervisor`` fault in
+    tests/benches; a real daemon just ``os._exit``\\ s)."""
 
 
 def default_state_dir() -> Path:
@@ -60,13 +69,35 @@ class Supervisor:
         parallel_sync: bool = True,
         sync_workers: Optional[int] = None,
         cached_store: bool = True,
+        shards: Optional[int] = None,
+        supervisor_id: Optional[str] = None,
+        lease_ttl: float = 5.0,
+        sync_workers_max: Optional[int] = None,
     ):
         self.state_dir = Path(state_dir) if state_dir is not None else default_state_dir()
         self.state_dir.mkdir(parents=True, exist_ok=True)
+        self.identity = supervisor_id or default_identity()
+        # Sharded control plane (``--shards N``): job-space partitioned
+        # across N store-marker leases; this supervisor reconciles only
+        # the shards it holds. Replaces leader election — the whole
+        # point is MULTIPLE active reconcilers on one state dir.
+        self.shards = (
+            ShardManager(
+                self.state_dir, shards, identity=self.identity, ttl=lease_ttl
+            )
+            if shards
+            else None
+        )
         # Leader election (reference: leaderelection.RunOrDie, SURVEY.md §3.1).
         # The lease is created here but acquired by the daemon entrypoint, so
         # library users (tests, foreground run) aren't serialized by default.
-        self.lease = LeaderLease(self.state_dir) if leader_elect else None
+        # Single-supervisor semantics are exactly ShardManager(num_shards=1)
+        # — kept as-is so existing daemons/tests run unchanged.
+        self.lease = (
+            LeaderLease(self.state_dir)
+            if leader_elect and self.shards is None
+            else None
+        )
         self.poll_interval = poll_interval
         # Events before the store: persistence-layer warnings (corrupt
         # state files skipped at load, stale tmp sweeps) land on the
@@ -80,11 +111,22 @@ class Supervisor:
             cache=cached_store,
         )
         # Parallel reconcile phase (reference: controller.Run(threadiness)
-        # — the workqueue's N workers): steady-state jobs sync on a small
-        # thread pool; scheduling decisions stay serial (see sync_once).
+        # — the workqueue's N workers): steady-state jobs sync on a
+        # thread pool whose size a latency-driven autoscaler controls
+        # (controller/autoscale.py) against the measured steady-phase
+        # latency, bounded by --sync-workers-max. An EXPLICIT
+        # sync_workers with no ceiling pins the old fixed-size behavior.
         self.parallel_sync = parallel_sync
-        self._sync_workers = sync_workers or min(8, os.cpu_count() or 2)
+        base = sync_workers or min(8, os.cpu_count() or 2)
+        if sync_workers is not None and sync_workers_max is None:
+            floor = ceiling = base  # explicitly pinned: no autoscaling
+        else:
+            ceiling = sync_workers_max or base
+            floor = min(2, ceiling)
+        self._pool_scaler = PoolAutoscaler(floor=floor, ceiling=ceiling)
+        self._sync_workers = self._pool_scaler.size
         self._sync_pool = None
+        self._sync_pool_size = 0
         self._sync_pool_lock = threading.Lock()
         # Incremental heartbeat reader for the per-job training gauges:
         # remembers a byte offset per replica status file, so an idle
@@ -93,6 +135,39 @@ class Supervisor:
         # Supervisor pass counter for the fault-injection pass hook
         # (kill_replica faults schedule against it).
         self._fault_pass = 0
+        # kill_supervisor fault behavior: None = real daemon death
+        # (os._exit); tests/benches set simulate_crash to keep the
+        # process alive while THIS supervisor stops cold.
+        self.fault_kill_action = None
+        # Steady fast path: key -> job.generation recorded after a full
+        # steady-phase reconcile found nothing to do. A later pass may
+        # skip the full reconcile iff the generation still matches AND
+        # the runner reported no replica change AND the status files
+        # grew no new bytes — at 10k jobs this is what keeps the idle
+        # pass flat instead of O(jobs × reconcile machinery).
+        self._steady_gen: dict = {}
+        # Companion cache for the scheduling-phase classifier: key ->
+        # generation at which _needs_scheduling last returned False.
+        # Valid under the same invariants (generation + runner change
+        # set), with the two fields callers may legally flip WITHOUT
+        # touch() — run_policy.suspend and elastic_policy — re-checked
+        # live in the gate.
+        self._steady_ok: dict = {}
+        # Per-pass stash of tailer polls done by the fast-path gate, so
+        # the gauge fold does not scan the same status dir twice.
+        self._pass_polled: dict = {}
+        # Jobs whose status dir held NO replica files at the last poll
+        # (never reported): re-scanned only every 4th pass, staggered by
+        # key hash — a 10k-job idle fleet must not pay 10k scandirs per
+        # pass for directories that are provably empty. key -> stagger.
+        self._dir_empty: dict = {}
+        self._pass_no = 0
+        # Keys fast-skipped THIS pass (provably unchanged): the gauge
+        # fold reuses the pass loop's is_finished verdict for them.
+        self._pass_fast_skipped: set = set()
+        # key -> shard id (hash or spec pin), cached: the per-pass
+        # ownership filter must cost a dict lookup, not a spec walk.
+        self._shard_cache: dict = {}
         self.metrics = MetricsRegistry()
         self.runner = runner if runner is not None else SubprocessRunner(
             self.state_dir, max_slots=max_slots, standby=standby
@@ -149,6 +224,108 @@ class Supervisor:
         from ..obs.watch import WatchEngine
 
         self.watch = WatchEngine(self.state_dir)
+        if self.shards is not None:
+            # Markers are consumed by rename-claim (exactly-once): a
+            # sharded supervisor must not claim one for a job another
+            # shard owner reconciles.
+            self.store.key_filter = self._owns_key
+
+    # ---- sharded control plane ----
+
+    def _job_shard(self, key: str) -> int:
+        """The job's shard (hash of key, or the spec's explicit pin),
+        cached per key — the ownership filter runs per job per pass."""
+        s = self._shard_cache.get(key)
+        if s is None:
+            job = self.store.get(key)
+            pin = None
+            if job is not None:
+                pin = job.spec.run_policy.scheduling_policy.shard
+            s = self.shards.shard_of(key, pin)
+            if job is not None:
+                self._shard_cache[key] = s
+        return s
+
+    def _owns_key(self, key: str, now: Optional[float] = None) -> bool:
+        return self.shards.owns_shard(self._job_shard(key), now)
+
+    def _shard_tick(self, now: float) -> dict:
+        """Once per pass: renew/claim/release shard leases, then turn
+        the changes into state the rest of the pass relies on — adopt
+        replica records of acquired shards, reload their (possibly
+        stale) job objects, forget what was handed off — and record
+        every hand-off on the shared shard event log so ``tpujob why``
+        can cite an ownership change."""
+        changes = self.shards.tick(now)
+        m = self.metrics
+        for i in changes["lost"]:
+            m.shard_losses.inc()
+            self.events.warning(
+                SHARD_EVENT_KEY,
+                "ShardLeaseLost",
+                f"shard {i} lease lost by {self.identity} "
+                "(fencing rejection or expiry before renewal).",
+            )
+            self._drop_shard_state(i)
+        for i in changes["released"]:
+            m.shard_releases.inc()
+            self.events.normal(
+                SHARD_EVENT_KEY,
+                "ShardReleased",
+                f"shard {i} released by {self.identity} (rebalance to "
+                f"{changes['members']} supervisors).",
+            )
+            self._drop_shard_state(i)
+        if changes["acquired"]:
+            owned_now = set(changes["acquired"])
+            # Adopt the replica records (and live processes) the
+            # previous owner left behind — only for shards now ours.
+            self.runner.rescan(
+                key_filter=lambda k: self._job_shard(k) in owned_now
+            )
+            for i in changes["acquired"]:
+                m.shard_acquisitions.inc()
+                lease = self.shards.leases[i]
+                msg = (
+                    f"shard {i} acquired by {self.identity} "
+                    f"(token {lease.token})"
+                )
+                if lease.takeover_from:
+                    # Stolen after expiry: the previous holder stopped
+                    # renewing — died, hung, or was partitioned.
+                    msg += f" after lease expiry of {lease.takeover_from}"
+                self.events.normal(SHARD_EVENT_KEY, "ShardAcquired", msg + ".")
+            # Our cached job objects for these shards may be stale (the
+            # previous owner mutated them up to its death/release).
+            for key in self.store.keys():
+                if self._job_shard(key) in owned_now:
+                    self.store.reload(key)
+                    self._steady_gen.pop(key, None)
+                    self._steady_ok.pop(key, None)
+        return changes
+
+    def _drop_shard_state(self, shard_id: int) -> None:
+        """Hand-off bookkeeping for a shard we no longer own: stop
+        tracking its replicas (processes/records stay for the adopter),
+        drop fast-path and health-engine state, retire its metric
+        series from THIS supervisor's registry."""
+        for key in self.store.keys():
+            if self._job_shard(key) == shard_id:
+                self.runner.forget_job(key)
+                self._steady_gen.pop(key, None)
+                self._steady_ok.pop(key, None)
+                self.watch.retire_job(key)
+                self.metrics.retire_job(key)
+
+    def simulate_crash(self) -> None:
+        """In-process stand-in for an abrupt daemon death (the
+        ``kill_supervisor`` fault in tests/benches): stop cold without
+        releasing leases or killing replicas — survivors must win the
+        shards back by EXPIRY, exactly like a real SIGKILL. The renewal
+        thread is halted (a dead process renews nothing)."""
+        if self.shards is not None:
+            self.shards.halt()
+        raise SupervisorKilledError(self.identity)
 
     # ---- API-server-ish surface ----
 
@@ -265,6 +442,8 @@ class Supervisor:
                 job.spec.port = cur.spec.port  # keep the live probed port
             cur.spec = job.spec
             cur.touch()
+            # The spec may carry a new explicit shard pin.
+            self._shard_cache.pop(key, None)
             # New metadata wins; system identity (uid/creation/submit) stays.
             cur.metadata.labels.update(job.metadata.labels)
             cur.metadata.annotations.update(job.metadata.annotations)
@@ -377,43 +556,98 @@ class Supervisor:
 
         now = time.time() if now is None else now
         t_pass = time.perf_counter()
+        if self.shards is not None:
+            self._shard_tick(now)
         self._inject_pass_faults()
         any_active = False
-        jobs = []
-        for key in self.store.keys():
-            job = self.store.get(key)
-            if job is None:
-                continue
-            jobs.append((key, job))
-        jobs.sort(
-            key=lambda kj: (
-                -kj[1].spec.run_policy.scheduling_policy.priority,
-                kj[1].status.submit_time or 0.0,
-            )
-        )
+        if self.shards is None:
+            jobs = self.store.items()
+        else:
+            # Inline ownership filter: one dict get + one set test per
+            # key (10k keys per pass at fleet scale — function-call
+            # overhead per key is real money). Validity is computed
+            # once; leases are renewed by the background thread, not
+            # per key.
+            valid = {
+                i
+                for i in self.shards.owned
+                if self.shards.leases[i].held(now)
+            }
+            cache = self._shard_cache
+            jobs = []
+            for key, job in self.store.items():
+                s = cache.get(key)
+                if s is None:
+                    s = self._job_shard(key)
+                if s in valid:
+                    jobs.append((key, job))
         # One batched liveness poll for the whole pass, BEFORE the phase
-        # split (the partition reads the freshly observed phases).
+        # split (the partition reads the freshly observed phases); its
+        # change report (None = runner doesn't track) gates the steady
+        # fast path below.
         self.runner.sync()
+        changed = self.runner.take_changed_keys()
         # Reset the pass-scoped scheduling state (priority reservations,
         # queue-usage cache) before admitting in priority order; close the
         # pass afterwards so solo syncs never see its stale state.
         self.reconciler.begin_pass()
-        t_serial = t_parallel = 0.0
+        t_serial = t_steady = 0.0
+        fast_skips = 0
+        steady: List[str] = []
+        self._pass_polled = {}
+        self._pass_fast_skipped = set()
+        self._pass_no += 1
         try:
-            steady: List[str] = []
+            serial: List[tuple] = []
+            for key, job in jobs:
+                # The merged steady gate, FIRST: a job whose generation
+                # still matches both fast-path records was steady AND
+                # unfinished at its last full reconcile; with no runner
+                # change and the touch()-exempt fields (suspend,
+                # elastic_policy) re-checked live, nothing the sync —
+                # or even is_finished — reads can have moved. One
+                # condition-list walk per job per pass is real money at
+                # 10k jobs.
+                gen = job.generation
+                if (
+                    changed is not None
+                    and key not in changed
+                    and self._steady_gen.get(key) == gen
+                    and self._steady_ok.get(key) == gen
+                    and not job.spec.run_policy.suspend
+                    and job.spec.elastic_policy is None
+                    and self._fast_skip(key, job)
+                ):
+                    fast_skips += 1
+                    self._pass_fast_skipped.add(key)
+                    any_active = True
+                    continue
+                if job.is_finished():
+                    self._gc_ttl(job, key, now)
+                    continue
+                needs = self._needs_scheduling(key, job)
+                if not needs:
+                    self._steady_ok[key] = gen
+                else:
+                    self._steady_ok.pop(key, None)
+                if not self.parallel_sync or needs:
+                    serial.append((key, job))
+                    continue
+                steady.append(key)
+            # Priority order matters only where capacity can be claimed
+            # — the serial scheduling phase. Sorting the WHOLE fleet
+            # per pass would be O(N log N) of pure overhead at 10k jobs.
+            serial.sort(
+                key=lambda kj: (
+                    -kj[1].spec.run_policy.scheduling_policy.priority,
+                    kj[1].status.submit_time or 0.0,
+                )
+            )
             t0 = time.perf_counter()
-            with obs.span("pass_serial", cat="supervisor", jobs=len(jobs)):
-                for key, job in jobs:
-                    if job.is_finished():
-                        self._gc_ttl(job, key, now)
-                        continue
-                    if not self.parallel_sync or self._needs_scheduling(
-                        key, job
-                    ):
-                        if self.reconciler.sync(key, now=now):
-                            any_active = True
-                    else:
-                        steady.append(key)
+            with obs.span("pass_serial", cat="supervisor", jobs=len(serial)):
+                for key, job in serial:
+                    if self._sync_guarded(key, now):
+                        any_active = True
             t_serial = time.perf_counter() - t0
             if steady:
                 t0 = time.perf_counter()
@@ -422,18 +656,76 @@ class Supervisor:
                 ):
                     for active in self._sync_parallel(steady, now):
                         any_active = any_active or active
-                t_parallel = time.perf_counter() - t0
+                t_steady = time.perf_counter() - t0
+                # Arm the fast path: these jobs just had a full
+                # reconcile with nothing to schedule; record the
+                # generation that reconcile left behind.
+                for key in steady:
+                    job = self.store.get(key)
+                    if job is not None and not job.is_finished():
+                        self._steady_gen[key] = job.generation
             if self.preempt_enabled:
                 self._maybe_preempt(jobs, now)
         finally:
             queue_usage = self.reconciler.end_pass()
+        if fast_skips:
+            self.metrics.steady_fast_skips.inc(fast_skips)
         self._update_gauges(jobs, queue_usage)
         m = self.metrics.sync_pass_seconds
         m.observe(t_serial, phase="serial")
-        if t_parallel:
-            m.observe(t_parallel, phase="parallel")
-        m.observe(time.perf_counter() - t_pass, phase="total")
+        if t_steady:
+            m.observe(t_steady, phase="steady")
+        t_total = time.perf_counter() - t_pass
+        m.observe(t_total, phase="total")
+        self.metrics.supervisor_pass_seconds.set(
+            t_total, supervisor=self.identity
+        )
+        # Latency-driven pool autoscaling: feed the measured steady
+        # phase; resize takes effect next pass.
+        self._resize_pool(self._pool_scaler.observe(t_steady, len(steady)))
         return any_active
+
+    def _sync_guarded(self, key: str, now: float) -> bool:
+        """Reconcile with the shard double-reconcile guard: a lease that
+        stopped being valid since the pass started (renewal fencing-
+        rejected, expiry mid-pass) refuses the sync — the new owner
+        reconciles the job; we must not race it."""
+        if self.shards is not None and not self._owns_key(key):
+            self.shards.io.guard_skips += 1
+            self.metrics.shard_guard_skips.inc()
+            return True  # still active; its new owner reconciles it
+        return self.reconciler.sync(key, now=now)
+
+    def _fast_skip(self, key: str, job: TPUJob) -> bool:
+        """The tail of the merged steady gate (the caller already
+        verified: runner unchanged, generation matches both fast-path
+        records, suspend/elastic clear): refuse when a time-driven rule
+        (active deadline, hang deadline) is armed, then check the one
+        remaining input — did the job's status files grow?"""
+        if job.spec.run_policy.active_deadline_seconds is not None:
+            return False
+        if HANG_DEADLINE_ANNOTATION in job.metadata.annotations:
+            return False
+        stagger = self._dir_empty.get(key)
+        if stagger is not None and (self._pass_no & 3) != stagger:
+            # The dir held no replica files at the last real scan: a
+            # never-reported job's first file appears at most 3 passes
+            # late on the telemetry surfaces (nothing else reads it),
+            # and 10k such jobs cost ~2.5k scandirs per pass, not 10k.
+            self._pass_polled[key] = {}
+            return True
+        tailer = self._progress
+        by_kind = tailer.poll(job_status_dir(self.reconciler.status_root, key))
+        self._pass_polled[key] = by_kind
+        if tailer.last_poll_consumed:
+            self._dir_empty.pop(key, None)
+            return False
+        if tailer.last_poll_files == 0:
+            if stagger is None:
+                self._dir_empty[key] = zlib.crc32(key.encode()) & 3
+        else:
+            self._dir_empty.pop(key, None)
+        return True
 
     def _needs_scheduling(self, key: str, job: TPUJob) -> bool:
         """Must this job sync in the serial scheduling phase? True when
@@ -460,22 +752,37 @@ class Supervisor:
                     return True
         return False
 
+    def _resize_pool(self, size: int) -> None:
+        """Apply an autoscaler decision. The pool is idle between passes
+        (observe() runs after the steady phase drained), so a resize is
+        a cheap shutdown + lazy re-create; same-size calls are free."""
+        self._sync_workers = size
+        self.metrics.sync_pool_size.set(size)
+        self.metrics.sync_pool_max.set(self._pool_scaler.ceiling)
+        with self._sync_pool_lock:
+            if self._sync_pool is not None and self._sync_pool_size != size:
+                pool, self._sync_pool = self._sync_pool, None
+            else:
+                return
+        pool.shutdown(wait=True)
+
     def _sync_parallel(self, keys: List[str], now: float) -> List[bool]:
         """Fan steady-state reconciles across the bounded pool, in chunks
         so pool overhead stays O(workers), not O(jobs). Exceptions
         propagate like the serial loop's (first one wins)."""
         if len(keys) <= 1 or self._sync_workers <= 1:
-            return [self.reconciler.sync(k, now=now) for k in keys]
+            return [self._sync_guarded(k, now) for k in keys]
         with self._sync_pool_lock:
             if self._sync_pool is None:
                 self._sync_pool = ThreadPoolExecutor(
                     max_workers=self._sync_workers,
                     thread_name_prefix="tpujob-sync",
                 )
+                self._sync_pool_size = self._sync_workers
             pool = self._sync_pool
 
         def run_chunk(chunk: List[str]) -> List[bool]:
-            return [self.reconciler.sync(k, now=now) for k in chunk]
+            return [self._sync_guarded(k, now) for k in chunk]
 
         n_chunks = min(len(keys), 2 * self._sync_workers)
         step = (len(keys) + n_chunks - 1) // n_chunks
@@ -500,6 +807,28 @@ class Supervisor:
         if inj is None:
             return
         self._fault_pass += 1
+        if inj.supervisor_kill_due(self._fault_pass, self.identity):
+            self.events.warning(
+                SHARD_EVENT_KEY,
+                "FaultInjected",
+                f"injected supervisor kill of {self.identity} "
+                f"(pass {self._fault_pass}).",
+            )
+            if self.fault_kill_action is not None:
+                self.fault_kill_action()
+            else:
+                os._exit(137)  # a real daemon dies without cleanup
+        if self.shards is not None:
+            for f in inj.lease_drops_due(
+                self._fault_pass, self.shards.owned
+            ):
+                dropped = self.shards.inject_drop(f.target)
+                self.events.warning(
+                    SHARD_EVENT_KEY,
+                    "FaultInjected",
+                    f"injected on-disk lease drop of shard(s) {dropped} "
+                    f"held by {self.identity} ({f.label()}).",
+                )
         for f in inj.kills_due(self._fault_pass):
             for h in self.runner.list_all():
                 if h.is_active() and faults.FaultInjector.target_matches(
@@ -516,10 +845,26 @@ class Supervisor:
         """Point-in-time scheduler state for /metrics, refreshed per pass
         from the pass's own accounting (no rescans)."""
         m = self.metrics
-        m.jobs_active.set(sum(1 for _, j in jobs if not j.is_finished()))
-        active = [h for h in self.runner.list_all() if h.is_active()]
-        m.replicas_active.set(len(active))
-        m.slots_used.set(sum(h.slots for h in active))
+        # Fast-skipped jobs are unfinished by construction (the pass
+        # loop checked); walking every job's conditions again tripled
+        # the is_finished cost per pass at 10k jobs.
+        skipped = self._pass_fast_skipped
+        m.jobs_active.set(
+            len(skipped)
+            + sum(
+                1
+                for key, j in jobs
+                if key not in skipped and not j.is_finished()
+            )
+        )
+        n_active = 0
+        slots_used = 0
+        for h in self.runner.list_all():
+            if h.is_active():
+                n_active += 1
+                slots_used += h.slots
+        m.replicas_active.set(n_active)
+        m.slots_used.set(slots_used)
         m.slots_capacity.set(self.runner.capacity_slots() or 0)
         m.gangs_held.set(len(self.reconciler.held_gangs()))
         m.queue_slots_used.clear()
@@ -528,6 +873,21 @@ class Supervisor:
             for qname, cap in self.reconciler.queue_slots.items():
                 m.queue_slots_capacity.set(cap, queue=qname)
                 m.queue_slots_used.set(queue_usage.get(qname, 0), queue=qname)
+        if self.shards is not None:
+            m.shards_owned.set(len(self.shards.owned))
+            m.shard_jobs.clear()
+            per_shard: dict = {}
+            cache = self._shard_cache
+            for key, j in jobs:
+                if key in skipped or not j.is_finished():
+                    s = cache.get(key)
+                    if s is None:
+                        s = self._job_shard(key)
+                    per_shard[s] = per_shard.get(s, 0) + 1
+            for s, n in per_shard.items():
+                m.shard_jobs.set(
+                    n, shard=str(s), supervisor=self.identity
+                )
         self._update_progress_gauges(jobs)
         # End-of-pass cross-job rule (noisy-neighbor attribution needs
         # every job's verdict from THIS pass), then the alert gauges.
@@ -578,8 +938,18 @@ class Supervisor:
         root = self.reconciler.status_root
         if root is None:
             return
+        skipped = self._pass_fast_skipped
+        polled = self._pass_polled
         for key, job in jobs:
-            if job.is_finished():
+            if key in skipped and not polled.get(key, True):
+                # Fast-skipped with an EMPTY poll stash: the job has
+                # never produced a status record (the tailer state is
+                # empty, not just quiet), so there is nothing to fold,
+                # observe, or probe — skip the whole body. At 10k
+                # never-reporting jobs this loop is otherwise the
+                # biggest residual per-pass cost.
+                continue
+            if key not in skipped and job.is_finished():
                 # Close the live-alert lifecycle: anything still firing
                 # resolves (logged) so the postmortem sees it closed by
                 # the finish, not dangling. Idempotent after the first
@@ -587,7 +957,13 @@ class Supervisor:
                 self.watch.finalize(key)
                 continue
             status_dir = job_status_dir(root, key)
-            by_kind = self._progress.poll(status_dir)
+            if key in self._pass_polled:
+                # The fast-path gate already polled this dir this pass;
+                # poll() returns latest-known state, so the stash is
+                # exactly what a second (wasted) scan would return.
+                by_kind = self._pass_polled[key]
+            else:
+                by_kind = self._progress.poll(status_dir)
             by_replica = self._progress.replica_latest(status_dir)
             self._record_clock_observations(key, status_dir, by_replica)
             # Live health engine: fold the same already-tailed state
@@ -805,6 +1181,10 @@ class Supervisor:
         registry bounded (pinned by tests/test_obs_analyze.py)."""
         self.metrics.retire_job(key)
         self.watch.retire_job(key)
+        self._steady_gen.pop(key, None)
+        self._steady_ok.pop(key, None)
+        self._dir_empty.pop(key, None)
+        self._shard_cache.pop(key, None)
         self._hb_observed.pop(key, None)
         self._ckpt_observed.pop(key, None)
         self._clock_logs.pop(key, None)
@@ -914,9 +1294,21 @@ class Supervisor:
                     key, "TPUJobScaleRejected", f"scale to {workers} rejected: {e}"
                 )
 
+    def metrics_file_path(self) -> Path:
+        """Unsharded daemons keep the historical ``metrics.prom``; a
+        sharded supervisor writes ``metrics-<identity>.prom`` so N
+        daemons on one state dir don't clobber each other — observer
+        surfaces (`tpujob top`, `metrics`, `why`) read the union."""
+        if self.shards is None:
+            return self.state_dir / "metrics.prom"
+        import re as _re
+
+        safe = _re.sub(r"[^A-Za-z0-9._-]", "_", self.identity)
+        return self.state_dir / f"metrics-{safe}.prom"
+
     def write_metrics_file(self) -> None:
         """Expose counters for ``tpujob metrics`` (monitoring-port analog)."""
-        (self.state_dir / "metrics.prom").write_text(self.metrics.render_text())
+        self.metrics_file_path().write_text(self.metrics.render_text())
 
     def shutdown(self) -> None:
         with self._sync_pool_lock:
@@ -925,6 +1317,10 @@ class Supervisor:
             pool.shutdown(wait=True)
         if isinstance(self.runner, SubprocessRunner):
             self.runner.shutdown()
+        if self.shards is not None:
+            # Voluntary drain: hand every shard back NOW so survivors
+            # rebalance immediately instead of waiting out the TTL.
+            self.shards.drain()
         if self.lease is not None:
             self.lease.release()
 

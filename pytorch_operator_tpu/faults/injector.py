@@ -201,6 +201,40 @@ class FaultInjector:
                     out.append(f)
         return out
 
+    def supervisor_kill_due(self, pass_index: int, identity: str) -> bool:
+        """kill_supervisor: whether THIS supervisor dies at this pass.
+        ``target`` matches the supervisor identity (fnmatch) or ``*``;
+        consumed only by the supervisor it targets, so a plan shared by
+        two in-process supervisors kills exactly the named one."""
+        with self._lock:
+            for i, f in self._candidates("kill_supervisor"):
+                if f.at == pass_index and (
+                    f.target == "*" or fnmatch.fnmatch(identity, f.target)
+                ):
+                    self._consume(i, f)
+                    return True
+        return False
+
+    def lease_drops_due(self, pass_index: int, owned_shards) -> List[Fault]:
+        """drop_lease faults scheduled for this supervisor pass whose
+        ``target`` (a shard id, or ``*``) names a shard THIS supervisor
+        owns — only the holder can meaningfully drop the lease, and a
+        plan shared by several in-process supervisors must be consumed
+        by the right one."""
+        out = []
+        with self._lock:
+            for i, f in self._candidates("drop_lease"):
+                if f.at != pass_index:
+                    continue
+                if f.target == "*":
+                    if not owned_shards:
+                        continue
+                elif not any(f.target == str(s) for s in owned_shards):
+                    continue
+                self._consume(i, f)
+                out.append(f)
+        return out
+
     # ---- serving site ----
 
     def engine_step_fault(self) -> Optional[Fault]:

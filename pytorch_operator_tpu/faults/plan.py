@@ -40,6 +40,19 @@ Fault kinds (``Fault.kind``):
                              step
 - ``kill_replica``           controller-side: SIGKILL the target replica
                              at supervisor pass ``at`` (preemption model)
+- ``kill_supervisor``        controller-side: the targeted SUPERVISOR
+                             (``target`` = supervisor identity or ``*``)
+                             dies abruptly at its pass ``at`` — shard
+                             leases stop renewing and expire; the
+                             failover acceptance is the surviving
+                             supervisors re-claiming the orphaned
+                             shards within one lease TTL
+- ``drop_lease``             controller-side: force-expire the holder's
+                             shard lease ON DISK at pass ``at``
+                             (``target`` = shard id or ``*``) without
+                             telling the holder — the stale-holder
+                             scenario; its next renew must be
+                             fencing-rejected while a rival claims
 - ``fail_spawn``             controller-side: the ``nth`` spawn of the
                              target replica fails at launch
 - ``torn_state_write``       controller-side: the next persisted write of
@@ -73,6 +86,8 @@ KINDS = frozenset(
         "torn_checkpoint_write",
         "enospc_checkpoint_write",
         "kill_replica",
+        "kill_supervisor",
+        "drop_lease",
         "fail_spawn",
         "torn_state_write",
         "fail_engine_step",
@@ -214,6 +229,10 @@ JOB_TARGET_KINDS = frozenset({"torn_state_write"})
 # engine has no replica identity at the step hook).
 UNTARGETED_KINDS = frozenset({"fail_engine_step"})
 
+# Fault kinds whose ``target`` names a SUPERVISOR identity or shard id —
+# nothing a job spec can address, so the plan-vs-spec lint skips them.
+SUPERVISOR_TARGET_KINDS = frozenset({"kill_supervisor", "drop_lease"})
+
 
 def validate_against_job(plan: "FaultPlan", job) -> List[str]:
     """Lint a plan against a TPUJob spec: a fault whose ``target``
@@ -242,7 +261,11 @@ def validate_against_job(plan: "FaultPlan", job) -> List[str]:
             replica_ids.append((rtype.value, index))
     warnings: List[str] = []
     for f in plan.faults:
-        if f.kind in UNTARGETED_KINDS or f.target == "*":
+        if (
+            f.kind in UNTARGETED_KINDS
+            or f.kind in SUPERVISOR_TARGET_KINDS
+            or f.target == "*"
+        ):
             continue
         if f.kind in JOB_TARGET_KINDS:
             if f.target != key:

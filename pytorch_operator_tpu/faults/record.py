@@ -24,6 +24,11 @@ express are captured:
   records) map to ``fail_checkpoint_write`` — or the persistent
   ``enospc_checkpoint_write`` when the recorded error names ENOSPC /
   "no space";
+- a shard hand-off whose acquisition event cites "after lease expiry
+  of <holder>" (the sharded control plane's takeover-after-death path)
+  maps to ``kill_supervisor`` targeting the dead holder at pass 1 —
+  replaying the plan against a two-supervisor cell re-exercises the
+  same failover;
 - a recorded rendezvous stall (``fault_stall`` records exist only for
   injected stalls, but a join that measurably exceeded the gang's is
   not reconstructable — skipped).
@@ -40,6 +45,7 @@ from typing import List, Optional
 from .plan import Fault, FaultPlan
 
 _EXIT_RE = re.compile(r"replica (\S+) failed with exit code (\d+)")
+_TAKEOVER_RE = re.compile(r"after lease expiry of (\S+?)\.?$")
 
 
 def _replica_target(name: str, key: str) -> str:
@@ -113,6 +119,19 @@ def plan_from_recording(state_dir, key: str) -> FaultPlan:
                 target=str(rec.get("replica", "*")),
                 nth=int(rec.get("save_index", i) or i),
             )
+        )
+
+    # ---- shard takeover-after-death -> kill_supervisor ----
+    seen_dead = set()
+    for e in tl.events:
+        if e.get("reason") != "ShardAcquired":
+            continue
+        m = _TAKEOVER_RE.search(str(e.get("message", "")))
+        if not m or m.group(1) in seen_dead:
+            continue
+        seen_dead.add(m.group(1))
+        faults.append(
+            Fault(kind="kill_supervisor", target=m.group(1), at=1)
         )
 
     seed = sum(ord(c) for c in key) % 1000

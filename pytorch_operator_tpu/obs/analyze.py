@@ -241,6 +241,13 @@ def build_timeline(
     events = load_merged_events(
         state / "events" / (fs + ".events.jsonl")
     )
+    # Sharded control plane: the shard event log is GLOBAL (one bounded
+    # sink, not one per job) — fold in the hand-offs of THIS job's
+    # shard so the postmortem can cite an ownership change ("the
+    # supervisor reconciling this job died at t; shard re-claimed at
+    # t+ttl").
+    events = events + shard_events_for_job(state, key)
+    events.sort(key=lambda e: float(e.get("timestamp", 0.0)))
 
     # Spans (optional): replica files aligned by the estimator, the
     # supervisor's own files are already in the reference frame.
@@ -277,6 +284,39 @@ def _replica_of_trace_file(path) -> Optional[str]:
     from .clock import _trace_file_replica
 
     return _trace_file_replica(path)
+
+
+def shard_events_for_job(state_dir, key: str) -> List[dict]:
+    """Shard hand-off events affecting ``key``'s shard, from the global
+    shard event sink (controller/leases.py SHARD_EVENT_KEY). Empty when
+    the control plane never ran sharded. The job's shard is resolved
+    exactly like the supervisor does: spec pin if set, else key hash."""
+    from ..controller.events import load_merged_events
+    from ..controller.leases import SHARD_EVENT_KEY, shard_of_key
+    from ..controller.store import JobStore, key_to_fs
+
+    state = Path(state_dir)
+    from ..controller.leases import read_shard_config
+
+    num_shards = read_shard_config(state)
+    if not num_shards:
+        return []
+    pin = None
+    job = JobStore(persist_dir=state / "jobs").get(key)
+    if job is not None:
+        pin = job.spec.run_policy.scheduling_policy.shard
+    shard = shard_of_key(key, num_shards, pin)
+    needle = f"shard {shard} "
+    out = []
+    for ev in load_merged_events(
+        state / "events" / (key_to_fs(SHARD_EVENT_KEY) + ".events.jsonl")
+    ):
+        msg = str(ev.get("message", ""))
+        if needle in msg or f"shard(s) [{shard}]" in msg:
+            ev = dict(ev)
+            ev["shard"] = shard
+            out.append(ev)
+    return out
 
 
 # ---- the engine ----
@@ -322,8 +362,9 @@ def analyze(
     # cell's latest span id per histogram, so the report can say WHICH
     # span landed the tail.
     exemplars: Dict[str, List[dict]] = {}
-    prom = Path(state_dir) / "metrics.prom"
-    if prom.exists():
+    # metrics.prom (unsharded) or one metrics-<identity>.prom per
+    # sharded supervisor — the job's series live in its owner's file.
+    for prom in sorted(Path(state_dir).glob("metrics*.prom")):
         try:
             for name, rows in parse_exemplars(prom.read_text()).items():
                 hits = [
@@ -333,7 +374,7 @@ def analyze(
                     if labels.get("job") == key
                 ]
                 if hits:
-                    exemplars[name] = hits
+                    exemplars.setdefault(name, []).extend(hits)
         except OSError:
             pass
 
@@ -344,6 +385,21 @@ def analyze(
     from .watch import load_alert_log
 
     alerts = load_alert_log(state_dir, key)
+
+    # Control-plane ownership history for this job's shard: who was
+    # reconciling it, and when that changed (lease expiry after a
+    # supervisor death, rebalance, injected drop) — the citation for
+    # "nothing reconciled this job between t and t+ttl".
+    shard_handoffs = [
+        {
+            "ts": float(e.get("timestamp", 0.0)),
+            "reason": e.get("reason"),
+            "message": e.get("message"),
+            "shard": e.get("shard"),
+        }
+        for e in shard_events_for_job(state_dir, key)
+        if tl.in_window(float(e.get("timestamp", 0.0)))
+    ]
 
     replicas = {
         replica: {
@@ -367,6 +423,7 @@ def analyze(
         "spans": len(tl.spans),
         "exemplars": exemplars,
         "alerts": alerts,
+        "shard_handoffs": shard_handoffs,
         "findings": [f.to_dict() for f in findings],
     }
 
@@ -430,7 +487,7 @@ def render_report(report: dict) -> str:
         lines.append("clock:    " + "; ".join(parts))
     alerts = report.get("alerts", [])
     findings = report.get("findings", [])
-    if not findings and not alerts:
+    if not findings and not alerts and not report.get("shard_handoffs"):
         lines.append("")
         lines.append("no findings — the recorded window looks healthy.")
         return "\n".join(lines)
@@ -458,5 +515,17 @@ def render_report(report: dict) -> str:
                 f"{rec.get('rule', '?')} {who} @ "
                 f"{float(rec.get('ts', 0.0)):.3f}  "
                 f"{rec.get('summary', '')}"
+            )
+    handoffs = report.get("shard_handoffs", [])
+    if handoffs:
+        lines.append("")
+        lines.append(
+            f"SHARD OWNERSHIP ({len(handoffs)} hand-off event(s) for "
+            f"shard {handoffs[0].get('shard')}):"
+        )
+        for rec in handoffs:
+            lines.append(
+                f"  {rec.get('reason', '?'):<16} @ "
+                f"{float(rec.get('ts', 0.0)):.3f}  {rec.get('message', '')}"
             )
     return "\n".join(lines)

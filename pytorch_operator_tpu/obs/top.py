@@ -33,6 +33,7 @@ STEP_HIST = "tpujob_step_time_seconds"
 # cannot drift. Row keys index the dicts gather_rows returns.
 COLUMNS = (
     ("JOB", "job"),
+    ("SHARD", "shard"),
     ("STEP", "step"),
     ("STEPS/S", "steps_per_sec"),
     ("P50(ms)", "p50_ms"),
@@ -88,20 +89,41 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
     now = time.time() if now is None else now
     metrics: Dict = {}
     exemplars: Dict = {}
-    prom = state / "metrics.prom"
-    if prom.exists():
+    # Union across daemons: one metrics.prom (unsharded) or one
+    # metrics-<identity>.prom per sharded supervisor — each job's
+    # series exist only in its owner's file, so merging is a union.
+    for prom in sorted(state.glob("metrics*.prom")):
         try:
             text = prom.read_text()
-            metrics = parse_prometheus_text(text)
-            exemplars = parse_exemplars(text)
         except OSError:
-            pass
+            continue
+        for name, rows_ in parse_prometheus_text(text).items():
+            metrics.setdefault(name, []).extend(rows_)
+        for name, rows_ in parse_exemplars(text).items():
+            exemplars.setdefault(name, []).extend(rows_)
+    # Sharded control plane: which shard each job hashes to and who
+    # holds its lease right now (the SHARD column; None when unsharded).
+    from ..controller.leases import (
+        read_shard_config,
+        read_shard_owners,
+        shard_of_key,
+    )
+
+    num_shards = read_shard_config(state)
+    shard_owners = read_shard_owners(state) if num_shards else {}
     store = JobStore(persist_dir=state / "jobs")
     rows: List[dict] = []
     for job in store.list():
         if job.is_finished():
             continue
         key = job_key(job)
+        shard = (
+            shard_of_key(
+                key, num_shards, job.spec.run_policy.scheduling_policy.shard
+            )
+            if num_shards
+            else None
+        )
         d = job_status_dir(state / "status", key)
         hb = read_latest_event(d, "progress") or {}
         ck = read_latest_event(d, "checkpoint_committed") or {}
@@ -120,6 +142,10 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
         rows.append(
             {
                 "job": key,
+                "shard": shard,
+                "shard_owner": (
+                    shard_owners.get(shard) if shard is not None else None
+                ),
                 "step": step,
                 "steps_per_sec": hb.get("steps_per_sec"),
                 "p50_ms": 1000 * q[0] if q else None,
@@ -195,9 +221,20 @@ def _fmt(v, spec: str = "", dash: str = "-") -> str:
     return format(v, spec) if spec else str(v)
 
 
+def _shard_cell(r: dict) -> str:
+    """``<shard>@<owner>`` (owner truncated), ``<shard>@?`` for an
+    orphaned shard mid-failover, ``-`` when the control plane is
+    unsharded."""
+    if r.get("shard") is None:
+        return "-"
+    owner = r.get("shard_owner")
+    return f"{r['shard']}@{owner[:12] if owner else '?'}"
+
+
 def _cells(r: dict) -> tuple:
     return (
         r["job"],
+        _shard_cell(r),
         _fmt(None if r["step"] is None else int(r["step"])),
         _fmt(r["steps_per_sec"], ".2f"),
         _fmt(r["p50_ms"], ".1f"),
@@ -279,6 +316,14 @@ def diff_rows(prev: List[dict], rows: List[dict]) -> List[str]:
         pa, ca = p.get("age_s"), c.get("age_s")
         if pa is not None and ca is not None and ca > max(3 * pa, pa + 2.0):
             changes.append(f"hb age {pa:.0f}s→{ca:.0f}s (going silent?)")
+        if (
+            c.get("shard") is not None
+            and p.get("shard_owner") != c.get("shard_owner")
+        ):
+            changes.append(
+                f"shard {c['shard']} owner "
+                f"{p.get('shard_owner') or '?'}→{c.get('shard_owner') or '?'}"
+            )
         prev_alerts = set(p.get("alert_rules") or ())
         cur_alerts = set(c.get("alert_rules") or ())
         for rule in sorted(cur_alerts - prev_alerts):

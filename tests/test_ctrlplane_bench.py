@@ -30,6 +30,72 @@ def cell(result, mode):
     return next(c for c in result["cells"] if c["mode"] == mode)
 
 
+@pytest.fixture(scope="module")
+def sharded_result(tmp_path_factory):
+    """A real two-supervisor sharded cell at smoke scale: one shared
+    state dir, per-shard leases, each supervisor running the full
+    daemon loop body."""
+    td = tmp_path_factory.mktemp("ctrlplane-sharded")
+    return ctrlplane_bench.bench_sharded(
+        40, 2, 6, td, lease_ttl=1.0, log=lambda *_: None
+    )
+
+
+@pytest.fixture(scope="module")
+def churn_result(tmp_path_factory):
+    td = tmp_path_factory.mktemp("ctrlplane-churn")
+    return ctrlplane_bench.bench_sharded(
+        24, 2, 4, td, replicas=3, churn_markers=8, lease_ttl=1.0,
+        log=lambda *_: None,
+    )
+
+
+class TestShardedSmoke:
+    def test_no_job_is_double_reconciled(self, sharded_result):
+        # THE exactly-once pin: under a 2-supervisor split, no job ever
+        # has live worlds in both runners.
+        assert sharded_result["double_reconciles"] == 0
+
+    def test_every_job_has_exactly_one_owner(self, sharded_result):
+        assert sum(sharded_result["jobs_per_supervisor"]) == 40
+        assert all(n > 0 for n in sharded_result["jobs_per_supervisor"])
+
+    def test_idle_store_io_is_zero_per_shard_owner(self, sharded_result):
+        # The zero-idle-I/O invariant survives the shard split: each
+        # supervisor's idle pass reads/writes NO job files for its
+        # shards (lease renewals live outside the store on purpose).
+        assert sharded_result["idle_reads_per_pass_per_supervisor"] == [0, 0]
+        assert sharded_result["idle_writes_per_pass_per_supervisor"] == [0, 0]
+
+    def test_autoscaler_respects_its_bounds(self, sharded_result):
+        # Pool never exceeds --sync-workers-max, and an idle fleet
+        # shrinks it back to the floor.
+        assert (
+            sharded_result["sync_pool_max_seen"]
+            <= sharded_result["sync_pool_ceiling"]
+        )
+        assert (
+            sharded_result["sync_pool_final"]
+            == sharded_result["sync_pool_floor"]
+        )
+
+    def test_drain_completes_across_supervisors(self, sharded_result):
+        assert sharded_result["unfinished_after_drain"] == 0
+
+    def test_shard_split_is_disjoint_and_complete(self, sharded_result):
+        split = sharded_result["shard_split"]
+        all_shards = [s for owned in split.values() for s in owned]
+        assert sorted(all_shards) == list(range(sharded_result["shards"]))
+
+    def test_churn_cell_stays_exactly_once_with_wide_gangs(self, churn_result):
+        # Marker storms (rename-claimed across two supervisors) on
+        # 3-replica gangs: still no double worlds, still drains clean.
+        assert churn_result["double_reconciles"] == 0
+        assert churn_result["unfinished_after_drain"] == 0
+        assert churn_result["churn_passes"] > 0
+        assert churn_result["churn_pass_ms_p50"] > 0
+
+
 class TestBenchSmoke:
     def test_cached_idle_pass_does_zero_job_file_io(self, smoke_result):
         cached = cell(smoke_result, "cached")

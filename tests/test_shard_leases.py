@@ -1,0 +1,578 @@
+"""Shard-lease races, rebalance, failover, and the steady-pool autoscaler.
+
+Grown from tests/test_store_cache.py::TestMarkerExactlyOnce: the same
+two-supervisors-one-dir discipline, applied to the job-space leases the
+sharded control plane runs on (controller/leases.py). The contracts
+under test:
+
+- renewal-vs-expiry interleavings: a renew after expiry NEVER quietly
+  overwrites a stealer; it goes through the contended acquire path;
+- fencing: a stale holder's writes are rejected once a rival bumped the
+  token (drop_lease / partition scenarios);
+- simultaneous claim by two joiners is exactly-once (O_EXCL claim file);
+- drain-then-rejoin rebalances within a tick, death within one TTL;
+- the chaos-driven failover e2e: kill one of two supervisors mid-pass,
+  the orphaned shards are re-claimed within one lease TTL, and no job
+  ends up with two live worlds.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from pytorch_operator_tpu.controller.autoscale import PoolAutoscaler
+from pytorch_operator_tpu.controller.leases import (
+    ShardLease,
+    ShardManager,
+    read_shard_config,
+    read_shard_owners,
+    shard_of_key,
+)
+
+T0 = 1_000_000.0  # synthetic clock origin — no wall-clock in the units
+
+
+def lease(tmp_path, shard=0, who="a", ttl=5.0):
+    d = tmp_path / "leases"
+    d.mkdir(parents=True, exist_ok=True)
+    return ShardLease(d, shard, who, ttl=ttl)
+
+
+def manager(tmp_path, who, shards=4, ttl=5.0):
+    # auto_renew=False: the units drive tick(now) on a synthetic clock;
+    # a real-time renewal thread would fight the test's sense of time.
+    return ShardManager(
+        tmp_path, shards, identity=who, ttl=ttl, auto_renew=False
+    )
+
+
+class TestShardLease:
+    def test_claim_free_shard_starts_token_at_one(self, tmp_path):
+        a = lease(tmp_path, who="a")
+        assert a.try_acquire(T0)
+        assert a.token == 1
+        rec = json.loads(a.path.read_text())
+        assert rec["holder"] == "a"
+        assert rec["token"] == 1
+        assert rec["expires"] == pytest.approx(T0 + 5.0)
+
+    def test_validly_held_shard_rejects_a_rival(self, tmp_path):
+        a, b = lease(tmp_path, who="a"), lease(tmp_path, who="b")
+        assert a.try_acquire(T0)
+        assert not b.try_acquire(T0 + 1.0)
+        assert b.token == 0
+
+    def test_renewal_extends_without_bumping_the_token(self, tmp_path):
+        a = lease(tmp_path, who="a")
+        a.try_acquire(T0)
+        assert a.renew(T0 + 2.0)
+        assert a.token == 1
+        assert a.expires == pytest.approx(T0 + 7.0)
+
+    def test_renew_after_expiry_is_refused_not_overwriting(self, tmp_path):
+        """THE renewal-vs-expiry interleaving: once its lease expired,
+        a holder may not renew-in-place (a stealer may already own the
+        path) — it must drop and re-contend."""
+        a = lease(tmp_path, who="a")
+        a.try_acquire(T0)
+        assert not a.renew(T0 + 6.0)  # ttl=5: expired
+        assert a.token == 0
+
+    def test_steal_of_expired_lease_bumps_fencing_token(self, tmp_path):
+        a, b = lease(tmp_path, who="a"), lease(tmp_path, who="b")
+        a.try_acquire(T0)
+        assert b.try_acquire(T0 + 6.0)  # expired -> stealable
+        assert b.token == 2
+        assert b.takeover_from == "a"
+
+    def test_fencing_rejects_stale_holders_write(self, tmp_path):
+        """drop_lease scenario: the on-disk lease is force-expired under
+        a live holder; a rival claims (token+1); the stale holder's
+        next renew must be REJECTED and must not clobber the rival."""
+        a, b = lease(tmp_path, who="a"), lease(tmp_path, who="b")
+        a.try_acquire(T0)
+        a.force_expire()  # disk says expired; a's memory says held
+        assert b.try_acquire(T0 + 0.1)
+        assert b.token == 2
+        # a still believes it holds (in-memory unexpired) — the write
+        # path must notice the token moved.
+        assert not a.renew(T0 + 1.0)
+        assert a.token == 0
+        rec = json.loads(b.path.read_text())
+        assert (rec["holder"], rec["token"]) == ("b", 2)
+
+    def test_simultaneous_claim_by_two_joiners_exactly_once(self, tmp_path):
+        """Two joiners race try_acquire on a free shard; the O_EXCL
+        claim file hands it to exactly one — every round."""
+        for round_ in range(10):
+            a = lease(tmp_path, shard=round_, who="a")
+            b = lease(tmp_path, shard=round_, who="b")
+            results = {}
+            barrier = threading.Barrier(2)
+
+            def claim(lz, tag):
+                barrier.wait()
+                results[tag] = lz.try_acquire(T0)
+
+            ts = [
+                threading.Thread(target=claim, args=(a, "a")),
+                threading.Thread(target=claim, args=(b, "b")),
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(10)
+            assert sorted(results.values()) == [False, True], results
+
+    def test_release_keeps_the_token_monotonic(self, tmp_path):
+        a, b = lease(tmp_path, who="a"), lease(tmp_path, who="b")
+        a.try_acquire(T0)
+        a.release(T0 + 1.0)
+        assert b.try_acquire(T0 + 1.1)  # released -> immediately claimable
+        assert b.token == 2  # monotonic across release->claim
+        assert b.takeover_from is None  # voluntary hand-back, not a death
+
+    def test_own_surviving_lease_reattaches_on_restart(self, tmp_path):
+        a = lease(tmp_path, who="a")
+        a.try_acquire(T0)
+        a2 = lease(tmp_path, who="a")  # same identity, fresh process
+        assert a2.try_acquire(T0 + 1.0)
+        assert a2.token == 1  # reattached, no ownership change
+
+
+class TestShardManager:
+    def test_single_manager_claims_every_shard(self, tmp_path):
+        a = manager(tmp_path, "a")
+        changes = a.tick(T0)
+        assert sorted(changes["acquired"]) == [0, 1, 2, 3]
+        assert a.owns_key("default/x", T0 + 1.0)
+
+    def test_two_managers_split_disjoint_and_complete(self, tmp_path):
+        a, b = manager(tmp_path, "a"), manager(tmp_path, "b")
+        # Interleave ticks until stable (presence discovery -> release
+        # -> claim takes a few rounds).
+        for i in range(6):
+            a.tick(T0 + i * 0.1)
+            b.tick(T0 + i * 0.1)
+        assert a.owned | b.owned == {0, 1, 2, 3}
+        assert not (a.owned & b.owned)
+        assert len(a.owned) == len(b.owned) == 2
+
+    def test_join_rebalances_within_one_ttl(self, tmp_path):
+        a = manager(tmp_path, "a", ttl=5.0)
+        a.tick(T0)
+        assert len(a.owned) == 4
+        b = manager(tmp_path, "b", ttl=5.0)
+        # Everything below happens within ONE ttl of synthetic time.
+        b.tick(T0 + 0.1)  # announces presence; nothing claimable yet
+        a.tick(T0 + 0.2)  # sees b -> releases down to fair share
+        changes = b.tick(T0 + 0.3)  # claims the released shards
+        assert len(changes["acquired"]) == 2
+        assert a.owned | b.owned == {0, 1, 2, 3}
+        assert not (a.owned & b.owned)
+
+    def test_supervisor_death_fails_over_within_one_ttl(self, tmp_path):
+        ttl = 5.0
+        a, b = manager(tmp_path, "a", ttl=ttl), manager(tmp_path, "b", ttl=ttl)
+        for i in range(6):
+            a.tick(T0 + i * 0.1)
+            b.tick(T0 + i * 0.1)
+        dead = set(a.owned)
+        # a dies at T0+1: stops ticking/renewing. b keeps ticking (its
+        # own leases stay renewed); by T0+1+ttl a's leases are
+        # stealable — the orphan rescue claims them on b's next tick,
+        # within one TTL of a's last renewal.
+        b.tick(T0 + 2.0)
+        b.tick(T0 + 4.0)
+        assert len(b.owned) == 2  # nothing stealable yet
+        t_rec = T0 + 1.0 + ttl + 0.1
+        changes = b.tick(t_rec)
+        assert set(changes["acquired"]) == dead
+        assert b.owned == {0, 1, 2, 3}
+
+    def test_drain_then_rejoin(self, tmp_path):
+        a, b = manager(tmp_path, "a"), manager(tmp_path, "b")
+        for i in range(6):
+            a.tick(T0 + i * 0.1)
+            b.tick(T0 + i * 0.1)
+        released = b.drain(T0 + 1.0)
+        assert released and not b.owned
+        # a picks the drained shards up immediately (released, not
+        # expired — no TTL wait).
+        a.tick(T0 + 1.1)
+        assert a.owned == {0, 1, 2, 3}
+        # rejoin: a fresh manager with the same identity rebalances back.
+        b2 = manager(tmp_path, "b")
+        b2.tick(T0 + 2.0)
+        a.tick(T0 + 2.1)
+        b2.tick(T0 + 2.2)
+        assert a.owned | b2.owned == {0, 1, 2, 3}
+        assert not (a.owned & b2.owned)
+        assert len(b2.owned) == 2
+
+    def test_lost_lease_surfaces_through_tick(self, tmp_path):
+        a = manager(tmp_path, "a", ttl=5.0)
+        a.tick(T0)
+        # Force-expire everything on disk (the drop_lease fault), let a
+        # rival steal one, then tick a at renew time: losses reported.
+        a.inject_drop("*")
+        b = manager(tmp_path, "b", ttl=5.0)
+        b.tick(T0 + 0.5)
+        changes = a.tick(T0 + 3.0)  # past ttl/2: renewal due -> fencing
+        assert changes["lost"], changes
+        assert not (a.owned & b.owned)
+
+    def test_shard_count_mismatch_is_rejected(self, tmp_path):
+        manager(tmp_path, "a", shards=4)
+        with pytest.raises(ValueError, match="sharded 4 ways"):
+            manager(tmp_path, "b", shards=8)
+
+    def test_observer_helpers_read_config_and_owners(self, tmp_path):
+        a = manager(tmp_path, "a")
+        a.tick(T0)
+        assert read_shard_config(tmp_path) == 4
+        # Owners are judged against the REAL clock; re-acquire with
+        # real time so the observer sees live leases.
+        for i in list(a.owned):
+            a.leases[i].release(time.time())
+        a.owned.clear()
+        a.tick(time.time())
+        owners = read_shard_owners(tmp_path)
+        assert set(owners.values()) == {"a"}
+
+    def test_spec_pin_overrides_the_hash(self):
+        assert shard_of_key("default/j", 8, pin=13) == 13 % 8
+        assert 0 <= shard_of_key("default/j", 8) < 8
+
+
+class TestPoolAutoscaler:
+    def test_grows_on_latency_and_respects_ceiling(self):
+        s = PoolAutoscaler(floor=2, ceiling=16, target_s=0.1)
+        # 2 workers took 1.6s over plenty of jobs -> work = 3.2s ->
+        # wants 32, clamped to ceiling.
+        assert s.observe(1.6, 5000) == 16
+        for _ in range(50):
+            assert s.observe(10.0, 5000) <= 16
+
+    def test_shrinks_to_floor_on_an_idle_fleet(self):
+        s = PoolAutoscaler(floor=2, ceiling=16, target_s=0.1, shrink_patience=3)
+        s.observe(1.6, 5000)
+        assert s.size == 16
+        for _ in range(30):
+            s.observe(0.0, 0)
+        assert s.size == s.floor
+
+    def test_shrink_has_hysteresis(self):
+        s = PoolAutoscaler(floor=2, ceiling=16, target_s=0.1, shrink_patience=4)
+        s.observe(1.6, 5000)
+        for _ in range(3):
+            s.observe(0.0, 0)
+        assert s.size == 16  # patience not yet exhausted
+        s.observe(0.0, 0)
+        assert s.size < 16  # halving begins
+
+    def test_never_more_workers_than_jobs(self):
+        s = PoolAutoscaler(floor=2, ceiling=16, target_s=0.1)
+        assert s.observe(5.0, 3) <= 3
+
+    def test_fixed_mode_is_inert(self):
+        s = PoolAutoscaler(floor=8, ceiling=8)
+        assert s.fixed
+        assert s.observe(100.0, 10000) == 8
+        assert s.observe(0.0, 0) == 8
+
+
+def _mk_sups(tmp_path, n=2, shards=4, ttl=1.0):
+    from pytorch_operator_tpu.controller.runner import FakeRunner
+    from pytorch_operator_tpu.controller.supervisor import Supervisor
+
+    sups = []
+    for i in range(n):
+        sup = Supervisor(
+            state_dir=tmp_path,
+            runner=FakeRunner(),
+            persist=True,
+            shards=shards,
+            supervisor_id=f"sup-{chr(ord('a') + i)}",
+            lease_ttl=ttl,
+            sync_workers_max=8,
+        )
+        sup.fault_kill_action = sup.simulate_crash
+        sups.append(sup)
+    return sups
+
+
+def _pass(sup):
+    sup.store.rescan()
+    sup.process_deletion_markers()
+    sup.process_scale_markers()
+    sup.process_suspend_markers()
+    sup.process_apply_markers()
+    sup.sync_once()
+
+
+def _settle(sups, shards, deadline_s=10.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        for sup in sups:
+            _pass(sup)
+        owned = [len(sup.shards.owned) for sup in sups]
+        if sum(owned) == shards and all(n > 0 for n in owned):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"shards never settled: {owned}")
+
+
+def _active_owners(sups):
+    owners = {}
+    for sup in sups:
+        for h in sup.runner.list_all():
+            if h.is_active():
+                owners.setdefault(h.job_key, set()).add(sup.identity)
+    return owners
+
+
+class TestShardFailoverE2E:
+    def test_kill_supervisor_fault_fails_over_within_one_ttl(self, tmp_path):
+        """The chaos acceptance: two supervisors split the job space; a
+        kill_supervisor fault takes one down mid-run; the orphaned
+        shards are re-claimed within one lease TTL and no job is
+        double-spawned (one live world per job throughout)."""
+        from pytorch_operator_tpu import faults
+        from pytorch_operator_tpu.controller.supervisor import (
+            SupervisorKilledError,
+        )
+        from pytorch_operator_tpu.faults.plan import Fault, FaultPlan
+        from tests.testutil import new_job
+
+        ttl = 1.0
+        sups = _mk_sups(tmp_path, ttl=ttl)
+        a, b = sups
+        try:
+            _settle(sups, 4)
+            for i in range(12):
+                a.submit(new_job(name=f"fo-{i}"))
+            for _ in range(3):
+                for sup in sups:
+                    _pass(sup)
+            before = _active_owners(sups)
+            assert len(before) == 12
+            assert all(len(v) == 1 for v in before.values())
+            victims = {k for k, v in before.items() if v == {"sup-a"}}
+            assert victims  # the split gave sup-a some jobs
+
+            # Chaos-drivable: the kill is DECLARED, not hand-rolled.
+            faults.arm(
+                FaultPlan(
+                    faults=[Fault(kind="kill_supervisor", target="sup-a", at=1)]
+                )
+            )
+            try:
+                with pytest.raises(SupervisorKilledError):
+                    _pass(a)  # dies mid-pass; leases left to expire
+            finally:
+                faults.disarm()
+            t_dead = time.time()
+
+            # Only b survives. Its next passes must re-claim a's shards
+            # as they expire — within one TTL — and re-create exactly
+            # the orphaned worlds.
+            deadline = t_dead + ttl + 1.0
+            while time.time() < deadline and len(b.shards.owned) < 4:
+                _pass(b)
+                time.sleep(0.05)
+            t_recovered = time.time()
+            assert b.shards.owned == {0, 1, 2, 3}
+            # The failover bound: orphaned shards re-claimed within one
+            # lease TTL (plus one pass of slack for the tick cadence).
+            assert t_recovered - t_dead <= ttl + 1.0
+            for _ in range(3):
+                _pass(b)
+            # Every job has exactly one LIVE world again, all owned by
+            # the survivor; the victims were re-spawned by b, not
+            # duplicated (a is dead — only b's runner is live).
+            after = _active_owners([b])
+            assert set(after) == set(before)
+            assert all(v == {"sup-b"} for v in after.values())
+            # The hand-off is on the record: the acquisition events name
+            # the dead holder, so `tpujob why` can cite the ownership
+            # change and `tpujob chaos --record` can reconstruct it.
+            from pytorch_operator_tpu.controller.leases import SHARD_EVENT_KEY
+
+            msgs = [
+                e.message
+                for e in b.events.for_job(SHARD_EVENT_KEY)
+                if e.reason == "ShardAcquired"
+            ]
+            assert any("after lease expiry of sup-a" in m for m in msgs)
+            # ...and `tpujob chaos --record` reconstructs the incident
+            # as a replayable kill_supervisor fault from those events.
+            from pytorch_operator_tpu.faults.record import plan_from_recording
+
+            victim_key = sorted(victims)[0]
+            plan = plan_from_recording(tmp_path, victim_key)
+            kills = [f for f in plan.faults if f.kind == "kill_supervisor"]
+            assert kills and kills[0].target == "sup-a"
+        finally:
+            for sup in sups:
+                try:
+                    sup.shutdown()
+                except Exception:
+                    pass
+
+    def test_drop_lease_fault_fences_the_stale_holder(self, tmp_path):
+        """drop_lease chaos: the holder's on-disk lease is force-expired
+        mid-run; the rival claims it and the stale holder's next renew
+        is fencing-rejected (ShardLeaseLost) — converging back to one
+        owner per shard with every world singly-owned."""
+        from pytorch_operator_tpu import faults
+        from pytorch_operator_tpu.faults.plan import Fault, FaultPlan
+        from tests.testutil import new_job
+
+        ttl = 0.6
+        sups = _mk_sups(tmp_path, ttl=ttl)
+        a, b = sups
+        try:
+            _settle(sups, 4)
+            for i in range(8):
+                a.submit(new_job(name=f"dl-{i}"))
+            for _ in range(3):
+                for sup in sups:
+                    _pass(sup)
+            target = sorted(a.shards.owned)[0]
+            faults.arm(
+                FaultPlan(
+                    faults=[Fault(kind="drop_lease", target=str(target), at=1)]
+                )
+            )
+            try:
+                _pass(a)  # drops its own lease on disk, keeps believing
+            finally:
+                faults.disarm()
+            # Run both until a's stale hold is fencing-rejected (its
+            # renew reads the force-expired/stolen record and drops) —
+            # within ~half a TTL. WHO ends up owning the shard is
+            # legitimately either of them (a may re-claim the orphan it
+            # just lost); the contract is the rejection plus
+            # convergence back to exactly one owner.
+            deadline = time.time() + 4 * ttl + 2.0
+            while time.time() < deadline:
+                _pass(a)
+                _pass(b)
+                if a.metrics.shard_losses.get() >= 1:
+                    break
+                time.sleep(0.05)
+            assert a.metrics.shard_losses.get() >= 1
+            assert any(
+                e.reason == "ShardLeaseLost"
+                for e in a.events.for_job(
+                    __import__(
+                        "pytorch_operator_tpu.controller.leases",
+                        fromlist=["SHARD_EVENT_KEY"],
+                    ).SHARD_EVENT_KEY
+                )
+            )
+            # Settle: exactly one owner per shard, one world per job.
+            deadline = time.time() + 4 * ttl + 2.0
+            while time.time() < deadline:
+                _pass(a)
+                _pass(b)
+                if (
+                    a.shards.owned | b.shards.owned == {0, 1, 2, 3}
+                    and not (a.shards.owned & b.shards.owned)
+                ):
+                    break
+                time.sleep(0.05)
+            assert a.shards.owned | b.shards.owned == {0, 1, 2, 3}
+            assert not (a.shards.owned & b.shards.owned)
+            for _ in range(3):
+                _pass(a)
+                _pass(b)
+            owners = _active_owners(sups)
+            assert all(len(v) == 1 for v in owners.values()), owners
+        finally:
+            for sup in sups:
+                try:
+                    sup.shutdown()
+                except Exception:
+                    pass
+
+
+class TestSteadyFastPath:
+    """The fast path must be invisible: anything that CAN change a
+    steady job still reconciles it."""
+
+    def _steady_sup(self, tmp_path):
+        from pytorch_operator_tpu.api.types import ReplicaPhase
+        from pytorch_operator_tpu.controller.runner import FakeRunner
+        from pytorch_operator_tpu.controller.supervisor import Supervisor
+        from tests.testutil import new_job
+
+        sup = Supervisor(state_dir=tmp_path, runner=FakeRunner())
+        key = sup.submit(new_job(name="steady"))
+        sup.sync_once()
+        for h in sup.runner.list_all():
+            sup.runner.set_phase(h.name, ReplicaPhase.RUNNING)
+        sup.sync_once()  # observes RUNNING
+        sup.sync_once()  # steady reconcile -> arms the fast path
+        return sup, key
+
+    def test_idle_passes_are_fast_skipped(self, tmp_path):
+        sup, _ = self._steady_sup(tmp_path)
+        base = sup.metrics.steady_fast_skips.get()
+        sup.sync_once()
+        sup.sync_once()
+        assert sup.metrics.steady_fast_skips.get() >= base + 2
+        sup.shutdown()
+
+    def test_replica_exit_breaks_the_skip(self, tmp_path):
+        from pytorch_operator_tpu.api.types import ReplicaPhase
+
+        sup, key = self._steady_sup(tmp_path)
+        sup.sync_once()  # skipping now
+        for h in sup.runner.list_for_job(key):
+            sup.runner.set_phase(h.name, ReplicaPhase.SUCCEEDED, exit_code=0)
+        sup.sync_once()
+        assert sup.get(key).is_succeeded()
+        sup.shutdown()
+
+    def test_direct_suspend_mutation_still_acts(self, tmp_path):
+        # The touch()-exempt field: flipped in place without bumping the
+        # generation (tests/test_suspend.py relies on this).
+        sup, key = self._steady_sup(tmp_path)
+        sup.sync_once()
+        j = sup.get(key)
+        j.spec.run_policy.suspend = True
+        sup.store.update(j)
+        sup.sync_once()
+        assert sup.runner.list_for_job(key) == []
+        sup.shutdown()
+
+    def test_first_status_record_is_noticed(self, tmp_path):
+        """A job that never reported gets its status dir scans
+        throttled; the FIRST replica file must still be noticed within
+        the stagger window (4 passes) and folded into the gauges."""
+        import json as _json
+
+        from pytorch_operator_tpu.controller.progress import job_status_dir
+
+        sup, key = self._steady_sup(tmp_path)
+        for _ in range(6):
+            sup.sync_once()  # throttle engages on the empty dir
+        d = job_status_dir(sup.reconciler.status_root, key)
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "master-0.jsonl").write_text(
+            _json.dumps(
+                {"event": "progress", "ts": time.time(), "step": 7,
+                 "steps_per_sec": 2.0}
+            )
+            + "\n"
+        )
+        for _ in range(5):  # >= the 4-pass stagger window
+            sup.sync_once()
+        assert sup.metrics.job_step.get(job=key) == 7.0
+        sup.shutdown()
