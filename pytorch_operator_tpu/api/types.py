@@ -411,6 +411,18 @@ class DataPlanePolicy:
     # Device-feed lookahead depth (batches resident on device ahead of
     # the step loop — data/device_prefetch.py). 0 = inline transfers.
     prefetch: int = 0
+    # Upper bound for the feed's lookahead — the device-memory budget
+    # the depth autotuner may grow into (0 = the static ``prefetch``
+    # depth is also the cap).
+    prefetch_depth_max: int = 0
+    # Let the feed resize its own depth inside [1, prefetch_depth_max]
+    # from the measured step-loop stall (data/feed_autotune.py:
+    # grow-fast/shrink-slow). Requires prefetch > 0.
+    autotune: bool = False
+    # Producer threads in the device feed's sharded gather (batch pulls
+    # stay serialized and FIFO-ordered; casts/copies/transfers overlap).
+    # 0 = single producer thread.
+    prefetch_workers: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -418,6 +430,12 @@ class DataPlanePolicy:
             d["async_checkpoint"] = True
         if self.prefetch:
             d["prefetch"] = self.prefetch
+        if self.prefetch_depth_max:
+            d["prefetch_depth_max"] = self.prefetch_depth_max
+        if self.autotune:
+            d["autotune"] = True
+        if self.prefetch_workers:
+            d["prefetch_workers"] = self.prefetch_workers
         return d
 
     @classmethod
@@ -425,6 +443,13 @@ class DataPlanePolicy:
         return cls(
             async_checkpoint=bool(d.get("async_checkpoint", False)),
             prefetch=_parse_int(d.get("prefetch", 0), "data_plane.prefetch"),
+            prefetch_depth_max=_parse_int(
+                d.get("prefetch_depth_max", 0), "data_plane.prefetch_depth_max"
+            ),
+            autotune=bool(d.get("autotune", False)),
+            prefetch_workers=_parse_int(
+                d.get("prefetch_workers", 0), "data_plane.prefetch_workers"
+            ),
         )
 
 

@@ -126,8 +126,26 @@ def validate_spec(spec: TPUJobSpec) -> List[str]:
     if spec.elastic_policy is not None:
         errs.extend(_validate_elastic(spec.elastic_policy, spec))
 
-    if spec.data_plane is not None and spec.data_plane.prefetch < 0:
-        errs.append("spec.data_plane.prefetch: must be >= 0")
+    if spec.data_plane is not None:
+        dp = spec.data_plane
+        if dp.prefetch < 0:
+            errs.append("spec.data_plane.prefetch: must be >= 0")
+        if dp.prefetch_depth_max < 0:
+            errs.append("spec.data_plane.prefetch_depth_max: must be >= 0")
+        if dp.prefetch_workers < 0:
+            errs.append("spec.data_plane.prefetch_workers: must be >= 0")
+        if dp.prefetch_depth_max and dp.prefetch_depth_max < dp.prefetch:
+            errs.append(
+                "spec.data_plane.prefetch_depth_max: "
+                f"({dp.prefetch_depth_max}) is below the initial prefetch "
+                f"depth ({dp.prefetch}) — the cap would shrink the feed "
+                "it is supposed to bound"
+            )
+        if dp.autotune and dp.prefetch <= 0:
+            errs.append(
+                "spec.data_plane.autotune: requires prefetch > 0 (there "
+                "is no device feed to autotune with inline transfers)"
+            )
 
     if spec.observability is not None:
         ob = spec.observability

@@ -1,50 +1,54 @@
-"""Async checkpoint commits as a first-class, *verified* path.
+"""Staged async checkpointing: snapshot and commit as pipelined stages,
+both off the step path, every step still *verified*.
 
-The blocking save stalls the training loop for the full device→host
-gather plus the backend write — at pod scale that stall IS the step-time
-budget (TorchTitan ships async distributed checkpointing as a headline
-feature for exactly this reason, PAPERS.md). The old ``block=False``
-path overlapped the write but skipped the checksum sidecar, so
-async-saved steps verified as "unknown" forever — second-class
-checkpoints the integrity scan could not vouch for.
+PR 3 took the WRITE off the step path but left the device→host gather
+inline: ``save(block=False)`` paid the full ``device_get`` of the train
+state on the caller's thread before returning — for a multi-GB state
+that snapshot IS the remaining stall (TorchTitan ships staged async
+distributed checkpointing as a headline feature for exactly this,
+PAPERS.md). This revision splits the writer into two pipelined stages:
 
-This module closes that hole with a commit protocol:
+1. **Submit (caller thread)**: write the ``<step>.inflight`` fence,
+   copy only the MUTABLE host leaves (numpy arrays a donating or
+   in-place-updating caller could overwrite — device arrays are
+   immutable and safe to hold), and return. The step loop's stall is
+   the fence write plus a few host memcpys.
+2. **Snapshot stage (one background thread)**: the device→host gather
+   runs chunked PER LEAF in submission order — while step N's leaves
+   gather, the COMMIT of step N-1 proceeds concurrently on the commit
+   thread; a large pytree overlaps instead of serializing the pipeline.
+3. **Commit stage (one background thread)**: unchanged from PR 3 —
+   strictly ordered commits through the shared backoff retry with
+   partial-step cleanup, checksum sidecar written AT COMMIT, fence
+   cleared when the sidecar lands.
 
-1. **Snapshot at save-call time** (:func:`snapshot_to_host`): the state
-   is copied device→host (or host→host for numpy leaves) on the caller's
-   thread BEFORE the call returns, so a later in-place donation or
-   optimizer update cannot tear the bytes an in-flight commit is
-   reading. The snapshot cost — a device_get — is the only stall the
-   step loop pays.
-2. **Single commit thread**: snapshots commit strictly in submission
-   order on one background thread (save-while-save-in-flight
-   serializes by construction), each through the shared backoff retry
-   with partial-step cleanup, exactly like a blocking save.
-3. **Sidecar at commit time**: the checksum sidecar is written when the
-   bytes are durable — an async-saved step verifies ``True`` the moment
-   :func:`~pytorch_operator_tpu.checkpoint.integrity.latest_verified_step`
-   can see it.
-4. **Inflight fencing**: an ``<step>.inflight`` marker is written at
-   submit and cleared when the sidecar lands. A replica killed
-   mid-commit leaves the marker behind, and the restore-side scan
-   treats a fenced step as uncommitted — recovery resumes from the last
-   sidecar-verified step instead of whatever bytes the crash left.
-5. **Barriers**: ``wait()`` drains pending commits; ``close()`` drains
-   and joins. The manager routes every read-side entry point
-   (``restore*``, ``latest_step``, ``all_steps``) and workload exit
-   through them, so nothing ever observes a half-committed directory.
+Every PR-3 invariant carries over: snapshots are bounded at submit
+(``max_pending`` slots — backpressure, not unbounded host memory),
+commits land in submission order, a crash mid-snapshot OR mid-commit
+leaves a fenced (never torn) step that restore-side scans skip, and
+``wait()``/``close()`` barriers drain BOTH stages. New obs surfaces:
+a ``ckpt_snapshot_wait`` span when a submitted step waited behind the
+snapshot stage, and a ``snapshot_depth`` stat (``ckpt_stage_depth``
+gauge) counting submitted-but-not-yet-gathered steps.
 
-A failed commit (e.g. a persistent ENOSPC after the retry budget) does
-NOT kill the step loop: the partial step is cleaned, the failure is
-recorded in :attr:`AsyncCheckpointWriter.errors` and reported on the
-status channel as ``checkpoint_save_failed``, and later saves proceed —
-restart-based recovery then falls back to the last verified step.
+The one caller obligation the deferred gather adds: a jit step that
+DONATES the state invalidates the device buffers the snapshot thread
+would read — donating callers must keep the PR-3 eager snapshot
+(``CheckpointManager.save(..., staged=False)``); the manager documents
+and defaults this per workload.
+
+A failed snapshot or commit (e.g. a persistent ENOSPC after the retry
+budget) does NOT kill the step loop: the partial step is cleaned, the
+failure is recorded in :attr:`AsyncCheckpointWriter.errors` and
+reported on the status channel as ``checkpoint_save_failed``, and later
+saves proceed — restart-based recovery then falls back to the last
+verified step.
 
 Deliberately jax-free and orbax-free: the commit callable owns the
 backend, so the orbax manager (``manager.py``) and the JSON step files
 the chaos workload writes (``workloads/exit_with.py``) share this exact
-commit protocol — the crash-consistency tier-1 exercises without orbax
-is the crash-consistency production checkpoints get.
+protocol — the crash-consistency tier-1 exercises without orbax is the
+crash-consistency production checkpoints get.
 """
 
 from __future__ import annotations
@@ -60,11 +64,14 @@ def snapshot_to_host(tree: Any) -> Any:
     background commit while the caller keeps mutating (donating) the
     originals.
 
-    jax arrays come back as host numpy via ``jax.device_get`` (a real
-    transfer — the returned buffer is fresh); numpy arrays are COPIED
-    (``device_get`` would return them aliased, and an aliased snapshot
-    is exactly the torn-write bug this function exists to prevent).
-    Non-array leaves pass through.
+    jax arrays come back as host numpy via a chunked PER-LEAF
+    ``jax.device_get`` (a real transfer — the returned buffer is
+    fresh); gathering leaf-at-a-time instead of one whole-tree call is
+    what lets the staged snapshot thread interleave with a concurrent
+    commit (and with the step loop's own transfers) on a large pytree.
+    numpy arrays are COPIED (``device_get`` would return them aliased,
+    and an aliased snapshot is exactly the torn-write bug this function
+    exists to prevent). Non-array leaves pass through.
     """
     import numpy as np
 
@@ -87,6 +94,9 @@ def snapshot_to_host(tree: Any) -> Any:
     try:
         import jax
 
+        # tree.map visits leaves one at a time: each device_get is its
+        # own chunk, so the GIL (and the transfer engine) is yielded
+        # between leaves — the "chunked per-leaf" overlap contract.
         return jax.tree.map(snap, tree)
     except ImportError:
         # jax-free callers (the JSON chaos workload): plain containers.
@@ -97,8 +107,36 @@ def snapshot_to_host(tree: Any) -> Any:
         return snap(tree)
 
 
+def stage_mutable_leaves(tree: Any) -> Any:
+    """The SUBMIT-TIME half of a staged snapshot: copy every leaf a
+    caller could mutate under the deferred gather (host numpy arrays —
+    in-place optimizer updates, reused buffers), pass immutable device
+    arrays and scalars through by reference. The returned tree is safe
+    to hand to the snapshot thread, which finishes the job with
+    :func:`snapshot_to_host` (jax arrays are immutable, so holding the
+    reference is sound as long as the caller does not DONATE them)."""
+    import numpy as np
+
+    def stage(x):
+        if isinstance(x, np.ndarray):
+            return np.array(x, copy=True)
+        return x
+
+    try:
+        import jax
+
+        return jax.tree.map(stage, tree)
+    except ImportError:
+        if isinstance(tree, dict):
+            return {k: stage_mutable_leaves(v) for k, v in tree.items()}
+        if isinstance(tree, (list, tuple)):
+            return type(tree)(stage_mutable_leaves(v) for v in tree)
+        return stage(tree)
+
+
 class AsyncCheckpointWriter:
-    """Commits checkpoint payloads on ONE background thread, in
+    """Commits checkpoint payloads through a two-stage background
+    pipeline — snapshot (device→host gather) then commit — strictly in
     submission order, with verified-at-commit semantics.
 
     ``commit(step, payload, fault)`` runs on the commit thread and must
@@ -109,12 +147,19 @@ class AsyncCheckpointWriter:
     caller's thread, so a replayed plan fires the identical saves even
     though the I/O itself is asynchronous.
 
+    :meth:`submit` enqueues an already-materialized payload (the PR-3
+    eager-snapshot path — still the right call for donating steps);
+    :meth:`submit_staged` enqueues a zero-arg ``snapshot()`` callable
+    the snapshot thread runs. Both kinds flow through the SAME
+    snapshot→commit queue chain, so mixed submissions still commit in
+    exact submission order.
+
     ``root`` enables inflight fencing (integrity.mark_inflight at
     submit; integrity.write_sidecar clears it at commit).
 
-    ``max_pending`` bounds how many host snapshots are alive at once
-    (submit blocks when the budget is spent — backpressure, not
-    unbounded host memory).
+    ``max_pending`` bounds how many snapshots are alive at once across
+    BOTH stages (submit blocks when the budget is spent — backpressure,
+    not unbounded host memory).
     """
 
     def __init__(
@@ -124,27 +169,58 @@ class AsyncCheckpointWriter:
         root=None,
         max_pending: int = 2,
         on_error: Optional[Callable[[int, BaseException], None]] = None,
-        on_commit: Optional[Callable[[int, float, int, float], None]] = None,
+        on_commit: Optional[Callable[..., None]] = None,
+        clear_fence_on_error: bool = True,
     ):
         if max_pending < 1:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         self._commit = commit
         self._root = root
+        # Single-host commits own their fence: a failed commit cleans
+        # its partial step, so clearing the fence is safe and avoids a
+        # phantom fence condemning a never-written step. A MULTI-HOST
+        # commit (checkpoint/multihost.py) must keep the fence on
+        # failure — peer shards this process cannot see may exist, and
+        # "fenced, not torn" is the crash invariant.
+        self._clear_fence_on_error = clear_fence_on_error
         self._on_error = on_error
         # Commit-telemetry hook: (step, commit_seconds, queue_depth_after,
-        # oldest_inflight_age_seconds) after each successful commit — the
-        # manager and exit_with report it on the status channel so the
-        # supervisor's checkpoint-lag/queue surfaces stay live.
+        # oldest_inflight_age_seconds, stage_depth) after each successful
+        # commit — the manager and exit_with report it on the status
+        # channel so the supervisor's checkpoint-lag/queue/stage surfaces
+        # stay live. Legacy 4-arg hooks are called without stage_depth.
         self._on_commit = on_commit
+        self._on_commit_takes_stage = False
+        if on_commit is not None:
+            import inspect
+
+            try:
+                params = inspect.signature(on_commit).parameters.values()
+                self._on_commit_takes_stage = any(
+                    p.kind == inspect.Parameter.VAR_POSITIONAL for p in params
+                ) or sum(
+                    p.kind in (
+                        inspect.Parameter.POSITIONAL_ONLY,
+                        inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                    )
+                    for p in params
+                ) >= 5
+            except (TypeError, ValueError):
+                pass  # builtins/C callables: stay on the 4-arg contract
         # step -> submit wall time of in-flight (submitted, undecided)
         # commits; drives the oldest-inflight-age gauge.
         self._inflight_ts: dict = {}
         self._slots = threading.Semaphore(max_pending)
+        # Stage 1 queue: (step, payload_or_snapshot_fn, staged, fault,
+        # submit_perf_ts). Stage 2 queue: (step, payload, fault).
+        self._snap_q: "queue.Queue" = queue.Queue()
         self._q: "queue.Queue" = queue.Queue()
         self._idle = threading.Event()
         self._idle.set()
         self._outstanding = 0  # submitted, not yet committed/failed
+        self._in_snapshot = 0  # submitted, not yet handed to commit
         self._lock = threading.Lock()
+        self._snap_thread: Optional[threading.Thread] = None
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self._last_committed: Optional[int] = None
@@ -153,10 +229,7 @@ class AsyncCheckpointWriter:
 
     # ---- submit side (caller thread) ----
 
-    def submit(self, step: int, payload: Any, fault: Optional[str] = None) -> None:
-        """Enqueue one commit. Blocks only when ``max_pending`` snapshots
-        are already in flight. The inflight fence for ``step`` is on
-        disk before this returns."""
+    def _enqueue(self, step: int, work, staged: bool, fault) -> None:
         if self._closed:
             raise RuntimeError("writer is closed")
         from .. import obs
@@ -179,22 +252,109 @@ class AsyncCheckpointWriter:
             integrity.mark_inflight(self._root, step)
         with self._lock:
             # Outstanding count — not queue emptiness — drives the idle
-            # barrier: the queue is briefly empty while the thread is
-            # mid-commit, and wait() must not return then.
+            # barrier: the queues are briefly empty while a thread is
+            # mid-snapshot/mid-commit, and wait() must not return then.
             self._outstanding += 1
+            self._in_snapshot += 1
             self._inflight_ts[step] = time.time()
             self._idle.clear()
-            self._ensure_thread()
-        self._q.put((step, payload, fault))
+            self._ensure_threads()
+        self._snap_q.put((step, work, staged, fault, time.perf_counter()))
 
-    def _ensure_thread(self) -> None:
+    def submit(self, step: int, payload: Any, fault: Optional[str] = None) -> None:
+        """Enqueue one commit of an ALREADY-MATERIALIZED payload (the
+        eager-snapshot path). Blocks only when ``max_pending`` snapshots
+        are already in flight. The inflight fence for ``step`` is on
+        disk before this returns."""
+        self._enqueue(step, payload, False, fault)
+
+    def submit_staged(
+        self, step: int, snapshot: Callable[[], Any], fault: Optional[str] = None
+    ) -> None:
+        """Enqueue one STAGED commit: ``snapshot()`` runs on the
+        snapshot-stage thread (device→host gather, chunked per leaf),
+        then the result commits in submission order like any other
+        payload. Only the fence write happens on the caller's thread.
+
+        The snapshot closure must be safe to run concurrently with the
+        caller's next steps — the manager builds it over immutable
+        device arrays plus submit-time copies of mutable host leaves
+        (:func:`stage_mutable_leaves`)."""
+        self._enqueue(step, snapshot, True, fault)
+
+    def _ensure_threads(self) -> None:
+        if self._snap_thread is None or not self._snap_thread.is_alive():
+            self._snap_thread = threading.Thread(
+                target=self._run_snapshots, name="ckpt-async-snapshot",
+                daemon=True,
+            )
+            self._snap_thread.start()
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
                 target=self._run, name="ckpt-async-commit", daemon=True
             )
             self._thread.start()
 
-    # ---- commit side (background thread) ----
+    # ---- snapshot stage (background thread) ----
+
+    def _fail(self, step: int, e: BaseException) -> None:
+        """Shared failure tail for both stages: record, unfence, report
+        — the step loop never sees the exception."""
+        with self._lock:
+            self.errors.append((step, e))
+            self._inflight_ts.pop(step, None)
+        if self._root is not None and self._clear_fence_on_error:
+            from . import integrity
+
+            integrity.clear_inflight(self._root, step)
+        if self._on_error is not None:
+            try:
+                self._on_error(step, e)
+            except Exception:
+                pass
+
+    def _retire(self) -> None:
+        self._slots.release()
+        with self._lock:
+            self._outstanding -= 1
+            if self._outstanding == 0:
+                self._idle.set()
+
+    def _run_snapshots(self) -> None:
+        from .. import obs
+
+        while True:
+            item = self._snap_q.get()
+            if item is None:
+                return
+            step, work, staged, fault, t_submit = item
+            if staged:
+                waited = time.perf_counter() - t_submit
+                if waited > 1e-4:
+                    # The gather sat behind an earlier snapshot — the
+                    # stage-depth pressure signal, span-recorded so a
+                    # trace shows WHICH save paid it.
+                    rec = obs.tracer()
+                    if rec is not None:
+                        rec.emit(
+                            "ckpt_snapshot_wait", "ckpt",
+                            time.time() - waited, waited, step=step,
+                        )
+                try:
+                    with obs.span("ckpt_snapshot", cat="ckpt", step=step):
+                        work = work()
+                except BaseException as e:  # noqa: BLE001 — a failed gather
+                    # must not take the stage down; record and move on.
+                    with self._lock:
+                        self._in_snapshot -= 1
+                    self._fail(step, e)
+                    self._retire()
+                    continue
+            with self._lock:
+                self._in_snapshot -= 1
+            self._q.put((step, work, fault))
+
+    # ---- commit stage (background thread) ----
 
     def _run(self) -> None:
         from .. import obs
@@ -214,47 +374,40 @@ class AsyncCheckpointWriter:
                     self.committed.append(step)
                     self._inflight_ts.pop(step, None)
                     depth = self._outstanding - 1
+                    stage_depth = self._in_snapshot
                     oldest = min(self._inflight_ts.values(), default=None)
                 if self._on_commit is not None:
+                    args = [
+                        step,
+                        commit_s,
+                        max(depth, 0),
+                        (time.time() - oldest) if oldest else 0.0,
+                    ]
+                    if self._on_commit_takes_stage:
+                        args.append(stage_depth)
                     try:
-                        self._on_commit(
-                            step,
-                            commit_s,
-                            max(depth, 0),
-                            (time.time() - oldest) if oldest else 0.0,
-                        )
+                        self._on_commit(*args)
                     except Exception:
                         pass  # telemetry must never fail a commit
             except BaseException as e:  # noqa: BLE001 — a failed commit
                 # must never take the commit thread (and with it every
                 # queued save) down; the failure is recorded and the
                 # step loop keeps training.
-                with self._lock:
-                    self.errors.append((step, e))
-                    self._inflight_ts.pop(step, None)
-                if self._root is not None:
-                    from . import integrity
-
-                    integrity.clear_inflight(self._root, step)
-                if self._on_error is not None:
-                    try:
-                        self._on_error(step, e)
-                    except Exception:
-                        pass
+                self._fail(step, e)
             finally:
-                self._slots.release()
-                with self._lock:
-                    self._outstanding -= 1
-                    if self._outstanding == 0:
-                        self._idle.set()
+                self._retire()
 
     # ---- barriers ----
 
-    def wait(self, timeout: Optional[float] = None) -> None:
+    def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until every submitted commit has finished (committed or
-        failed-and-recorded). Does NOT raise on commit failure — check
-        :attr:`errors` / re-save blocking if durability is mandatory."""
-        self._idle.wait(timeout)
+        failed-and-recorded). Returns ``True`` when drained, ``False``
+        on timeout WITH COMMITS STILL PENDING — callers that proceed on
+        False are reading/exiting past undrained state and must say so
+        (the manager's read barriers and workload exit log a warning).
+        Does NOT raise on commit failure — check :attr:`errors` /
+        re-save blocking if durability is mandatory."""
+        return self._idle.wait(timeout)
 
     def last_committed_step(self) -> Optional[int]:
         """Newest step whose commit (including sidecar) finished."""
@@ -265,8 +418,10 @@ class AsyncCheckpointWriter:
         return not self._idle.is_set()
 
     def stats(self) -> dict:
-        """Live queue telemetry: submitted-undecided depth and the age
-        of the oldest in-flight commit (0 when idle)."""
+        """Live queue telemetry: submitted-undecided depth, the age of
+        the oldest in-flight commit (0 when idle), and the snapshot-
+        stage depth (submitted steps whose gather has not finished —
+        the ``ckpt_stage_depth`` gauge source)."""
         with self._lock:
             oldest = min(self._inflight_ts.values(), default=None)
             return {
@@ -274,17 +429,36 @@ class AsyncCheckpointWriter:
                 "oldest_inflight_age_s": (
                     time.time() - oldest if oldest else 0.0
                 ),
+                "snapshot_depth": self._in_snapshot,
             }
 
-    def close(self, timeout: Optional[float] = None) -> None:
-        """Drain, stop the commit thread, refuse further submits."""
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Drain, stop both stage threads, refuse further submits.
+        Returns ``True`` when the drain completed; ``False`` (after a
+        warning — an exit that abandons pending commits is exactly the
+        silent data loss the barrier exists to prevent) when ``timeout``
+        expired with commits still pending."""
         if self._closed:
-            return
+            return True
         self._closed = True
-        self.wait(timeout)
+        drained = self.wait(timeout)
+        if not drained:
+            with self._lock:
+                left = self._outstanding
+            print(
+                f"[tpujob] warning: async checkpoint drain timed out "
+                f"after {timeout}s with {left} commit(s) still pending — "
+                "the newest saves may not be durable; recovery will fall "
+                "back to the last sidecar-verified step",
+                flush=True,
+            )
+        if self._snap_thread is not None and self._snap_thread.is_alive():
+            self._snap_q.put(None)
+            self._snap_thread.join(timeout)
         if self._thread is not None and self._thread.is_alive():
             self._q.put(None)
             self._thread.join(timeout)
+        return drained
 
     def __enter__(self) -> "AsyncCheckpointWriter":
         return self

@@ -42,11 +42,24 @@ class CheckpointManager:
     """
 
     def __init__(
-        self, directory: Path | str, max_to_keep: int = 3, create: bool = True
+        self,
+        directory: Path | str,
+        max_to_keep: int = 3,
+        create: bool = True,
+        *,
+        staged: bool = False,
     ):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
+        # Staged async saves defer the device→host gather to the
+        # writer's snapshot-stage thread: save(block=False) writes the
+        # inflight fence, copies only mutable host leaves, and returns.
+        # OPT-IN because the deferred gather holds references to the
+        # live device arrays — sound only while the step does NOT
+        # donate them (a donating caller must keep the eager PR-3
+        # snapshot; llama's --donate path passes staged=False).
+        self._staged = staged
         self.directory = Path(directory).absolute()
         if create:
             # One creation mechanism only: parents=True is load-bearing
@@ -162,13 +175,32 @@ class CheckpointManager:
         )
         report("checkpoint_save_failed", step=step, error=str(err))
 
-    def save(self, step: int, state: Any, *, block: bool = True) -> None:
+    def save(
+        self,
+        step: int,
+        state: Any,
+        *,
+        block: bool = True,
+        staged: Optional[bool] = None,
+    ) -> None:
         """Save ``state`` at ``step``. ``block=True`` waits for the commit —
         the safe default for preemption-recovery tests; ``block=False``
-        snapshots the state to host and returns, committing (checksum
-        sidecar included) on the async writer's single background
-        thread. Both paths produce VERIFIED steps; the only difference
-        is where the wait happens.
+        commits (checksum sidecar included) on the async writer's
+        background pipeline. All paths produce VERIFIED steps; the only
+        difference is where the wait happens.
+
+        Two async flavors (``staged`` defaults to the manager-level
+        setting):
+
+        - **eager** (PR-3, ``staged=False``): the full device→host
+          snapshot runs on the caller's thread before returning — after
+          that the caller may donate/overwrite the live state.
+        - **staged** (``staged=True``): only the inflight fence write
+          and copies of MUTABLE host leaves happen here; the device
+          gather runs chunked per-leaf on the writer's snapshot-stage
+          thread, overlapping the previous step's commit. The caller
+          must NOT donate the device arrays (they are read after this
+          returns) — in-place numpy mutation stays safe.
 
         The fault-injection decision (``checkpoint_write_fault``) is
         evaluated HERE, in call order, so a replayed plan fires the
@@ -185,7 +217,11 @@ class CheckpointManager:
             with obs.span("ckpt_blocking_save", cat="ckpt", step=step):
                 self._commit_step(step, state, fault)
             return
-        from .async_writer import AsyncCheckpointWriter, snapshot_to_host
+        from .async_writer import (
+            AsyncCheckpointWriter,
+            snapshot_to_host,
+            stage_mutable_leaves,
+        )
 
         if self._writer is None:
             from ..runtime.rendezvous import report_checkpoint_committed
@@ -196,16 +232,38 @@ class CheckpointManager:
                 on_error=self._report_save_failed,
                 on_commit=report_checkpoint_committed,
             )
-        # The host snapshot is the ONLY stall the step loop pays: after
-        # this line the caller may donate/overwrite the live state.
+        if self._staged if staged is None else staged:
+            # Submit-time stall = fence write + mutable-leaf copies; the
+            # gather itself is the snapshot stage's job.
+            with obs.span("ckpt_stage_submit", cat="ckpt", step=step):
+                held = stage_mutable_leaves(state)
+            self._writer.submit_staged(
+                step, lambda: snapshot_to_host(held), fault
+            )
+            return
+        # Eager: the host snapshot is the ONLY stall the step loop pays;
+        # after this line the caller may donate/overwrite the live state.
         with obs.span("ckpt_snapshot", cat="ckpt", step=step):
             snap = snapshot_to_host(state)
         self._writer.submit(step, snap, fault)
 
-    def wait(self, timeout: Optional[float] = None) -> None:
-        """Public barrier: drain pending async commits."""
-        if self._writer is not None:
-            self._writer.wait(timeout)
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Public barrier: drain pending async commits. Returns ``True``
+        when drained; ``False`` (after a logged warning — the caller is
+        about to proceed past undrained saves) when ``timeout`` expired
+        with commits still pending."""
+        if self._writer is None:
+            return True
+        drained = self._writer.wait(timeout)
+        if not drained:
+            print(
+                f"[tpujob] warning: checkpoint drain timed out after "
+                f"{timeout}s with commits still pending "
+                f"({self._writer.stats()}); proceeding — the newest saves "
+                "may not be durable yet",
+                flush=True,
+            )
+        return drained
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore onto the structure/shardings of ``state_like`` (pass the

@@ -1288,7 +1288,8 @@ def cmd_bench_control_plane(args) -> int:
 
 def cmd_bench_data_plane(args) -> int:
     """Data-plane benchmark: checkpoint stall + step throughput across
-    {blocking, async} saves x {inline, prefetched} device feeds
+    {blocking, async, staged} saves x {inline, prefetched} device feeds,
+    plus the bursty-producer static-vs-autotuned feed cells
     (workloads/dataplane_bench)."""
     from pytorch_operator_tpu.workloads import dataplane_bench
 
@@ -1296,6 +1297,8 @@ def cmd_bench_data_plane(args) -> int:
         "--steps", str(args.steps),
         "--checkpoint-every", str(args.checkpoint_every),
         "--dim", str(args.dim),
+        "--feed-steps", str(args.feed_steps),
+        "--feed-depth-max", str(args.feed_depth_max),
     ]
     if args.out:
         argv += ["--out", args.out]
@@ -1687,8 +1690,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser(
         "bench-data-plane",
         help="measure training-step checkpoint stalls + device-feed "
-        "overlap ({blocking, async} saves x {inline, prefetched} "
-        "feeds); emits a JSON artifact",
+        "overlap ({blocking, async, staged} saves x {inline, prefetched} "
+        "feeds, bursty static-vs-autotuned feed cells); emits a JSON "
+        "artifact",
     )
     sp.add_argument("--steps", type=int, default=40, help="timed steps/cell")
     sp.add_argument(
@@ -1697,6 +1701,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument(
         "--dim", type=int, default=256,
         help="bench model width (state bytes ~ 96*dim^2)",
+    )
+    sp.add_argument(
+        "--feed-steps", type=int, default=60,
+        help="fenced steps per bursty feed cell",
+    )
+    sp.add_argument(
+        "--feed-depth-max", type=int, default=8,
+        help="depth budget the autotuned feed cell may grow into",
     )
     sp.add_argument(
         "--out", default=None,

@@ -238,6 +238,11 @@ class MetricsRegistry:
             "Age of the oldest in-flight async checkpoint at the newest "
             "commit",
         )
+        self.job_ckpt_stage_depth = self.gauge(
+            "tpujob_job_ckpt_stage_depth",
+            "Staged-writer snapshot-stage depth at the newest commit "
+            "(submitted saves whose device→host gather has not finished)",
+        )
         # Live health engine (obs/watch.py): firing alerts per
         # job/rule/severity, rebuilt per pass from the watch state —
         # the scrapeable face of the alert lifecycle (pending alerts

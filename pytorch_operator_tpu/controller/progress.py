@@ -77,6 +77,7 @@ TAILED_KINDS: dict = {
     ),
     "checkpoint_committed": (
         "ts", "step", "commit_ms", "queue_depth", "oldest_age_s",
+        "stage_depth",
     ),
     "clock_probe": ("ts", "probe_ts", "seq"),
 }

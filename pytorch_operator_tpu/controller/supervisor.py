@@ -929,7 +929,8 @@ class Supervisor:
         gauges = (
             g_step, g_sps, g_tp, g_loss, g_age,
             m.job_checkpoint_step, m.job_ckpt_queue_depth,
-            m.job_ckpt_oldest_age, m.job_feed_stall,
+            m.job_ckpt_oldest_age, m.job_ckpt_stage_depth,
+            m.job_feed_stall,
         )
         for g in gauges:
             g.clear()
@@ -1027,6 +1028,10 @@ class Supervisor:
                 if ck.get("oldest_age_s") is not None:
                     m.job_ckpt_oldest_age.set(
                         float(ck["oldest_age_s"]), job=key
+                    )
+                if ck.get("stage_depth") is not None:
+                    m.job_ckpt_stage_depth.set(
+                        float(ck["stage_depth"]), job=key
                     )
                 if (
                     ck.get("commit_ms") is not None

@@ -127,6 +127,12 @@ def build_cluster_env(
             env["TPUJOB_ASYNC_CHECKPOINT"] = "1"
         if dp.prefetch > 0:
             env["TPUJOB_PREFETCH"] = str(dp.prefetch)
+        if dp.prefetch_depth_max > 0:
+            env["TPUJOB_PREFETCH_DEPTH_MAX"] = str(dp.prefetch_depth_max)
+        if dp.autotune:
+            env["TPUJOB_FEED_AUTOTUNE"] = "1"
+        if dp.prefetch_workers > 0:
+            env["TPUJOB_PREFETCH_WORKERS"] = str(dp.prefetch_workers)
     # Persistent XLA compilation cache, shared across the state dir: a
     # resubmitted/restarted job skips its ~30s cold compile, which is most
     # of schedule-to-first-step on TPU (BASELINE.md). Template env wins —

@@ -258,16 +258,22 @@ def report_checkpoint_committed(
     commit_s: float,
     queue_depth: int = 0,
     oldest_age_s: float = 0.0,
+    stage_depth: int = 0,
 ) -> None:
     """Async-checkpoint commit telemetry for the operator surface: the
     supervisor folds the newest record into the per-job checkpoint-step
-    /queue-depth/oldest-inflight-age gauges and observes the commit
-    duration into ``tpujob_checkpoint_commit_seconds`` — checkpoint lag
-    in ``tpujob top`` is ``job_step - job_checkpoint_step``."""
+    /queue-depth/oldest-inflight-age/stage-depth gauges and observes
+    the commit duration into ``tpujob_checkpoint_commit_seconds`` —
+    checkpoint lag in ``tpujob top`` is ``job_step -
+    job_checkpoint_step``. ``stage_depth`` counts submitted saves whose
+    device→host gather has not finished (the staged writer's snapshot
+    stage — a growing value means gathers cannot keep up with the save
+    cadence)."""
     report(
         "checkpoint_committed",
         step=step,
         commit_ms=round(1000.0 * commit_s, 3),
         queue_depth=int(queue_depth),
         oldest_age_s=round(oldest_age_s, 3),
+        stage_depth=int(stage_depth),
     )

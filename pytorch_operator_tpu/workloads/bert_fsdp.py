@@ -48,6 +48,9 @@ def run(
     grad_clip: float | None = None,
     num_classes: int = 2,
     prefetch: int = 0,
+    prefetch_depth_max: int = 0,
+    feed_autotune: bool = False,
+    prefetch_workers: int = 0,
     profile_dir: str | None = None,
     log=print,
 ) -> dict:
@@ -139,6 +142,9 @@ def run(
         prefetcher = DevicePrefetcher(
             lambda: host_batch(next(_feed_steps)), put=put_batch,
             depth=prefetch,
+            depth_max=prefetch_depth_max or None,
+            workers=max(prefetch_workers, 1),
+            autotune=feed_autotune,
         )
 
         def batches(step: int):
@@ -253,11 +259,15 @@ def main(argv=None) -> int:
         help="write a jax.profiler trace of the timed window here",
     )
     p.add_argument("--json", action="store_true")
+    from .trainer import add_feed_tuning_args, resolve_feed_tuning
+
+    add_feed_tuning_args(p)
     args = p.parse_args(argv)
 
     from .trainer import data_plane_env_defaults
 
     _, env_prefetch = data_plane_env_defaults()
+    feed_tuning = resolve_feed_tuning(args)
     world = rendezvous.initialize_from_env()
     result = run(
         bert_base=args.bert_base,
@@ -270,6 +280,9 @@ def main(argv=None) -> int:
         lr_warmup_steps=args.lr_warmup_steps,
         grad_clip=args.grad_clip,
         prefetch=args.prefetch if args.prefetch is not None else env_prefetch,
+        prefetch_depth_max=feed_tuning["prefetch_depth_max"],
+        feed_autotune=feed_tuning["autotune"],
+        prefetch_workers=feed_tuning["prefetch_workers"],
         profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"

@@ -21,6 +21,12 @@ the last sidecar-verified step.
 ``--commit-time S`` — sleep inside each commit BETWEEN the state write
 and the sidecar (async mode): widens the mid-commit window so a kill
 deterministically lands while a step is fenced-but-uncommitted.
+``--staged-checkpoint`` — submit saves through the writer's STAGED
+snapshot stage (submit_staged: fence at submit, "gather" on the
+snapshot thread, then the ordered commit). With ``--snapshot-time S``
+the synthetic gather sleeps S, widening the mid-SNAPSHOT window so a
+kill deterministically lands while a step is fenced with NO bytes
+written at all — the staged-pipeline crash-consistency casualty.
 """
 
 import argparse
@@ -119,6 +125,8 @@ def _run_steps(
     async_checkpoint: bool = False,
     commit_time: float = 0.0,
     feed_stall_ms: float = 0.0,
+    staged_checkpoint: bool = False,
+    snapshot_time: float = 0.0,
 ) -> int:
     with obs.span("rendezvous_join", cat="rendezvous"):
         rendezvous.fault_stall_if_armed()  # the rendezvous-join stand-in
@@ -127,7 +135,7 @@ def _run_steps(
     with obs.span("restore", cat="ckpt"):
         start = _restore_step(root) if root is not None else 0
     writer = None
-    if async_checkpoint and root is not None:
+    if (async_checkpoint or staged_checkpoint) and root is not None:
         from ..checkpoint.async_writer import AsyncCheckpointWriter
 
         writer = AsyncCheckpointWriter(
@@ -138,6 +146,14 @@ def _run_steps(
             on_error=_report_save_failed,
             on_commit=rendezvous.report_checkpoint_committed,
         )
+
+    def _staged_snapshot(step: int):
+        """The synthetic device→host gather: runs on the writer's
+        snapshot-stage thread; --snapshot-time widens the fenced-but-
+        nothing-written window the kill chaos aims at."""
+        if snapshot_time:
+            time.sleep(snapshot_time)
+        return {"step": step}
     rendezvous.report_first_step(start + 1)
     for step in range(start + 1, steps + 1):
         with obs.span("step", cat="step", step=step):
@@ -150,7 +166,13 @@ def _run_steps(
             faults.crash_if_due(step)
             if root is not None:
                 fault = faults.checkpoint_write_fault()
-                if writer is not None:
+                if writer is not None and staged_checkpoint:
+                    writer.submit_staged(
+                        step,
+                        (lambda s=step: _staged_snapshot(s)),
+                        fault,
+                    )
+                elif writer is not None:
                     writer.submit(step, None, fault)
                 else:
                     try:
@@ -180,6 +202,8 @@ def main() -> int:
     p.add_argument("--step-time", type=float, default=0.0)
     p.add_argument("--async-checkpoint", action="store_true")
     p.add_argument("--commit-time", type=float, default=0.0)
+    p.add_argument("--staged-checkpoint", action="store_true")
+    p.add_argument("--snapshot-time", type=float, default=0.0)
     # Reported feed stall per heartbeat: makes the input-bound signature
     # (obs rule feed_stall_dominance) drivable by a real subprocess
     # world without a jax data pipeline.
@@ -194,6 +218,8 @@ def main() -> int:
             async_checkpoint=args.async_checkpoint,
             commit_time=args.commit_time,
             feed_stall_ms=args.feed_stall_ms,
+            staged_checkpoint=args.staged_checkpoint,
+            snapshot_time=args.snapshot_time,
         )
         sys.stdout.flush()
         return rc

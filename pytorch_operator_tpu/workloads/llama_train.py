@@ -66,6 +66,9 @@ def run(
     checkpoint_every: int = 0,
     async_checkpoint: bool = False,
     prefetch: int = 0,
+    prefetch_depth_max: int = 0,
+    feed_autotune: bool = False,
+    prefetch_workers: int = 0,
     max_steps: int | None = None,
     remat: bool | None = None,
     remat_policy: str | None = None,
@@ -351,7 +354,14 @@ def run(
         mgr = None
         ckpt_dir = job_checkpoint_dir()
         if checkpoint_every and ckpt_dir is not None:
-            mgr = CheckpointManager(ckpt_dir)
+            # Staged async saves (fence-and-return; gather on the
+            # writer's snapshot thread) need the device arrays alive
+            # until the background gather reads them — a DONATING step
+            # invalidates them, so donation keeps the eager PR-3
+            # snapshot-at-submit path.
+            mgr = CheckpointManager(
+                ckpt_dir, staged=async_checkpoint and not donate
+            )
             resumed = mgr.restore_or_none(state)
             if resumed is not None:
                 start_step, state = resumed
@@ -404,6 +414,9 @@ def run(
                 lambda: host_batch(next(_feed_steps)),
                 put=lambda toks: put_global(toks, batch_sharding),
                 depth=prefetch,
+                depth_max=prefetch_depth_max or None,
+                workers=max(prefetch_workers, 1),
+                autotune=feed_autotune,
             )
 
             def batches(step: int):
@@ -714,11 +727,15 @@ def main(argv=None) -> int:
         help="write a jax.profiler trace of the timed window here",
     )
     p.add_argument("--json", action="store_true")
+    from .trainer import add_feed_tuning_args, resolve_feed_tuning
+
+    add_feed_tuning_args(p)
     args = p.parse_args(argv)
 
     from .trainer import data_plane_env_defaults
 
     env_async, env_prefetch = data_plane_env_defaults()
+    feed_tuning = resolve_feed_tuning(args)
     world = rendezvous.initialize_from_env()
     result = run(
         config=args.config,
@@ -739,6 +756,9 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every,
         async_checkpoint=args.async_checkpoint or env_async,
         prefetch=args.prefetch if args.prefetch is not None else env_prefetch,
+        prefetch_depth_max=feed_tuning["prefetch_depth_max"],
+        feed_autotune=feed_tuning["autotune"],
+        prefetch_workers=feed_tuning["prefetch_workers"],
         max_steps=args.max_steps,
         remat=True if args.remat else None,
         remat_policy=args.remat_policy,

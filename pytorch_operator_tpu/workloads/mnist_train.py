@@ -43,11 +43,15 @@ def main(argv=None) -> int:
         "batches device-resident ahead of the step loop (0 = inline "
         "transfers). Default: spec.data_plane / TPUJOB_PREFETCH",
     )
+    from .trainer import add_feed_tuning_args, resolve_feed_tuning
+
+    add_feed_tuning_args(p)
     args = p.parse_args(argv)
     from .trainer import data_plane_env_defaults
 
     _, env_prefetch = data_plane_env_defaults()
     prefetch = args.prefetch if args.prefetch is not None else env_prefetch
+    feed_tuning = resolve_feed_tuning(args)
 
     world = rendezvous.initialize_from_env()
 
@@ -161,6 +165,9 @@ def main(argv=None) -> int:
             loader = prefetch_to_device(
                 loader, depth=prefetch,
                 put=lambda f: put_xy(f["x"], f["y"]),
+                depth_max=feed_tuning["prefetch_depth_max"] or None,
+                workers=max(feed_tuning["prefetch_workers"], 1),
+                autotune=feed_tuning["autotune"],
             )
 
             def epoch_iter(epoch):

@@ -157,6 +157,9 @@ def run_benchmark(
     windows: int = 1,
     data_file: str | None = None,
     prefetch: int = 0,
+    prefetch_depth_max: int = 0,
+    feed_autotune: bool = False,
+    prefetch_workers: int = 0,
     profile_dir: str | None = None,
     bn_f32_stats: bool = True,
     s2d_stem: bool = False,
@@ -250,6 +253,8 @@ def run_benchmark(
         next_batches, loader = open_image_feed(
             data_file, batch=batch, chunk=chunk, classes=classes, mesh=mesh,
             meta=file_meta, prefetch=prefetch,
+            prefetch_depth_max=prefetch_depth_max, autotune=feed_autotune,
+            prefetch_workers=prefetch_workers,
         )
         train_chunk = make_train_chunk_fed(model, tx)
     else:
@@ -397,11 +402,15 @@ def main(argv=None) -> int:
         help="write a jax.profiler trace of the timed window here",
     )
     p.add_argument("--json", action="store_true", help="print a JSON result line")
+    from .trainer import add_feed_tuning_args, resolve_feed_tuning
+
+    add_feed_tuning_args(p)
     args = p.parse_args(argv)
 
     from .trainer import data_plane_env_defaults
 
     _, env_prefetch = data_plane_env_defaults()
+    feed_tuning = resolve_feed_tuning(args)
     world = rendezvous.initialize_from_env()
     result = run_benchmark(
         depth=args.depth,
@@ -415,6 +424,9 @@ def main(argv=None) -> int:
         windows=args.windows,
         data_file=args.data_file,
         prefetch=args.prefetch if args.prefetch is not None else env_prefetch,
+        prefetch_depth_max=feed_tuning["prefetch_depth_max"],
+        feed_autotune=feed_tuning["autotune"],
+        prefetch_workers=feed_tuning["prefetch_workers"],
         profile_dir=args.profile_dir,
         bn_f32_stats=not args.bn_bf16_stats,
         s2d_stem=args.s2d_stem,

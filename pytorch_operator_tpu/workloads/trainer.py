@@ -56,21 +56,92 @@ def init_sharded_train_state(model_init: Callable, tx, mesh):
     return init_sharded(init_state, mesh, jax.random.key(int(os.environ.get("TPUJOB_SEED", "0"))))
 
 
-def data_plane_env_defaults() -> tuple:
-    """``(async_checkpoint, prefetch)`` defaults from the supervisor-
-    injected ``spec.data_plane`` env (``TPUJOB_ASYNC_CHECKPOINT`` /
-    ``TPUJOB_PREFETCH``, runtime/env.py) — the one place every workload's
-    ``--async-checkpoint`` / ``--prefetch`` flags read the spec knobs, so
-    the env contract cannot drift per workload. Explicit flags win."""
-    async_ckpt = os.environ.get("TPUJOB_ASYNC_CHECKPOINT", "").lower() in (
-        "1",
-        "true",
-    )
+def _env_int(name: str) -> int:
     try:
-        prefetch = int(os.environ.get("TPUJOB_PREFETCH", "0"))
+        return max(int(os.environ.get(name, "0")), 0)
     except ValueError:
-        prefetch = 0
-    return async_ckpt, max(prefetch, 0)
+        return 0
+
+
+def data_plane_env() -> dict:
+    """The full supervisor-injected ``spec.data_plane`` contract
+    (runtime/env.py) as a dict — the ONE place every workload's
+    ``--async-checkpoint`` / ``--prefetch`` / ``--prefetch-depth-max`` /
+    ``--feed-autotune`` / ``--prefetch-workers`` flags read the spec
+    knobs, so the env contract cannot drift per workload. Explicit
+    flags win over these defaults."""
+    return {
+        "async_checkpoint": os.environ.get(
+            "TPUJOB_ASYNC_CHECKPOINT", ""
+        ).lower() in ("1", "true"),
+        "prefetch": _env_int("TPUJOB_PREFETCH"),
+        "prefetch_depth_max": _env_int("TPUJOB_PREFETCH_DEPTH_MAX"),
+        "autotune": os.environ.get("TPUJOB_FEED_AUTOTUNE", "").lower()
+        in ("1", "true"),
+        "prefetch_workers": _env_int("TPUJOB_PREFETCH_WORKERS"),
+    }
+
+
+def data_plane_env_defaults() -> tuple:
+    """Back-compat ``(async_checkpoint, prefetch)`` pair — see
+    :func:`data_plane_env` for the full knob set."""
+    dp = data_plane_env()
+    return dp["async_checkpoint"], dp["prefetch"]
+
+
+def add_feed_tuning_args(p) -> None:
+    """The shared feed-pipeline argparse block (every workload with a
+    ``--prefetch`` flag adds these three the same way — one definition
+    so the flag/env contract cannot drift per workload). ``None``
+    defaults mean "fall back to spec.data_plane env" — resolve with
+    :func:`resolve_feed_tuning`."""
+    import argparse as _ap
+
+    p.add_argument(
+        "--prefetch-depth-max", type=int, default=None, metavar="N",
+        help="upper bound the feed's device lookahead may grow to "
+        "(device-memory budget; default: spec.data_plane / "
+        "TPUJOB_PREFETCH_DEPTH_MAX, else the static --prefetch depth)",
+    )
+    p.add_argument(
+        "--feed-autotune", action=_ap.BooleanOptionalAction, default=None,
+        help="let the feed resize its depth inside [1, --prefetch-depth-max] "
+        "from the measured step-loop stall (grow fast, shrink slow — "
+        "data/feed_autotune.py). Default: spec.data_plane / "
+        "TPUJOB_FEED_AUTOTUNE",
+    )
+    p.add_argument(
+        "--prefetch-workers", type=int, default=None, metavar="N",
+        help="producer threads in the feed's sharded gather (batch order "
+        "stays FIFO-deterministic; casts and transfers overlap). "
+        "Default: spec.data_plane / TPUJOB_PREFETCH_WORKERS, else 1",
+    )
+
+
+def resolve_feed_tuning(args) -> dict:
+    """Merge the :func:`add_feed_tuning_args` flags with the
+    supervisor-injected spec defaults (explicit flags win) into the
+    kwargs :class:`~pytorch_operator_tpu.data.device_prefetch.DevicePrefetcher`
+    and :func:`open_image_feed` take."""
+    env = data_plane_env()
+    depth_max = (
+        args.prefetch_depth_max
+        if args.prefetch_depth_max is not None
+        else env["prefetch_depth_max"]
+    )
+    autotune = (
+        args.feed_autotune if args.feed_autotune is not None else env["autotune"]
+    )
+    workers = (
+        args.prefetch_workers
+        if args.prefetch_workers is not None
+        else env["prefetch_workers"]
+    )
+    return {
+        "prefetch_depth_max": max(depth_max, 0),
+        "autotune": bool(autotune),
+        "prefetch_workers": max(workers, 0),
+    }
 
 
 def probe_image_file(data_file: str):
@@ -95,6 +166,9 @@ def open_image_feed(
     seed: int = 0,
     meta=None,
     prefetch: int = 0,
+    prefetch_depth_max: int = 0,
+    autotune: bool = False,
+    prefetch_workers: int = 0,
 ):
     """Validate + open a packed image file and return ``(next_batches,
     loader)`` — the real-data feed both image benches share (one
@@ -115,10 +189,14 @@ def open_image_feed(
     prefetcher facade (closing it closes the real loader too).
 
     ``prefetch=N`` moves the whole host side — loader pulls, stacking
-    copy, and the ``device_put`` — onto a background feed thread with N
+    copy, and the ``device_put`` — onto a background feed pool with N
     stacked chunks of device lookahead (data/device_prefetch.py):
     ``next_batches()`` then just pops ready device arrays, zero
-    transfers on the step path.
+    transfers on the step path. ``prefetch_workers`` sizes the sharded
+    gather (loader pulls stay serialized and FIFO; the stacking casts
+    and transfers overlap across workers); ``prefetch_depth_max`` +
+    ``autotune`` hand the depth to the stall-driven controller
+    (data/feed_autotune.py).
     """
     import jax
     import jax.numpy as jnp
@@ -163,22 +241,42 @@ def open_image_feed(
     x_sh = NamedSharding(mesh, PartitionSpec(None, "dp"))
 
     def host_batches():
+        # The SERIAL half (loader borrow contract): pull + same-dtype
+        # slot copies only — a raw memcpy, so the serialized produce
+        # turn stays short and the expensive work below can shard.
+        raw = []
+        for _ in range(chunk):
+            _, _, fields = loader.next_batch()
+            raw.append(
+                (
+                    np.array(fields["x"], copy=True),
+                    np.array(fields["y"], copy=True),
+                )
+            )
+        return raw
+
+    def put_pair(raw):
+        # The SHARDED half: f32 → bf16 casts, chunk stacking, and the
+        # device transfer — with prefetch_workers > 1 these overlap
+        # across producer threads while the next serial pull runs.
         sx = np.empty((chunk, batch) + field_x.shape, jnp.bfloat16)
         sy = np.empty((chunk, batch), np.int32)
-        for i in range(chunk):
-            _, _, fields = loader.next_batch()
-            sx[i] = fields["x"]  # casts f32 → bf16 in place (a copy —
-            sy[i] = fields["y"]  # the borrowed slot never escapes)
-        return sx, sy
-
-    def put_pair(pair):
-        sx, sy = pair
+        for i, (x, y) in enumerate(raw):
+            sx[i] = x
+            sy[i] = y
         return put_global(sx, x_sh), put_global(sy, x_sh)
 
     if prefetch > 0:
         from ..data.device_prefetch import DevicePrefetcher
 
-        pf = DevicePrefetcher(host_batches, put=put_pair, depth=prefetch)
+        pf = DevicePrefetcher(
+            host_batches,
+            put=put_pair,
+            depth=prefetch,
+            depth_max=prefetch_depth_max or None,
+            workers=max(prefetch_workers, 1),
+            autotune=autotune,
+        )
 
         class _Feed:
             """Caller-owned close handle: prefetcher first, then loader."""
@@ -537,7 +635,14 @@ def heartbeat_reporter(report_progress, *, batch=None, n_dev=1, unit=None,
         stats = getattr(feed, "stats", None)
         if stats is not None:
             try:
-                kw["feed_stall_ms"] = stats()["feed_stall_ms_avg"]
+                s = stats()
+                # The heartbeat carries the ROLLING-WINDOW stall: a live
+                # burst must move the feed_stall_dominance rule now, not
+                # after the lifetime average dilutes it. The cumulative
+                # feed_stall_ms_avg stays in stats() for whole-run math.
+                kw["feed_stall_ms"] = s.get(
+                    "feed_stall_ms_recent", s["feed_stall_ms_avg"]
+                )
             except Exception:
                 pass  # telemetry must never kill the step loop
         report_progress(

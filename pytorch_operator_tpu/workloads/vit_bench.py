@@ -101,6 +101,9 @@ def run_benchmark(
     remat_policy: str = "full",
     data_file: str | None = None,
     prefetch: int = 0,
+    prefetch_depth_max: int = 0,
+    feed_autotune: bool = False,
+    prefetch_workers: int = 0,
     profile_dir: str | None = None,
     log=print,
 ) -> dict:
@@ -173,6 +176,8 @@ def run_benchmark(
         next_batches, loader = open_image_feed(
             data_file, batch=batch, chunk=chunk, classes=classes, mesh=mesh,
             square=True, meta=file_meta, prefetch=prefetch,
+            prefetch_depth_max=prefetch_depth_max, autotune=feed_autotune,
+            prefetch_workers=prefetch_workers,
         )
         train_chunk = make_train_chunk_fed(model, tx)
     else:
@@ -300,11 +305,15 @@ def main(argv=None) -> int:
     )
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--json", action="store_true")
+    from .trainer import add_feed_tuning_args, resolve_feed_tuning
+
+    add_feed_tuning_args(p)
     args = p.parse_args(argv)
 
     from .trainer import data_plane_env_defaults
 
     _, env_prefetch = data_plane_env_defaults()
+    feed_tuning = resolve_feed_tuning(args)
     world = rendezvous.initialize_from_env()
     result = run_benchmark(
         variant=args.variant,
@@ -320,6 +329,9 @@ def main(argv=None) -> int:
         remat_policy=args.remat_policy,
         data_file=args.data_file,
         prefetch=args.prefetch if args.prefetch is not None else env_prefetch,
+        prefetch_depth_max=feed_tuning["prefetch_depth_max"],
+        feed_autotune=feed_tuning["autotune"],
+        prefetch_workers=feed_tuning["prefetch_workers"],
         profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"

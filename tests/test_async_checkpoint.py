@@ -166,6 +166,118 @@ class TestAsyncWriter:
         assert (snap["w"] == 1.0).all()
         assert snap["n"] == 3
 
+    # ---- staged snapshot stage (PR 8) ----
+
+    def test_staged_submits_keep_submission_order_mixed_with_eager(
+        self, tmp_path
+    ):
+        """Staged and eager submits flow through the same
+        snapshot→commit chain: commits land in exact submission order
+        no matter which flavor each save used."""
+        order = []
+        w = AsyncCheckpointWriter(
+            self._json_commit(tmp_path, delay=0.01, order=order),
+            root=tmp_path,
+        )
+        w.submit(1, None)
+        w.submit_staged(2, lambda: None)
+        w.submit(3, None)
+        w.submit_staged(4, lambda: None)
+        w.close()
+        assert order == [1, 2, 3, 4]
+        assert w.committed == [1, 2, 3, 4]
+
+    def test_staged_submit_returns_before_snapshot_runs(self, tmp_path):
+        """The tentpole contract: submit_staged pays only the fence
+        write — the gather happens later, on the snapshot thread."""
+        started = threading.Event()
+        release = threading.Event()
+
+        def slow_snapshot():
+            started.set()
+            release.wait(5)
+            return {"s": 7}
+
+        w = AsyncCheckpointWriter(self._json_commit(tmp_path), root=tmp_path)
+        w.submit_staged(7, slow_snapshot)
+        # Returned while the snapshot is still running (or not started);
+        # the fence is already on disk.
+        assert integrity.inflight_path(tmp_path, 7).exists()
+        assert started.wait(5)
+        assert w.stats()["snapshot_depth"] == 1
+        release.set()
+        w.close()
+        assert integrity.verify_step(tmp_path, 7) is True
+        assert w.stats()["snapshot_depth"] == 0
+
+    def test_failed_snapshot_recorded_fence_cleared_later_saves_proceed(
+        self, tmp_path
+    ):
+        """A gather that raises (e.g. donated-buffer misuse) must be a
+        recorded failure like a failed commit — never a dead pipeline."""
+        errs = []
+
+        def boom():
+            raise RuntimeError("gather exploded")
+
+        w = AsyncCheckpointWriter(
+            self._json_commit(tmp_path),
+            root=tmp_path,
+            on_error=lambda s, e: errs.append(s),
+        )
+        w.submit_staged(1, lambda: None)
+        w.submit_staged(2, boom)
+        w.submit_staged(3, lambda: None)
+        w.close()
+        assert [s for s, _ in w.errors] == [2] and errs == [2]
+        assert w.committed == [1, 3]
+        assert not integrity.inflight_path(tmp_path, 2).exists()
+        assert integrity.latest_verified_step(tmp_path) == 3
+
+    def test_wait_returns_false_on_timeout_true_when_drained(self, tmp_path):
+        """Satellite: the barrier must SAY when it gave up — a silent
+        return with commits pending let exit paths proceed past
+        undrained saves."""
+        release = threading.Event()
+
+        def commit(step, payload, fault):
+            release.wait(5)
+            integrity.write_sidecar(tmp_path, step)
+
+        (tmp_path / "1").mkdir()
+        w = AsyncCheckpointWriter(commit, root=tmp_path)
+        w.submit(1, None)
+        assert w.wait(0.05) is False  # timed out, commit still pending
+        release.set()
+        assert w.wait(5.0) is True
+        w.close()
+
+    def test_close_timeout_warns_and_returns_false(self, tmp_path, capsys):
+        release = threading.Event()
+
+        def commit(step, payload, fault):
+            release.wait(10)
+
+        w = AsyncCheckpointWriter(commit, root=tmp_path)
+        w.submit(1, None)
+        assert w.close(timeout=0.05) is False
+        out = capsys.readouterr().out
+        assert "drain timed out" in out
+        release.set()
+
+    def test_stage_mutable_leaves_copies_numpy_keeps_rest(self):
+        import numpy as np
+
+        from pytorch_operator_tpu.checkpoint.async_writer import (
+            stage_mutable_leaves,
+        )
+
+        src = {"w": np.ones((4,), np.float32), "n": 3, "s": "tag"}
+        held = stage_mutable_leaves(src)
+        src["w"][:] = -1.0  # in-place mutation after submit
+        assert (held["w"] == 1.0).all()  # the copy is isolated
+        assert held["n"] == 3 and held["s"] == "tag"
+
 
 # ---- orbax manager integration ----
 
@@ -207,6 +319,75 @@ class TestManagerAsync:
             step, st = mgr.restore_or_none({"w": np.zeros((64, 32), np.float32)})
         assert step == 1
         np.testing.assert_allclose(np.asarray(st["w"]), 5.0)
+
+    def test_staged_steps_verify_and_restore(self, ckpt_mgr_dir):
+        """Staged saves are first-class VERIFIED checkpoints exactly
+        like eager async ones — the read side drains through both
+        stages."""
+        import numpy as np
+
+        from pytorch_operator_tpu.checkpoint import CheckpointManager
+
+        with CheckpointManager(
+            ckpt_mgr_dir, max_to_keep=10, staged=True
+        ) as mgr:
+            mgr.save(1, _state(1.0), block=False)
+            mgr.save(2, _state(2.0), block=False)
+            assert mgr.latest_verified_step() == 2
+            assert integrity.verify_step(ckpt_mgr_dir, 1) is True
+            step, st = mgr.restore_or_none(_state(0.0))
+        assert step == 2
+        np.testing.assert_allclose(np.asarray(st["w"]), 2.0)
+
+    def test_staged_save_isolates_mutable_host_leaves(self, ckpt_mgr_dir):
+        """The deferred gather still copies MUTABLE (numpy) leaves at
+        submit: in-place updates right after save(block=False) cannot
+        tear the staged commit."""
+        import numpy as np
+
+        from pytorch_operator_tpu.checkpoint import CheckpointManager
+
+        state = {"w": np.full((64, 32), 5.0, np.float32)}
+        with CheckpointManager(ckpt_mgr_dir, staged=True) as mgr:
+            mgr.save(1, state, block=False)
+            state["w"][:] = -1.0  # the next "step" updates in place
+            step, st = mgr.restore_or_none(
+                {"w": np.zeros((64, 32), np.float32)}
+            )
+        assert step == 1
+        np.testing.assert_allclose(np.asarray(st["w"]), 5.0)
+
+    def test_per_call_staged_override_wins(self, ckpt_mgr_dir):
+        """save(..., staged=) overrides the manager default — the
+        donate-path escape hatch."""
+        from pytorch_operator_tpu.checkpoint import CheckpointManager
+
+        with CheckpointManager(
+            ckpt_mgr_dir, max_to_keep=10, staged=True
+        ) as mgr:
+            mgr.save(1, _state(1.0), block=False, staged=False)  # eager
+            mgr.save(2, _state(2.0), block=False)  # staged default
+            assert mgr.latest_verified_step() == 2
+
+    def test_manager_wait_timeout_returns_false_and_warns(
+        self, ckpt_mgr_dir, capsys
+    ):
+        import threading as _threading
+
+        from pytorch_operator_tpu.checkpoint import CheckpointManager
+
+        gate = _threading.Event()
+        with CheckpointManager(ckpt_mgr_dir, max_to_keep=10) as mgr:
+            mgr.save(1, _state(1.0))  # blocking: builds the writer lazily?
+            # Use a staged save whose snapshot blocks to hold the drain.
+            mgr._staged = True
+            mgr.save(2, _state(2.0), block=False)
+            # Block the pipeline with a snapshot that waits on the gate.
+            mgr._writer.submit_staged(3, lambda: gate.wait(10) and {})
+            assert mgr.wait(0.05) is False
+            assert "drain timed out" in capsys.readouterr().out
+            gate.set()
+            assert mgr.wait(10.0) is True
 
     def test_torn_fault_fires_inside_async_commit(self, ckpt_mgr_dir):
         """torn_checkpoint_write on an ASYNC save: corrupt bytes under a
@@ -331,6 +512,24 @@ spec:
     backoff_limit: 3
 """
 
+STAGED_KILL_JOB = """\
+api_version: tpujob.dev/v1
+kind: TPUJob
+metadata:
+  name: staged-kill
+spec:
+  replica_specs:
+    Master:
+      replicas: 1
+      restart_policy: OnFailure
+      template:
+        module: pytorch_operator_tpu.workloads.exit_with
+        args: ["--steps", "8", "--step-time", "0.05", "--staged-checkpoint",
+               "--snapshot-time", "0.3"]
+  run_policy:
+    backoff_limit: 3
+"""
+
 ENOSPC_JOB = """\
 api_version: tpujob.dev/v1
 kind: TPUJob
@@ -435,6 +634,32 @@ def test_kill_replica_mid_async_commit_recovers(tmp_path):
 
     m = re.search(r"completed 8 steps \(resumed from (\d+)\)", log)
     assert m, log
+
+
+def test_kill_replica_mid_staged_snapshot_leaves_fenced_not_torn(tmp_path):
+    """PR-8 chaos acceptance: SIGKILL lands while saves sit in the
+    STAGED pipeline (snapshot-time 0.3 ≫ step-time 0.05, so at any kill
+    instant at least one step is fenced with its gather still pending —
+    no bytes written at all). Invariants: the kill spends exactly one
+    restart, the restart restores from a sidecar-VERIFIED step (a
+    fenced step is uncommitted, never 'unknown-accepted'), and the
+    finished job's checkpoint dir is fully verified with no stale
+    fences left behind."""
+    plan = FaultPlan(
+        seed=29,
+        faults=[Fault(kind="kill_replica", target="master-0", at=3)],
+    )
+    job, state = _run_job_with_plan(tmp_path, STAGED_KILL_JOB, plan)
+    assert job.is_succeeded()
+    assert job.status.restart_count == 1
+    log = _master_log(state)
+    import re
+
+    m = re.search(r"completed 8 steps \(resumed from (\d+)\)", log)
+    assert m, log
+    ckpt = state / "checkpoints" / "default_staged-kill"
+    assert integrity.latest_verified_step(ckpt) == 8
+    assert not list(ckpt.glob("*.inflight"))
 
 
 def test_disk_full_save_fails_loop_survives_restore_falls_back(tmp_path):

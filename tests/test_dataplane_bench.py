@@ -1,14 +1,20 @@
 """Data-plane bench smoke lane (``-m bench_smoke``, also tier-1).
 
 Runs the real harness at a small size — few steps, small model, real
-orbax saves — pinning the two data-plane invariants long before anyone
-reruns the full BENCH_dataplane.json artifact:
+orbax saves — pinning the pipelined data-plane invariants long before
+anyone reruns the full BENCH_dataplane.json artifact:
 
-- an ASYNC save stalls the step loop LESS than a blocking save of the
-  same state (the whole point of the async writer), while still ending
-  sidecar-verified;
+- a STAGED save stalls the step loop less than the PR-3 eager-async
+  save, which stalls less than a blocking save — all three of the same
+  state, all ending sidecar-verified;
 - a PREFETCHED loop issues ZERO ``device_put`` calls on the step path
-  (the transfers all ride the feed thread).
+  (the transfers all ride the producer pool);
+- a STAGED loop issues ZERO ``device_get`` calls on the step path
+  beyond the bench's own loss-fence budget (the state gather rides the
+  snapshot-stage thread);
+- under a bursty producer the AUTOTUNED feed stalls less than the
+  static ``depth=2`` feed, and its depth never exceeds the
+  ``depth_max`` budget.
 """
 
 from __future__ import annotations
@@ -35,9 +41,13 @@ def smoke_result(tmp_path_factory):
     os.environ.pop(obs_trace.ENV_VAR, None)
     obs_trace.reset_tracer()
     td = tmp_path_factory.mktemp("dataplane")
-    # Small but real: 15 steps, 3 timed saves per cell, ~1.5 MB state.
+    # Small but real: 18 steps, 3 timed saves per cell, ~1.5 MB state.
+    # checkpoint_every=6 keeps the save interval clear of the commit
+    # time at this size, so the stall ordering measures the submit
+    # protocol rather than max_pending backpressure.
     return dataplane_bench.run(
-        steps=15, checkpoint_every=5, dim=128, batch=128,
+        steps=18, checkpoint_every=6, dim=128, batch=128,
+        feed_steps=36,
         work_dir=str(td), log=lambda *_: None,
     )
 
@@ -45,6 +55,12 @@ def smoke_result(tmp_path_factory):
 def cell(result, ckpt, feed):
     return next(
         c for c in result["cells"] if c["ckpt"] == ckpt and c["feed"] == feed
+    )
+
+
+def feed_cell(result, mode):
+    return next(
+        c for c in result["feed_cells"] if c["feed_cell"] == mode
     )
 
 
@@ -62,27 +78,72 @@ class TestDataPlaneSmoke:
         )
         assert blocking["stall_ms_p50"] > 0
 
+    def test_staged_save_stalls_less_than_async(self, smoke_result):
+        """The staged pipeline's headline: a fence-only submit undercuts
+        the eager host snapshot (the full artifact pins the >=2x ratio
+        vs the PR-3 baseline; smoke sizes guarantee the ordering)."""
+        async_ = cell(smoke_result, "async", "inline")
+        staged = cell(smoke_result, "staged", "inline")
+        assert staged["stall_ms_p50"] < async_["stall_ms_p50"], (
+            staged,
+            async_,
+        )
+
     def test_prefetched_loop_zero_inline_device_puts(self, smoke_result):
-        for ckpt in ("blocking", "async"):
+        for ckpt in ("blocking", "async", "staged"):
             pf = cell(smoke_result, ckpt, "prefetched")
             inline = cell(smoke_result, ckpt, "inline")
             # Zero transfers on the step path vs one per step inline.
             assert pf["step_thread_device_puts"] == 0, pf
             assert inline["step_thread_device_puts"] == inline["steps"]
 
+    def test_staged_zero_step_thread_gathers_beyond_budget(self, smoke_result):
+        """The staged pipeline's transfer pin: the state gather NEVER
+        runs on the step thread — device_get calls there are exactly
+        the bench's own loss fences. The eager-async cells show the
+        contrast: one gather per state leaf per save on the step
+        thread."""
+        for feed in ("inline", "prefetched"):
+            staged = cell(smoke_result, "staged", feed)
+            assert staged["step_thread_gets_beyond_budget"] == 0, staged
+            eager = cell(smoke_result, "async", feed)
+            assert eager["step_thread_gets_beyond_budget"] > 0, eager
+        assert (
+            smoke_result["comparisons"]["staged_step_thread_gets_beyond_budget"]
+            == 0
+        )
+
     def test_every_cell_ends_sidecar_verified(self, smoke_result):
-        # Async saves are first-class VERIFIED checkpoints: the newest
-        # verified step equals the newest saved step in every cell.
+        # Async AND staged saves are first-class VERIFIED checkpoints:
+        # the newest verified step equals the newest saved step in
+        # every cell.
         for c in smoke_result["cells"]:
             assert c["all_saves_verified"], c
             assert c["last_verified_step"] == c["steps"]
+        assert smoke_result["comparisons"]["async_saves_verified"] is True
+
+    def test_autotuned_feed_beats_static_under_bursts(self, smoke_result):
+        """The depth-autotune pin: same bursty producer, same step —
+        the controller-grown buffer absorbs bursts the static depth=2
+        buffer cannot, and never exceeds its budget."""
+        static = feed_cell(smoke_result, "static")
+        tuned = feed_cell(smoke_result, "autotuned")
+        assert tuned["feed_stall_s_total"] < static["feed_stall_s_total"], (
+            tuned,
+            static,
+        )
+        # The controller actually acted, inside its budget.
+        assert tuned["depth_peak"] > tuned["depth_initial"], tuned
+        assert tuned["depth_peak"] <= tuned["depth_max"], tuned
+        assert static["depth_peak"] == static["depth_initial"], static
+        assert smoke_result["comparisons"]["autotuned_depth_within_max"]
 
     def test_tracing_disabled_adds_zero_step_path_spans(self, smoke_result):
         """The flight-recorder overhead pin (observability PR): with
         ``TPUJOB_TRACE_DIR`` unset, the fully instrumented step path
-        (step spans, save spans, feed-thread spans, queue-wait spans)
-        must emit ZERO span records — observability can never quietly
-        tax the hot loop."""
+        (step spans, save spans, feed-thread spans, queue-wait spans,
+        snapshot-stage spans) must emit ZERO span records —
+        observability can never quietly tax the hot loop."""
         assert smoke_result["comparisons"]["trace_disabled_zero_spans"] is True
         for c in smoke_result["cells"]:
             assert c["trace_enabled"] is False, c
@@ -113,6 +174,7 @@ class TestDataPlaneSmoke:
         out = tmp_path / "bench.json"
         dataplane_bench.run(
             steps=6, checkpoint_every=3, dim=64, batch=32,
+            feed_steps=12,
             out=str(out), work_dir=str(tmp_path), log=lambda *_: None,
         )
         data = json.loads(out.read_text())
@@ -121,9 +183,20 @@ class TestDataPlaneSmoke:
         for field in (
             "ckpt_stall_p50_reduction",
             "ckpt_stall_p99_reduction",
+            "staged_stall_p50_reduction_vs_async",
+            "staged_stall_p50_reduction_vs_blocking",
             "steps_per_sec_speedup_async",
+            "steps_per_sec_speedup_staged",
             "prefetched_step_thread_puts",
+            "staged_step_thread_gets_beyond_budget",
             "async_saves_verified",
+            "autotune_steps_per_sec_speedup",
+            "autotune_stall_reduction",
+            "autotuned_depth_within_max",
         ):
             assert field in comp
         assert comp["async_saves_verified"] is True
+        assert {c["feed_cell"] for c in data["feed_cells"]} == {
+            "static",
+            "autotuned",
+        }

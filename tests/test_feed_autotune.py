@@ -1,0 +1,303 @@
+"""Pipelined device feed: autotuner control law, sharded producer pool,
+rolling stall telemetry, and the close()-wakes-consumer regression.
+
+The autotuner (data/feed_autotune.py) is pure decision logic — bounds,
+grow-fast/shrink-slow hysteresis, warmup — so its law is pinned without
+threads. The prefetcher tests then pin the integration: FIFO
+determinism under a multi-worker pool (inline vs pipelined must train
+to the identical loss), in-order error delivery, dynamic depth, the
+rolling-window stall stat the heartbeat carries, and the PR-8 close
+fix (a step thread blocked in ``get()`` must be woken, not parked
+forever, when another thread closes the feed).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+import pytest
+
+from pytorch_operator_tpu.data.device_prefetch import (
+    STALL_WINDOW,
+    DevicePrefetcher,
+    PrefetchedLoader,
+)
+from pytorch_operator_tpu.data.feed_autotune import FeedAutotuner
+
+
+# ---- control law (pure, no threads) ----
+
+
+class TestFeedAutotuner:
+    def test_grows_in_one_observation(self):
+        at = FeedAutotuner(8, initial=2, warmup=0)
+        assert at.observe(5.0) == 4  # one stall -> double
+        assert at.grows == 1
+
+    def test_grow_is_bounded_by_depth_max(self):
+        at = FeedAutotuner(8, initial=2, warmup=0)
+        for _ in range(10):
+            at.observe(100.0)
+        assert at.depth == 8  # never above the budget
+
+    def test_never_below_floor(self):
+        at = FeedAutotuner(8, initial=1, warmup=0, shrink_patience=1)
+        for _ in range(50):
+            at.observe(0.0)
+        assert at.depth == 1  # never below 1
+
+    def test_shrink_needs_sustained_headroom(self):
+        at = FeedAutotuner(8, initial=8, warmup=0, shrink_patience=4)
+        # 3 quiet observations: not enough.
+        for _ in range(3):
+            assert at.observe(0.0) == 8
+        # A stall resets the patience counter entirely.
+        assert at.observe(5.0) == 8  # already at cap: no grow, but reset
+        for _ in range(3):
+            assert at.observe(0.0) == 8
+        # The 4th consecutive quiet observation shrinks by ONE.
+        assert at.observe(0.0) == 7
+        assert at.shrinks == 1
+
+    def test_shrink_is_one_slot_at_a_time(self):
+        at = FeedAutotuner(8, initial=8, warmup=0, shrink_patience=2)
+        for _ in range(2):
+            at.observe(0.0)
+        assert at.depth == 7  # not halved — bursts need the headroom
+
+    def test_warmup_observations_are_ignored(self):
+        # The first gets ALWAYS stall (the pipe is filling): they must
+        # not read as a stalling producer.
+        at = FeedAutotuner(8, initial=2, warmup=3)
+        for _ in range(3):
+            assert at.observe(500.0) == 2
+        assert at.observe(500.0) == 4  # first post-warmup stall grows
+
+    def test_initial_clamped_to_bounds(self):
+        assert FeedAutotuner(4, initial=9).depth == 4
+        assert FeedAutotuner(4, initial=0).depth == 1
+
+
+# ---- prefetcher integration ----
+
+
+class TestDevicePrefetcher:
+    def test_fifo_order_with_worker_pool(self):
+        """The determinism pin's mechanism: N workers, exact production
+        order out — produce() calls are serialized in ticket order, the
+        reorder buffer delivers in sequence."""
+        c = itertools.count()
+        pf = DevicePrefetcher(
+            lambda: next(c), put=lambda x: x * 10, depth=4, workers=4
+        )
+        try:
+            assert [pf.get() for _ in range(64)] == [
+                i * 10 for i in range(64)
+            ]
+        finally:
+            pf.close()
+
+    def test_close_wakes_blocked_consumer(self):
+        """PR-8 regression (satellite): a consumer blocked in get() on
+        a stalled producer must raise promptly when close() is called
+        from another thread — the old queue-based get() parked it
+        forever."""
+        woke = threading.Event()
+        outcome = {}
+        pf = DevicePrefetcher(
+            lambda: (time.sleep(30), 1)[1], put=lambda x: x, depth=2
+        )
+
+        def consumer():
+            try:
+                pf.get()
+                outcome["r"] = "got a batch?!"
+            except RuntimeError as e:
+                outcome["r"] = str(e)
+            woke.set()
+
+        t = threading.Thread(target=consumer, daemon=True)
+        t.start()
+        time.sleep(0.2)  # let it block inside get()
+        closer = threading.Thread(target=pf.close, daemon=True)
+        closer.start()
+        assert woke.wait(2.0), "consumer still blocked after close()"
+        assert outcome["r"] == "prefetcher is closed"
+
+    def test_get_after_close_raises(self):
+        pf = DevicePrefetcher(lambda: 1, put=lambda x: x, depth=2)
+        pf.get()
+        pf.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            pf.get()
+
+    def test_error_delivered_in_order_with_workers(self):
+        """A produce() failure surfaces at ITS position: every batch
+        produced before it drains first, then the error raises (and
+        keeps raising)."""
+        c = itertools.count()
+
+        def boom():
+            v = next(c)
+            if v == 5:
+                raise ValueError("decode exploded")
+            return v
+
+        pf = DevicePrefetcher(boom, put=lambda x: x, depth=3, workers=3)
+        try:
+            got = [pf.get() for _ in range(5)]
+            assert got == [0, 1, 2, 3, 4]
+            with pytest.raises(ValueError, match="decode exploded"):
+                pf.get()
+            with pytest.raises(ValueError, match="decode exploded"):
+                pf.get()  # still failed; never skips past the error
+        finally:
+            pf.close()
+
+    def test_set_depth_clamps_to_bounds(self):
+        pf = DevicePrefetcher(lambda: 1, put=lambda x: x, depth=2, depth_max=6)
+        try:
+            pf.set_depth(100)
+            assert pf.depth == 6
+            pf.set_depth(0)
+            assert pf.depth == 1
+        finally:
+            pf.close()
+
+    def test_autotune_grows_depth_on_stall_within_max(self):
+        gate = threading.Event()
+
+        def stalling_produce():
+            gate.wait(0.05)  # every batch is slow: the consumer stalls
+            return 1
+
+        pf = DevicePrefetcher(
+            stalling_produce, put=lambda x: x, depth=1, depth_max=4,
+            autotune=True,
+        )
+        try:
+            for _ in range(STALL_WINDOW):
+                pf.get()
+            assert 1 < pf.depth <= 4
+            assert pf.stats()["depth"] == pf.depth
+        finally:
+            pf.close()
+
+    def test_rolling_stall_stat_reflects_recent_burst(self):
+        """Satellite: the lifetime average dilutes a recent burst; the
+        rolling window must not. A long healthy phase then a stall
+        burst -> recent >> lifetime avg."""
+        slow = threading.Event()
+        produced = itertools.count()
+
+        def produce():
+            n = next(produced)
+            if slow.is_set():
+                time.sleep(0.02)
+            return n
+
+        pf = DevicePrefetcher(produce, put=lambda x: x, depth=1)
+        try:
+            for _ in range(400):  # healthy phase, near-zero waits
+                pf.get()
+            slow.set()
+            for _ in range(STALL_WINDOW):  # burst phase fills the window
+                pf.get()
+            s = pf.stats()
+            assert s["feed_stall_ms_recent"] > 5.0, s
+            # Lifetime mean is diluted by the 400 healthy gets...
+            assert s["feed_stall_ms_avg"] < s["feed_stall_ms_recent"], s
+            # ...and both fields coexist (back-compat contract).
+            assert "feed_stall_ms_avg" in s and "gets" in s
+        finally:
+            pf.close()
+
+    def test_heartbeat_carries_recent_not_lifetime(self):
+        from pytorch_operator_tpu.workloads.trainer import heartbeat_reporter
+
+        class FakeFeed:
+            def stats(self):
+                return {
+                    "feed_stall_ms_avg": 0.01,
+                    "feed_stall_ms_recent": 42.0,
+                }
+
+        records = []
+        report = heartbeat_reporter(
+            lambda step, **kw: records.append(kw), feed=FakeFeed()
+        )
+        report(1, 0.5, 10.0)
+        assert records[0]["feed_stall_ms"] == 42.0
+
+    def test_prefetched_loader_passes_pool_knobs(self):
+        class FakeLoader:
+            batches_per_epoch = 4
+
+            def __init__(self):
+                self._n = itertools.count()
+
+            def next_batch(self):
+                n = next(self._n)
+                import numpy as np
+
+                return 0, n, {"x": np.full((2,), n, np.float32)}
+
+            def close(self):
+                pass
+
+        pl = PrefetchedLoader(
+            FakeLoader(), 2, put=lambda f: f, workers=3, depth_max=4,
+            autotune=True,
+        )
+        try:
+            idx = [pl.next_batch()[1] for _ in range(12)]
+            assert idx == list(range(12))  # FIFO across the pool
+        finally:
+            pl.close()
+
+
+# ---- determinism pin: inline vs pipelined identical training ----
+
+
+@pytest.mark.bench_smoke
+def test_inline_vs_pipelined_feed_same_final_loss():
+    """THE data-plane determinism contract: moving the feed onto a
+    multi-worker autotuned pool changes WHERE batches are produced,
+    never WHICH batches arrive in what order — the final loss is
+    bit-identical to the inline loop."""
+    import tests.jaxenv  # noqa: F401
+
+    import jax
+
+    from pytorch_operator_tpu.workloads.dataplane_bench import _build_model
+
+    def train(feed_mode: str) -> float:
+        init_state, train_step, host_batch = _build_model(32, 16)
+        state = init_state()
+        if feed_mode == "inline":
+            feeds = (
+                jax.device_put(host_batch(i)) for i in range(20)
+            )
+            get = lambda: next(feeds)  # noqa: E731
+            close = lambda: None  # noqa: E731
+        else:
+            c = itertools.count()
+            pf = DevicePrefetcher(
+                lambda: host_batch(next(c)),
+                put=jax.device_put,
+                depth=2,
+                depth_max=6,
+                workers=4,
+                autotune=True,
+            )
+            get, close = pf.get, pf.close
+        try:
+            for _ in range(20):
+                state, loss = train_step(state, get())
+            return float(jax.device_get(loss))
+        finally:
+            close()
+
+    assert train("inline") == train("pipelined")
