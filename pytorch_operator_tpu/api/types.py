@@ -573,6 +573,90 @@ class ObservabilityPolicy:
 
 
 @dataclass
+class ServingSLOPolicy:
+    """Admission-control bar for a serving job's front queue
+    (serving/slo.py). The router judges every request against this at
+    claim time: a front queue past ``max_queue_depth`` or a request
+    older than ``deadline_s`` is SHED with an explicit overload
+    response instead of queueing unboundedly — the client learns it
+    must back off now, not after a timeout.
+    """
+
+    # Requests admitted + in flight through the router at once; arrivals
+    # past it are shed. 0 = unbounded (no depth-based shedding).
+    max_queue_depth: int = 0
+    # Per-request deadline measured from the client's submit_time; a
+    # request that cannot be dispatched before it is shed. 0 = none.
+    deadline_s: float = 0.0
+    # Re-route attempts after a replica death before the router answers
+    # the request with an error response itself.
+    retry_limit: int = 2
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.max_queue_depth:
+            d["max_queue_depth"] = self.max_queue_depth
+        if self.deadline_s:
+            d["deadline_s"] = self.deadline_s
+        if self.retry_limit != 2:
+            d["retry_limit"] = self.retry_limit
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServingSLOPolicy":
+        return cls(
+            max_queue_depth=_parse_int(
+                d.get("max_queue_depth", 0), "serving.slo.max_queue_depth"
+            ),
+            deadline_s=_parse_float(
+                d.get("deadline_s", 0.0), "serving.slo.deadline_s"
+            ),
+            retry_limit=_parse_int(
+                d.get("retry_limit", 2), "serving.slo.retry_limit"
+            ),
+        )
+
+
+@dataclass
+class ServingPolicy:
+    """Marks the job as a SERVING job and configures the serve plane
+    (serving/router.py): the supervisor hosts a request router that
+    claims from the job's client-facing FRONT spool, admission-controls
+    against ``slo``, and dispatches each request to the least-loaded
+    replica's private spool (injected per replica as
+    ``TPUJOB_SPOOL_DIR`` — runtime/env.py). Presence of this block is
+    what arms the router; an empty ``serving: {}`` is a serving job
+    with defaults, NOT a no-op — so, unlike the other optional policy
+    blocks, it round-trips even when empty.
+    """
+
+    # Client-facing front spool directory. Unset = the supervisor's
+    # default layout: <state>/serve/<ns>_<job>/front.
+    spool_dir: Optional[str] = None
+    slo: Optional[ServingSLOPolicy] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.spool_dir:
+            d["spool_dir"] = self.spool_dir
+        if self.slo is not None and (s := self.slo.to_dict()):
+            d["slo"] = s
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ServingPolicy":
+        sd = d.get("spool_dir")
+        return cls(
+            spool_dir=str(sd) if sd else None,
+            slo=(
+                ServingSLOPolicy.from_dict(d["slo"])
+                if d.get("slo") is not None
+                else None
+            ),
+        )
+
+
+@dataclass
 class TPUJobSpec:
     """The TPUJob spec (reference: PyTorchJobSpec — RunPolicy + a map
     ReplicaType→ReplicaSpec with Master exactly-1)."""
@@ -582,6 +666,8 @@ class TPUJobSpec:
     elastic_policy: Optional[ElasticPolicy] = None
     data_plane: Optional[DataPlanePolicy] = None
     observability: Optional[ObservabilityPolicy] = None
+    # Serve plane (serving/router.py); presence arms the router.
+    serving: Optional[ServingPolicy] = None
     # Coordinator (rendezvous) port — the pytorchjob-port analog.
     port: Optional[int] = None  # defaulted to DEFAULT_PORT
 
@@ -603,6 +689,10 @@ class TPUJobSpec:
             ob := self.observability.to_dict()
         ):
             d["observability"] = ob
+        if self.serving is not None:
+            # Not sparse-elided: an empty serving block still arms the
+            # router (see ServingPolicy).
+            d["serving"] = self.serving.to_dict()
         if self.port is not None:
             d["port"] = self.port
         return d
@@ -632,6 +722,11 @@ class TPUJobSpec:
             observability=(
                 ObservabilityPolicy.from_dict(d["observability"])
                 if d.get("observability") is not None
+                else None
+            ),
+            serving=(
+                ServingPolicy.from_dict(d["serving"])
+                if d.get("serving") is not None
                 else None
             ),
             port=_parse_opt_int(d, "port", "spec.port"),

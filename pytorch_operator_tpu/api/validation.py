@@ -147,6 +147,17 @@ def validate_spec(spec: TPUJobSpec) -> List[str]:
                 "is no device feed to autotune with inline transfers)"
             )
 
+    if spec.serving is not None:
+        sv = spec.serving
+        if sv.slo is not None:
+            slo = sv.slo
+            if slo.max_queue_depth < 0:
+                errs.append("spec.serving.slo.max_queue_depth: must be >= 0")
+            if slo.deadline_s < 0:
+                errs.append("spec.serving.slo.deadline_s: must be >= 0")
+            if slo.retry_limit < 0:
+                errs.append("spec.serving.slo.retry_limit: must be >= 0")
+
     if spec.observability is not None:
         ob = spec.observability
         if ob.trace_ring_bytes < 0:

@@ -1211,7 +1211,12 @@ def cmd_metrics(args) -> int:
 def cmd_serve_request(args) -> int:
     """Submit a request to a serving job's spool and (optionally) wait
     for the response — the client half of the serving service
-    (serving/spool.py; the serve workload is the engine half)."""
+    (serving/spool.py; the serve workload is the engine half).
+
+    ``--job`` targets a ``spec.serving`` job's FRONT spool (resolved
+    from the supervisor state layout — the router fans the request out
+    across replicas); ``--spool`` names a spool directory directly
+    (single-engine serve jobs that picked their own path)."""
     from pathlib import Path
 
     from pytorch_operator_tpu.serving import Spool
@@ -1222,6 +1227,39 @@ def cmd_serve_request(args) -> int:
             file=sys.stderr,
         )
         return 2
+    if (args.spool is None) == (args.job is None):
+        print(
+            "exactly one of --spool / --job is required",
+            file=sys.stderr,
+        )
+        return 2
+    if args.job is not None:
+        from pytorch_operator_tpu.controller.store import JobStore
+        from pytorch_operator_tpu.serving.router import (
+            front_spool_dir,
+            serve_root_dir,
+        )
+
+        state = _state_dir(args)
+        key = (
+            args.job
+            if "/" in args.job
+            else f"{args.namespace}/{args.job}"
+        )
+        job = JobStore(persist_dir=state / "jobs").get(key)
+        if job is None:
+            print(f"error: tpujob {key} not found", file=sys.stderr)
+            return 1
+        if job.spec.serving is None:
+            print(
+                f"error: tpujob {key} has no spec.serving block — not a "
+                "serving job (use --spool for raw spools)",
+                file=sys.stderr,
+            )
+            return 2
+        args.spool = str(
+            front_spool_dir(serve_root_dir(state), key, job.spec.serving)
+        )
     prompt = None
     if args.prompt is not None:
         try:
@@ -1303,6 +1341,25 @@ def cmd_bench_data_plane(args) -> int:
     if args.out:
         argv += ["--out", args.out]
     return dataplane_bench.main(argv)
+
+
+def cmd_bench_serve_plane(args) -> int:
+    """Serve-plane benchmark: routed goodput / shed / TTFT across
+    replica counts x {healthy, kill_replica, fail_engine_step}, plus
+    the zero-router-overhead idle cell (workloads/serveplane_bench)."""
+    from pytorch_operator_tpu.workloads import serveplane_bench
+
+    argv = [
+        "--replicas", args.replicas,
+        "--scenarios", args.scenarios,
+        "--rate", str(args.rate),
+        "--duration", str(args.duration),
+    ]
+    if args.smoke:
+        argv.append("--smoke")
+    if args.out:
+        argv += ["--out", args.out]
+    return serveplane_bench.main(argv)
 
 
 def cmd_manifests(args) -> int:
@@ -1717,11 +1774,50 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(func=cmd_bench_data_plane)
 
     sp = sub.add_parser(
+        "bench-serve-plane",
+        help="measure routed serving goodput/shed/TTFT across replica "
+        "counts x {healthy, kill_replica, fail_engine_step} plus the "
+        "zero-router-overhead idle cell; emits a JSON artifact",
+    )
+    sp.add_argument(
+        "--replicas", default="1,2,4",
+        help="comma-separated replica counts per scenario",
+    )
+    sp.add_argument(
+        "--scenarios", default="healthy,kill_replica,fail_engine_step",
+    )
+    sp.add_argument(
+        "--rate", type=float, default=85.0,
+        help="offered load, requests/s (open-loop Poisson)",
+    )
+    sp.add_argument(
+        "--duration", type=float, default=6.0,
+        help="arrival window per cell, seconds",
+    )
+    sp.add_argument(
+        "--smoke", action="store_true",
+        help="tiny under-capacity cells — seconds, not minutes",
+    )
+    sp.add_argument(
+        "--out", default=None,
+        help="write the full artifact here (e.g. BENCH_serveplane.json)",
+    )
+    sp.set_defaults(func=cmd_bench_serve_plane)
+
+    sp = sub.add_parser(
         "serve-request",
         help="submit a request to a serving job's spool and print the "
         "response (tokens + TTFT/per-token latency)",
     )
-    sp.add_argument("--spool", required=True, help="the serve job's --spool dir")
+    sp.add_argument(
+        "--spool", default=None, help="a serve job's --spool dir directly"
+    )
+    sp.add_argument(
+        "--job", default=None,
+        help="a spec.serving job (name or ns/name): submit to its FRONT "
+        "spool — the supervisor's router dispatches across replicas",
+    )
+    add_ns(sp)
     sp.add_argument(
         "--prompt", default=None,
         help="comma-separated token ids (no tokenizer ships here)",

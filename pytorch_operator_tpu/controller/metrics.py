@@ -311,6 +311,54 @@ class MetricsRegistry:
             "Mean step-loop wait on the device feed per get (0 = the feed "
             "thread keeps ahead), as reported in progress heartbeats",
         )
+        # ---- serve plane (serving/router.py) ----
+        # Folded per pass for serving jobs only; a fleet with no
+        # serving jobs never creates a single serve series (the
+        # bench_smoke zero-overhead pin).
+        self.job_serve_queue_depth = self.gauge(
+            "tpujob_job_serve_queue_depth",
+            "Front-queue depth per serving job (unclaimed + undispatched "
+            "requests ahead of admission)",
+        )
+        self.job_serve_inflight = self.gauge(
+            "tpujob_job_serve_inflight",
+            "Requests admitted and in flight through the router per "
+            "serving job",
+        )
+        self.job_serve_replicas = self.gauge(
+            "tpujob_job_serve_replicas",
+            "Alive serving replicas the router can dispatch to, per job",
+        )
+        self.job_serve_slots_free = self.gauge(
+            "tpujob_job_serve_slots_free",
+            "Free decode slots summed across a serving job's replicas "
+            "(from serve telemetry records)",
+        )
+        self.serve_requests = self.counter(
+            "tpujob_serve_requests_total",
+            "Responses the router published, per job and outcome "
+            "(ok / shed / error)",
+        )
+        self.serve_rerouted = self.counter(
+            "tpujob_serve_rerouted_total",
+            "Requests re-enqueued to another replica after a replica "
+            "death, per job",
+        )
+        self.serve_ttft_seconds = self.histogram(
+            "tpujob_serve_ttft_seconds",
+            "Client-perceived time to first token per serving job "
+            "(submit -> first token, queue wait included), with request "
+            "exemplars",
+        )
+        self.serve_tpot_seconds = self.histogram(
+            "tpujob_serve_tpot_seconds",
+            "Per-output-token decode latency per serving job",
+        )
+        self.serve_queue_wait_seconds = self.histogram(
+            "tpujob_serve_queue_wait_seconds",
+            "Front-queue wait per request (submit -> dispatch to a "
+            "replica spool)",
+        )
         # Live mirrors of the bench-only I/O instrumentation: idle-I/O
         # regressions become visible in production, not just in
         # BENCH_ctrlplane.json (store deltas folded once per pass).
@@ -330,6 +378,16 @@ class MetricsRegistry:
                 "(ProgressTailer fold stats, folded per sync pass)",
             )
             for k in ("dir_scans", "file_reads", "bytes_read")
+        }
+        self.router_io = {
+            k: self.counter(
+                f"tpujob_serve_router_{k}_total",
+                f"Serve-plane router {k.replace('_', ' ')} "
+                "(RouterIOCounters, folded per sync pass; all zero "
+                "when no serving jobs exist)",
+            )
+            for k in ("ticks", "front_scans", "dispatches", "publishes",
+                      "sweeps")
         }
 
     def counter(self, name: str, help_text: str = "") -> Counter:

@@ -69,7 +69,12 @@ def _job_status_dir_cached(status_root: str, key: str) -> Path:
 # the checkpoint-lag / queue-depth surfaces; ``clock_probe`` is the
 # replica's echo of the supervisor's round-trip clock probe
 # (obs/clock.py — the record's own ``ts`` is the echo send time on the
-# replica clock, ``probe_ts`` the supervisor's write time).
+# replica clock, ``probe_ts`` the supervisor's write time); ``serve``
+# is the serve plane's load beat — engine replicas report slot
+# occupancy / queue / latency percentiles (rendezvous.report_serve)
+# and the router reports front-queue depth as replica ``router``
+# (serving/router.py) — feeding the router's load scores, the serve
+# gauges, and the queue_growth / batch_size_collapse detectors.
 TAILED_KINDS: dict = {
     "progress": (
         "ts", "step", "loss", "steps_per_sec", "throughput",
@@ -80,6 +85,11 @@ TAILED_KINDS: dict = {
         "stage_depth",
     ),
     "clock_probe": ("ts", "probe_ts", "seq"),
+    "serve": (
+        "ts", "slots", "slots_free", "queued", "pending", "requests",
+        "ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50", "tpot_ms_p99",
+        "queue_depth", "inflight", "replicas", "routed", "shed",
+    ),
 }
 
 _NUMERIC_FIELDS = TAILED_KINDS["progress"]

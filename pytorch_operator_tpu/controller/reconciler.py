@@ -75,6 +75,7 @@ class Reconciler:
         coordinator_host: str = "127.0.0.1",
         queue_slots: Optional[dict] = None,
         trace_root: Optional[Path] = None,
+        serve_root: Optional[Path] = None,
     ):
         self.store = store
         self.runner = runner
@@ -88,6 +89,10 @@ class Reconciler:
         # tracing (spec.observability.trace) or the supervisor itself is
         # traced (TPUJOB_TRACE_DIR armed — trace everything).
         self.trace_root = Path(trace_root) if trace_root else None
+        # Serve plane (serving/router.py): serving jobs' spool trees
+        # live under here; each serving replica gets a private spool
+        # injected as TPUJOB_SPOOL_DIR. None = serve plane off.
+        self.serve_root = Path(serve_root) if serve_root else None
         # ONE cache for the whole state dir (not per-job): the win is a
         # resubmitted job hitting the previous run's compiled executables.
         self.cache_root = Path(cache_root) if cache_root else None
@@ -808,9 +813,24 @@ class Reconciler:
             num_processes = sum(
                 self._desired_replicas(job, rt) for rt in job.spec.replica_specs
             )
+            serve_job = (
+                job.spec.serving is not None and self.serve_root is not None
+            )
             self.expectations.expect_creations(key, len(missing), now=now)
             try:
                 for rtype, index in missing:
+                    spool_dir = None
+                    if serve_job:
+                        # The router derives the identical path from the
+                        # runner handle (serving/router.replica_spool_dir
+                        # — layout IS the contract).
+                        from ..serving.router import replica_spool_dir
+
+                        sd = replica_spool_dir(
+                            self.serve_root, key, rtype.value, index
+                        )
+                        sd.mkdir(parents=True, exist_ok=True)
+                        spool_dir = str(sd)
                     env = build_cluster_env(
                         job, rtype, index,
                         num_processes=num_processes,
@@ -819,6 +839,7 @@ class Reconciler:
                         checkpoint_dir=checkpoint_dir,
                         compile_cache_dir=cache_dir,
                         trace_dir=trace_dir,
+                        spool_dir=spool_dir,
                     )
                     self.runner.create(
                         key, rtype, index, job.spec.replica_specs[rtype].template, env

@@ -188,6 +188,7 @@ class Supervisor:
             cache_root=self.state_dir / "xla_cache",
             queue_slots=queue_slots,
             trace_root=self.state_dir / "trace",
+            serve_root=self.state_dir / "serve",
         )
         # Flight-recorder wiring (obs/): the store times its own
         # persist/rescan into these histograms, and the per-pass counter
@@ -224,6 +225,18 @@ class Supervisor:
         from ..obs.watch import WatchEngine
 
         self.watch = WatchEngine(self.state_dir)
+        # Serve plane (serving/router.py): the request router for
+        # spec.serving jobs, ticked from the gauge fold. Jobs without a
+        # serving block never reach it — one ``is None`` check per job
+        # per pass, no extra I/O, <state>/serve never created (the
+        # bench_smoke zero-overhead pin).
+        from ..serving.router import ServeRouter
+
+        self.router = ServeRouter(self.state_dir, metrics=self.metrics)
+        self._router_io_seen = self.router.io.snapshot()
+        # Serving jobs whose end-of-life drain already ran (the drain
+        # scans the front spool — once, not every pass).
+        self._serve_finalized: set = set()
         if self.shards is not None:
             # Markers are consumed by rename-claim (exactly-once): a
             # sharded supervisor must not claim one for a job another
@@ -616,6 +629,10 @@ class Supervisor:
                     and self._steady_ok.get(key) == gen
                     and not job.spec.run_policy.suspend
                     and job.spec.elastic_policy is None
+                    # Serving jobs route requests from the gauge fold
+                    # every pass; the fast path's stash-skip would
+                    # starve the router between heartbeats.
+                    and job.spec.serving is None
                     and self._fast_skip(key, job)
                 ):
                     fast_skips += 1
@@ -913,6 +930,12 @@ class Supervisor:
             if delta:
                 counter.inc(delta)
         self._progress_io_seen = cur
+        cur = self.router.io.snapshot()
+        for k, counter in m.router_io.items():
+            delta = cur[k] - self._router_io_seen.get(k, 0)
+            if delta:
+                counter.inc(delta)
+        self._router_io_seen = cur
 
     def _update_progress_gauges(self, jobs) -> None:
         """Fold each unfinished job's newest workload heartbeat
@@ -956,6 +979,18 @@ class Supervisor:
                 # the finish, not dangling. Idempotent after the first
                 # pass (state already dropped).
                 self.watch.finalize(key)
+                if (
+                    job.spec.serving is not None
+                    and key not in self._serve_finalized
+                ):
+                    # Serve-plane end-of-life: drain the front queue
+                    # with terminal error responses so no client waits
+                    # out a timeout. Once — the guard set keeps a
+                    # finished-but-undeleted serving job from paying a
+                    # spool scan every pass.
+                    self._serve_finalized.add(key)
+                    self.router.finalize(key, job)
+                    self.router.retire_job(key)
                 continue
             status_dir = job_status_dir(root, key)
             if key in self._pass_polled:
@@ -1046,6 +1081,19 @@ class Supervisor:
                     m.checkpoint_commit_seconds.observe(
                         float(ck["commit_ms"]) / 1000.0, exemplar=ex, job=key
                     )
+            if job.spec.serving is not None:
+                # Serve plane: route this job's requests on the pass
+                # cadence. The replica set is the runner's handle index
+                # (the same truth reconcile acts on); per-replica load
+                # comes from the serve telemetry already tailed above —
+                # the router adds no fold I/O of its own.
+                self.router.tick(
+                    key,
+                    job,
+                    self.runner.list_for_job(key),
+                    by_replica,
+                    status_dir=status_dir,
+                )
 
     def _record_clock_observations(
         self, key: str, status_dir, by_replica: Optional[dict] = None
@@ -1186,6 +1234,8 @@ class Supervisor:
         registry bounded (pinned by tests/test_obs_analyze.py)."""
         self.metrics.retire_job(key)
         self.watch.retire_job(key)
+        self.router.retire_job(key)
+        self._serve_finalized.discard(key)
         self._steady_gen.pop(key, None)
         self._steady_ok.pop(key, None)
         self._dir_empty.pop(key, None)

@@ -79,6 +79,20 @@ class Thresholds:
     # host attributes the regression to the host, not the jobs.
     noisy_neighbor_min_jobs: int = 2
 
+    # queue_growth (serve plane): the router's front-queue depth never
+    # falling over the last N samples AND rising by at least this much
+    # net — arrivals are outpacing aggregate decode service.
+    queue_growth_samples: int = 4
+    queue_growth_min: float = 4.0
+
+    # batch_size_collapse (serve plane): recent median busy-slot count
+    # (slots - slots_free, summed across replicas per beat) under the
+    # job's own earlier baseline by this factor; a tiny baseline is an
+    # idle job, not a collapse.
+    collapse_factor: float = 2.0
+    collapse_min_baseline: float = 2.0
+    collapse_min_samples: int = 3
+
 
 DEFAULT_THRESHOLDS = Thresholds()
 
@@ -174,7 +188,8 @@ def ev_status(rec: dict, kind: str) -> dict:
         "ts": round(float(rec.get("aligned_ts", rec.get("ts", 0.0))), 6),
     }
     for f in ("step", "step_time_ms", "feed_stall_ms", "queue_depth",
-              "commit_ms"):
+              "commit_ms", "slots", "slots_free", "inflight",
+              "ttft_ms_p99", "shed"):
         if rec.get(f) is not None:
             out[f] = rec[f]
     return out
@@ -531,12 +546,168 @@ def detect_straggler(
     ]
 
 
+# The serve-plane rules read the "serve" status stream, which carries
+# two shapes under one kind: the ROUTER's beat (has queue_depth /
+# inflight, written to router.jsonl so its replica name is "router")
+# and each ENGINE replica's occupancy beat (has slots / slots_free).
+# Field presence — not replica name — selects the shape, so a renamed
+# router stays detectable.
+
+#: Replica-death / membership-change event reasons a serve-plane
+#: finding cites as the likely cause (the chaos kill, a crashed
+#: replica's restart, a preemption, an elastic scale-down).
+_DEATH_REASONS = (
+    "FaultInjected",
+    "TPUJobRestarting",
+    "TPUJobPreempted",
+    "ElasticScaledDown",
+)
+
+
+def detect_queue_growth(
+    tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
+) -> List[Finding]:
+    """The router's front queue only growing: every sample in the tail
+    at least as deep as the one before AND a net rise past the floor.
+    A queue that breathes (fills, drains) is healthy batching; one that
+    ratchets up is an offered load the replica set cannot clear —
+    deadline sheds follow."""
+    recs = [
+        r
+        for r in tl.records.get("serve", [])
+        if r.get("queue_depth") is not None
+        and tl.in_window(float(r.get("aligned_ts", r.get("ts", 0.0))))
+    ]
+    if len(recs) < th.queue_growth_samples:
+        return []
+    recs.sort(key=lambda r: float(r.get("aligned_ts", r.get("ts", 0.0))))
+    tail = recs[-th.queue_growth_samples:]
+    depths = [float(r["queue_depth"]) for r in tail]
+    rise = depths[-1] - depths[0]
+    if (
+        any(b < a for a, b in zip(depths, depths[1:]))
+        or rise < th.queue_growth_min
+    ):
+        return []
+    evidence = [ev_status(tail[0], "serve"), ev_status(tail[-1], "serve")]
+    death = tl.find_event(*_DEATH_REASONS)
+    cause = ""
+    if death is not None:
+        evidence.append(ev_event(death))
+        cause = (
+            f"; coincides with {death.get('reason')} — lost decode "
+            "capacity, not extra load"
+        )
+    return [
+        Finding(
+            rule="queue_growth",
+            severity="warning",
+            summary=(
+                f"serve front queue only grows: depth "
+                f"{depths[0]:.0f} -> {depths[-1]:.0f} over the last "
+                f"{len(tail)} beats — arrivals outpace decode "
+                f"service{cause}"
+            ),
+            evidence=evidence,
+            metrics={
+                "depth_first": depths[0],
+                "depth_last": depths[-1],
+                "rise": rise,
+                "n": len(tail),
+            },
+        )
+    ]
+
+
+def detect_batch_size_collapse(
+    tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
+) -> List[Finding]:
+    """Live decode batch (busy slots summed across engine replicas,
+    per beat) collapsing against the job's own baseline. The classic
+    cause is a replica death: the survivors' occupancy cannot cover the
+    lost slots, TTFT spikes, and ``why`` should say so — the coinciding
+    death event rides along as evidence. Recent/baseline split mirrors
+    detect_step_time_regression."""
+    samples = [
+        r
+        for r in tl.records.get("serve", [])
+        if r.get("slots") is not None and r.get("slots_free") is not None
+    ]
+    if not samples:
+        return []
+    samples.sort(key=lambda r: float(r.get("aligned_ts", r.get("ts", 0.0))))
+    # One occupancy point per beat: sum busy slots across replicas
+    # reporting in the same beat bucket (the report cadence).
+    beats: Dict[int, float] = {}
+    beat_recs: Dict[int, dict] = {}
+    for r in samples:
+        ts = float(r.get("aligned_ts", r.get("ts", 0.0)))
+        bucket = int(ts)
+        beats[bucket] = beats.get(bucket, 0.0) + (
+            float(r["slots"]) - float(r["slots_free"])
+        )
+        beat_recs[bucket] = r
+    points = [
+        (float(b), occ, beat_recs[b]) for b, occ in sorted(beats.items())
+    ]
+    if tl.window_s is not None:
+        recent = [p for p in points if tl.in_window(p[0])]
+        baseline = [p for p in points if not tl.in_window(p[0])]
+    else:
+        cut = max(len(points) - max(len(points) // 4, 2), 0)
+        baseline, recent = points[:cut], points[cut:]
+    if len(baseline) < th.collapse_min_samples or len(recent) < 2:
+        return []
+    base_med = _median([p[1] for p in baseline])
+    rec_med = _median([p[1] for p in recent])
+    if (
+        base_med < th.collapse_min_baseline
+        or rec_med > base_med / th.collapse_factor
+    ):
+        return []
+    evidence = [
+        ev_status(baseline[-1][2], "serve"),
+        ev_status(recent[-1][2], "serve"),
+    ]
+    death = tl.find_event(*_DEATH_REASONS)
+    cause = ""
+    if death is not None:
+        evidence.append(ev_event(death))
+        cause = (
+            f" — coincides with {death.get('reason')}: a replica death "
+            "explains the lost slots (and the TTFT spike on what "
+            "remains)"
+        )
+    return [
+        Finding(
+            rule="batch_size_collapse",
+            severity="warning",
+            summary=(
+                f"live decode batch collapsed: busy slots "
+                f"{base_med:.1f} -> {rec_med:.1f} "
+                f"({base_med / max(rec_med, 1e-9):.1f}x under the "
+                f"job's own baseline){cause}"
+            ),
+            evidence=evidence,
+            metrics={
+                "baseline_busy": base_med,
+                "recent_busy": rec_med,
+                "factor": base_med / max(rec_med, 1e-9),
+                "baseline_n": len(baseline),
+                "recent_n": len(recent),
+            },
+        )
+    ]
+
+
 DETECTORS: Tuple[Callable[..., List[Finding]], ...] = (
     detect_heartbeat_silence,
     detect_step_time_regression,
     detect_feed_stall,
     detect_checkpoint_lag,
     detect_straggler,
+    detect_queue_growth,
+    detect_batch_size_collapse,
 )
 
 #: Every rule either engine can produce (the alert/report inventory).
@@ -546,6 +717,8 @@ RULES = (
     "feed_stall_dominance",
     "checkpoint_lag",
     "straggler",
+    "queue_growth",
+    "batch_size_collapse",
     "noisy_neighbor",
 )
 

@@ -27,6 +27,8 @@ from .metrics import histogram_quantile, parse_exemplars, parse_prometheus_text
 from .watch import fold_alert_log, load_alert_log
 
 STEP_HIST = "tpujob_step_time_seconds"
+SERVE_TTFT_HIST = "tpujob_serve_ttft_seconds"
+SERVE_QUEUE_GAUGE = "tpujob_job_serve_queue_depth"
 
 # The table's columns: (header, row key) in display order — one list so
 # the renderer, the sort-key cycling (`tpujob top` 's' key), and tests
@@ -40,6 +42,8 @@ COLUMNS = (
     ("P99(ms)", "p99_ms"),
     ("CKPT LAG", "ckpt_lag"),
     ("FEED(ms)", "feed_stall_ms"),
+    ("SRV Q", "serve_q"),
+    ("TTFT99", "ttft_p99_ms"),
     ("HB AGE", "age_s"),
     ("ALERTS", "alerts"),
     ("RESTARTS", "restarts"),
@@ -77,6 +81,18 @@ def _hist_quantiles(
     if p50 is None:
         return None
     return p50, p99
+
+
+def _gauge(metrics: Dict, name: str, job: str) -> Optional[float]:
+    """One job's gauge value from the merged exposition text, or None
+    (no daemon, or the job has no such series)."""
+    for labels, v in metrics.get(name, ()):
+        if labels.get("job") == job:
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                return None
+    return None
 
 
 def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
@@ -128,6 +144,17 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
         hb = read_latest_event(d, "progress") or {}
         ck = read_latest_event(d, "checkpoint_committed") or {}
         q = _hist_quantiles(metrics, STEP_HIST, key)
+        # Serve plane: front-queue depth from the router's gauge (the
+        # daemon writes it every pass), falling back to the newest
+        # ``serve`` status record so a daemon-less snapshot still
+        # answers; client-perceived TTFT p99 from the serve histogram
+        # with the engines' self-reported percentile as fallback.
+        sv = read_latest_event(d, "serve") or {}
+        serve_q = _gauge(metrics, SERVE_QUEUE_GAUGE, key)
+        if serve_q is None:
+            serve_q = sv.get("queue_depth")
+        tq = _hist_quantiles(metrics, SERVE_TTFT_HIST, key)
+        ttft_p99 = 1000 * tq[1] if tq else sv.get("ttft_ms_p99")
         step = hb.get("step")
         ck_step = ck.get("step")
         # Live health engine state (obs/watch.py alert log): the rules
@@ -156,6 +183,8 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
                     else None
                 ),
                 "feed_stall_ms": hb.get("feed_stall_ms"),
+                "serve_q": serve_q,
+                "ttft_p99_ms": ttft_p99,
                 "age_s": (now - hb["ts"]) if hb.get("ts") else None,
                 "alerts": len(firing) or None,
                 "alert_rules": sorted(firing),
@@ -241,6 +270,8 @@ def _cells(r: dict) -> tuple:
         _fmt(r["p99_ms"], ".1f"),
         _fmt(r["ckpt_lag"]),
         _fmt(r["feed_stall_ms"], ".2f"),
+        _fmt(None if r.get("serve_q") is None else int(r["serve_q"])),
+        _fmt(r.get("ttft_p99_ms"), ".1f"),
         _fmt(None if r["age_s"] is None else f"{r['age_s']:.0f}s"),
         (
             f"{r['alerts']}:{','.join(r.get('alert_rules', []))}"

@@ -303,7 +303,7 @@ class _JobWatch:
 
 # The record kinds the live window accumulates (a subset of
 # progress.TAILED_KINDS — clock_probe is the estimator's, not a rule's).
-_WATCHED_KINDS = ("progress", "checkpoint_committed")
+_WATCHED_KINDS = ("progress", "checkpoint_committed", "serve")
 
 
 class WatchEngine:

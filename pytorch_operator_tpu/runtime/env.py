@@ -38,6 +38,7 @@ def build_cluster_env(
     checkpoint_dir: Optional[str] = None,
     compile_cache_dir: Optional[str] = None,
     trace_dir: Optional[str] = None,
+    spool_dir: Optional[str] = None,
 ) -> Dict[str, str]:
     """Build the injected environment for one replica process.
 
@@ -118,6 +119,18 @@ def build_cluster_env(
         env["TPUJOB_ALERTS"] = _json.dumps(
             ob.alerts.to_dict(), sort_keys=True
         )
+    # Serve plane (spec.serving): each serving replica gets its OWN
+    # spool directory — the router's dispatch target for this replica —
+    # so `workloads/serve.py --spool` needs no per-replica args
+    # plumbing. The SLO block rides along as JSON for replica-side
+    # tooling parity, like TPUJOB_ALERTS.
+    if spool_dir is not None:
+        env["TPUJOB_SPOOL_DIR"] = spool_dir
+    sv = job.spec.serving
+    if sv is not None:
+        import json as _json
+
+        env["TPUJOB_SERVING"] = _json.dumps(sv.to_dict(), sort_keys=True)
     # Data-plane policy (spec.data_plane): workloads read these as the
     # defaults for --async-checkpoint / --prefetch, so host-I/O overlap
     # is a SPEC property, not per-workload args plumbing.

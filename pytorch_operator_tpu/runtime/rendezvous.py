@@ -253,6 +253,41 @@ def report_progress(
     _maybe_echo_probe()
 
 
+def report_serve(
+    requests: int,
+    *,
+    slots: int,
+    slots_free: int,
+    queued: int = 0,
+    pending: int = 0,
+    ttft_ms_p50: Optional[float] = None,
+    ttft_ms_p99: Optional[float] = None,
+    tpot_ms_p50: Optional[float] = None,
+    tpot_ms_p99: Optional[float] = None,
+) -> None:
+    """Serve-plane load beat: slot occupancy, queue depth, and latency
+    percentiles for this engine replica. The supervisor's router
+    (serving/router.py) reads the newest record per replica from the
+    heartbeat fold — zero extra I/O — to score least-loaded dispatch,
+    and the queue_growth / batch_size_collapse detectors judge the same
+    stream. Emit on the serve loop's report cadence, like progress."""
+    fields: dict = {
+        "slots": int(slots),
+        "slots_free": int(slots_free),
+        "queued": int(queued),
+        "pending": int(pending),
+    }
+    for k, v in (
+        ("ttft_ms_p50", ttft_ms_p50),
+        ("ttft_ms_p99", ttft_ms_p99),
+        ("tpot_ms_p50", tpot_ms_p50),
+        ("tpot_ms_p99", tpot_ms_p99),
+    ):
+        if v is not None:
+            fields[k] = round(float(v), 3)
+    report("serve", requests=int(requests), **fields)
+
+
 def report_checkpoint_committed(
     step: int,
     commit_s: float,

@@ -6,7 +6,14 @@ training operator's long-running reconciled workload).
   accounting (TTFT / per-token percentiles).
 - :mod:`spool` — file-based request/response IPC (this environment has
   no network; local spool directories are the transport).
+- :mod:`router` — the supervisor-hosted serve-plane router: front-spool
+  admission control (:mod:`slo`) + least-loaded dispatch across the
+  job's replica spools with bounded retry-on-replica-death.
+- :mod:`slo` — admission decisions and per-request SLO accounting
+  shared by the router and the serve-plane bench.
 """
 
 from .engine import Request, RequestResult, ServingEngine  # noqa: F401
+from .router import ServeRouter  # noqa: F401
+from .slo import SLO, SLOStats  # noqa: F401
 from .spool import Spool  # noqa: F401

@@ -410,6 +410,12 @@ class ServingEngine:
         return len(self._queue)
 
     @property
+    def slots_free(self) -> int:
+        """Unoccupied cache slots — the serve-plane load beat's
+        headroom signal (rendezvous.report_serve)."""
+        return sum(1 for s in self._slots if s is None)
+
+    @property
     def busy(self) -> bool:
         return bool(self._queue) or any(
             s is not None for s in self._slots

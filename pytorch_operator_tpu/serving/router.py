@@ -1,0 +1,591 @@
+"""Supervisor-hosted request router: the serve plane's control point.
+
+A serving job (``spec.serving`` present) gets many engine replicas but
+clients see ONE front spool. The router closes the gap each sync pass
+(``ServeRouter.tick`` — called from the supervisor's gauge fold, so it
+rides the existing per-pass cadence and costs literally one ``is
+None`` check per job when no serving jobs exist):
+
+1. **Discovery** — the serving replica set is the runner's handle
+   index for the job, the same source reconcile trusts; each replica
+   owns a private spool at a layout-derived path
+   (:func:`replica_spool_dir`) injected into its environment as
+   ``TPUJOB_SPOOL_DIR`` (runtime/env.py).
+2. **Load tracking** — per-replica live load comes from the ``serve``
+   telemetry records the heartbeat fold already tails (slots free,
+   queue depth, p99 per-token latency — zero extra I/O), corrected by
+   the router's own in-flight accounting for dispatches newer than the
+   last telemetry beat.
+3. **Admission** — every front-queue claim is judged by
+   ``spec.serving.slo`` (serving/slo.py): over-depth or past-deadline
+   requests are SHED with an explicit overload response instead of
+   queueing unboundedly.
+4. **Dispatch** — admitted requests go to the least-loaded alive
+   replica's spool, record verbatim (the client's ``submit_time``
+   rides along, so engine TTFT stays client-perceived).
+5. **Retry-on-death** — an in-flight request whose replica died is
+   pulled back (best-effort cancel from the dead replica's spool) and
+   re-enqueued on the shared ``backoff.py`` schedule, at most
+   ``slo.retry_limit`` re-routes; past that, the router answers with
+   an error itself. Publication to the front spool goes through
+   ``Spool.respond_once`` (hard-link exclusivity), so a re-routed —
+   or router-restart re-driven — request can never produce two
+   responses.
+6. **Accounting** — TTFT / per-token / queue-wait land in per-job
+   ``obs`` histograms with request-id exemplars, front-queue depth and
+   shed/routed counters in a throttled ``serve`` status record
+   (``router.jsonl``), so ``tpujob top``, ``/metrics``, the live
+   watch, and ``tpujob why`` all see the serve plane through the
+   channels they already read.
+
+Router restart is a non-event: front ``claimed/`` entries without a
+front response are re-adopted on the first tick (checked against every
+alive replica's spool before re-dispatch), and ``respond_once``
+guarantees the client still sees exactly one response.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import zlib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Optional
+
+from ..backoff import Backoff
+from .slo import ADMIT, SHED_DEADLINE, SLO, overload_response
+from .spool import Spool
+
+# Front-claim bound per tick: keeps one pass O(batch) even when a
+# client floods the spool; the rest is claimed next pass (and judged
+# against the deadline then — aging in requests/ still counts).
+CLAIM_BATCH = 256
+# Stale-tmp GC cadence — the store's stale-tmp sweep cadence, applied
+# to the spool dirs the router owns.
+SWEEP_EVERY_S = 30.0
+# serve status-record cadence (router.jsonl — the watch/why sample
+# stream; sub-second would just burn tail bytes).
+REPORT_EVERY_S = 1.0
+
+
+def serve_root_dir(state_dir) -> Path:
+    """``<state>/serve`` — created lazily by the first serving job's
+    tick; a fleet with no serving jobs never materializes it (the
+    bench_smoke zero-overhead pin)."""
+    return Path(state_dir) / "serve"
+
+
+def job_serve_dir(serve_root, key: str) -> Path:
+    from ..controller.store import key_to_fs
+
+    return Path(serve_root) / key_to_fs(key)
+
+
+def front_spool_dir(serve_root, key: str, serving) -> Path:
+    """The client-facing spool: ``spec.serving.spool_dir`` when set
+    (clients already know the path), else the state-dir layout."""
+    if serving is not None and serving.spool_dir:
+        return Path(serving.spool_dir)
+    return job_serve_dir(serve_root, key) / "front"
+
+
+def replica_spool_dir(
+    serve_root, key: str, rtype_value: str, index: int
+) -> Path:
+    """One replica's private dispatch spool. The reconciler injects
+    this path as the replica's ``TPUJOB_SPOOL_DIR``; the router derives
+    the identical path from the handle — layout IS the contract (one
+    definition, imported by both)."""
+    return (
+        job_serve_dir(serve_root, key)
+        / "replicas"
+        / f"{rtype_value.lower()}-{index}"
+    )
+
+
+class RouterIOCounters:
+    """Per-router work accounting, mirrored onto ``/metrics`` like the
+    tailer's — the serve plane's zero-idle-overhead pin reads these
+    (all zero when no serving jobs exist, because tick is never
+    called)."""
+
+    __slots__ = ("ticks", "front_scans", "dispatches", "publishes", "sweeps")
+
+    def __init__(self) -> None:
+        self.ticks = 0
+        self.front_scans = 0
+        self.dispatches = 0
+        self.publishes = 0
+        self.sweeps = 0
+
+    def snapshot(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+@dataclass
+class _Inflight:
+    """One admitted request the router is responsible for answering."""
+
+    rec: dict
+    rid: str
+    submit_time: float
+    # Replica stem (``master-0``) currently holding the request; None =
+    # undispatched (fresh admit, retry-pending, or no replica alive).
+    replica: Optional[str] = None
+    attempts: int = 0  # dispatches so far
+    retry_at: float = 0.0  # backoff gate for the next dispatch
+    first_dispatch: Optional[float] = None  # queue-wait endpoint
+    recovered: bool = False  # re-adopted after a router restart
+
+
+@dataclass
+class _JobState:
+    front: Spool
+    backoff: Backoff
+    inflight: Dict[str, _Inflight] = field(default_factory=dict)
+    routed: int = 0
+    shed: int = 0
+    ok: int = 0
+    errors: int = 0
+    rerouted: int = 0
+    dup_avoided: int = 0
+    last_sweep: float = 0.0
+    last_report: float = 0.0
+
+
+class ServeRouter:
+    def __init__(self, state_dir, metrics=None):
+        self.state_dir = Path(state_dir)
+        self.serve_root = serve_root_dir(state_dir)
+        self.metrics = metrics
+        self._jobs: Dict[str, _JobState] = {}
+        self.io = RouterIOCounters()
+
+    # ---- lifecycle ----
+
+    def _state(self, key: str, job) -> _JobState:
+        st = self._jobs.get(key)
+        if st is None:
+            st = _JobState(
+                front=Spool(
+                    front_spool_dir(self.serve_root, key, job.spec.serving)
+                ),
+                # Deterministic per-job jitter seed: a replayed chaos
+                # run re-routes on the identical schedule.
+                backoff=Backoff(
+                    base_s=0.05, cap_s=2.0, seed=zlib.crc32(key.encode())
+                ),
+            )
+            self._jobs[key] = st
+            self._recover(st)
+        return st
+
+    def _recover(self, st: _JobState) -> None:
+        """Router-restart adoption: a front claim without a front
+        response is a request a previous router life was answering —
+        it is ours again now. Dispatch state is re-derived against the
+        live replica spools on the next tick (``recovered`` flag)."""
+        try:
+            claims = sorted(st.front.claimed.iterdir())
+        except FileNotFoundError:
+            return
+        for p in claims:
+            if p.suffix != ".json":
+                continue
+            rid = p.stem
+            if st.front.has_response(rid):
+                p.unlink(missing_ok=True)
+                continue
+            try:
+                rec = json.loads(p.read_text())
+            except (OSError, json.JSONDecodeError):
+                st.front.respond(rid, {"id": rid, "error": "torn request"})
+                continue
+            st.inflight[rid] = _Inflight(
+                rec=rec,
+                rid=rid,
+                submit_time=float(rec.get("submit_time", 0.0)),
+                recovered=True,
+            )
+
+    def retire_job(self, key: str) -> None:
+        self._jobs.pop(key, None)
+
+    def finalize(self, key: str, job, reason: str = "job finished") -> None:
+        """End-of-life drain: every outstanding request — in flight or
+        still unclaimed in the front queue — gets a terminal error
+        response, so no client waits out a timeout on a job that will
+        never serve again. Exactly-once still holds (respond_once)."""
+        st = self._jobs.get(key)
+        if st is None:
+            if job is None or job.spec.serving is None:
+                return
+            st = self._state(key, job)
+        for f in list(st.inflight.values()):
+            resp = self._replica_response(key, f)
+            if resp is not None:
+                self._publish(key, st, f, resp)
+                continue
+            if st.front.respond_once(
+                f.rid, {"id": f.rid, "error": reason, "attempts": f.attempts}
+            ):
+                st.errors += 1
+            st.inflight.pop(f.rid, None)
+        while True:
+            recs = st.front.claim(CLAIM_BATCH)
+            if not recs:
+                break
+            for rec in recs:
+                rid = rec.get("id")
+                if rid and st.front.respond_once(
+                    rid, {"id": rid, "error": reason}
+                ):
+                    st.errors += 1
+
+    # ---- the per-pass tick ----
+
+    def tick(
+        self,
+        key: str,
+        job,
+        handles,
+        by_replica: dict,
+        status_dir=None,
+        now: Optional[float] = None,
+    ) -> dict:
+        """One routing pass for one serving job; returns the pass
+        summary (also folded into gauges when a registry is wired)."""
+        now = time.time() if now is None else now
+        self.io.ticks += 1
+        st = self._state(key, job)
+        slo = SLO.from_policy(job.spec.serving)
+
+        # Alive replica set, stem -> spool (the handle index is the
+        # same truth reconcile acts on; no second discovery mechanism).
+        alive: Dict[str, Spool] = {}
+        for h in handles:
+            if not h.is_active():
+                continue
+            stem = f"{h.replica_type.value.lower()}-{h.index}"
+            alive[stem] = Spool(
+                replica_spool_dir(
+                    self.serve_root, key, h.replica_type.value, h.index
+                )
+            )
+
+        if now - st.last_sweep > SWEEP_EVERY_S:
+            st.last_sweep = now
+            self.io.sweeps += 1
+            st.front.sweep_stale(SWEEP_EVERY_S)
+            for sp in alive.values():
+                sp.sweep_stale(SWEEP_EVERY_S)
+
+        self._collect_responses(key, st, now)
+        self._handle_deaths(key, st, slo, alive, now)
+        self._admit(key, st, slo, now)
+        self._dispatch(key, st, slo, alive, by_replica, now)
+
+        # ---- surface ----
+        self.io.front_scans += 1
+        queue_depth = st.front.pending_count() + sum(
+            1 for f in st.inflight.values() if f.replica is None
+        )
+        slots_free = 0.0
+        for stem in alive:
+            tele = (by_replica.get(stem) or {}).get("serve")
+            if tele and tele.get("slots_free") is not None:
+                slots_free += float(tele["slots_free"])
+        summary = {
+            "queue_depth": queue_depth,
+            "inflight": len(st.inflight),
+            "replicas": len(alive),
+            "slots_free": slots_free,
+            "routed": st.routed,
+            "shed": st.shed,
+            "ok": st.ok,
+            "errors": st.errors,
+            "rerouted": st.rerouted,
+            "dup_avoided": st.dup_avoided,
+        }
+        m = self.metrics
+        if m is not None:
+            m.job_serve_queue_depth.set(queue_depth, job=key)
+            m.job_serve_inflight.set(len(st.inflight), job=key)
+            m.job_serve_replicas.set(len(alive), job=key)
+            m.job_serve_slots_free.set(slots_free, job=key)
+        if now - st.last_report > REPORT_EVERY_S:
+            st.last_report = now
+            self._report(status_dir, now, summary)
+        return summary
+
+    # ---- tick phases ----
+
+    def _replica_response(self, key: str, f: _Inflight) -> Optional[dict]:
+        """The replica-side response for an in-flight request, if the
+        engine has published one (dead replicas included — a response
+        written just before the kill still counts)."""
+        if f.replica is None:
+            return None
+        rt, _, idx = f.replica.rpartition("-")
+        try:
+            sp = Spool(
+                replica_spool_dir(self.serve_root, key, rt, int(idx)),
+                create=False,
+            )
+        except (ValueError, OSError):
+            return None
+        return sp.read_response(f.rid)
+
+    def _publish(
+        self, key: str, st: _JobState, f: _Inflight, resp: dict
+    ) -> None:
+        """Move one response replica → front, exactly once, with the
+        router's accounting stamped on."""
+        resp.setdefault("id", f.rid)
+        resp["replica"] = f.replica
+        resp["attempts"] = max(1, f.attempts)
+        wait_end = f.first_dispatch if f.first_dispatch else f.submit_time
+        resp["queue_wait_ms"] = round(
+            1000 * max(0.0, wait_end - f.submit_time), 3
+        )
+        won = st.front.respond_once(f.rid, resp)
+        self.io.publishes += 1
+        if won:
+            outcome = "error" if resp.get("error") is not None else "ok"
+            if outcome == "ok":
+                st.ok += 1
+            else:
+                st.errors += 1
+            m = self.metrics
+            if m is not None:
+                m.serve_requests.inc(job=key, outcome=outcome)
+                if resp.get("ttft_ms") is not None:
+                    m.serve_ttft_seconds.observe(
+                        float(resp["ttft_ms"]) / 1000.0,
+                        exemplar=f.rid,
+                        job=key,
+                    )
+                if resp.get("tpot_ms") is not None:
+                    m.serve_tpot_seconds.observe(
+                        float(resp["tpot_ms"]) / 1000.0,
+                        exemplar=f.rid,
+                        job=key,
+                    )
+                m.serve_queue_wait_seconds.observe(
+                    float(resp["queue_wait_ms"]) / 1000.0,
+                    exemplar=f.rid,
+                    job=key,
+                )
+        else:
+            st.dup_avoided += 1
+        # Consume the replica-side copy either way; the front record is
+        # the durable one.
+        if f.replica is not None:
+            rt, _, idx = f.replica.rpartition("-")
+            try:
+                (
+                    replica_spool_dir(self.serve_root, key, rt, int(idx))
+                    / "responses"
+                    / f"{f.rid}.json"
+                ).unlink(missing_ok=True)
+            except (ValueError, OSError):
+                pass
+        st.inflight.pop(f.rid, None)
+
+    def _shed(
+        self, key: str, st: _JobState, rid: str, decision: str,
+        submit_time: float, now: float,
+    ) -> None:
+        if st.front.respond_once(
+            rid, overload_response(rid, decision, submit_time=submit_time,
+                                   now=now)
+        ):
+            st.shed += 1
+            if self.metrics is not None:
+                self.metrics.serve_requests.inc(job=key, outcome="shed")
+        else:
+            st.dup_avoided += 1
+
+    def _collect_responses(self, key: str, st: _JobState, now: float) -> None:
+        for f in list(st.inflight.values()):
+            resp = self._replica_response(key, f)
+            if resp is not None:
+                self._publish(key, st, f, resp)
+
+    def _handle_deaths(
+        self, key: str, st: _JobState, slo: SLO, alive: Dict[str, Spool],
+        now: float,
+    ) -> None:
+        for f in list(st.inflight.values()):
+            if f.replica is None or f.replica in alive:
+                continue
+            # The replica died with this request on board (its response
+            # — if any — was already collected above). Pull the copy
+            # back and decide: re-route or give up.
+            rt, _, idx = f.replica.rpartition("-")
+            try:
+                Spool(
+                    replica_spool_dir(self.serve_root, key, rt, int(idx)),
+                    create=False,
+                ).cancel(f.rid)
+            except (ValueError, OSError):
+                pass
+            if f.attempts > slo.retry_limit:
+                if st.front.respond_once(
+                    f.rid,
+                    {
+                        "id": f.rid,
+                        "error": (
+                            f"replica {f.replica} died; "
+                            f"{slo.retry_limit} re-route(s) exhausted"
+                        ),
+                        "attempts": f.attempts,
+                    },
+                ):
+                    st.errors += 1
+                    if self.metrics is not None:
+                        self.metrics.serve_requests.inc(
+                            job=key, outcome="error"
+                        )
+                st.inflight.pop(f.rid, None)
+                continue
+            f.replica = None
+            f.retry_at = now + st.backoff.delay(f.attempts - 1)
+            st.rerouted += 1
+            if self.metrics is not None:
+                self.metrics.serve_rerouted.inc(job=key)
+
+    def _admit(
+        self, key: str, st: _JobState, slo: SLO, now: float
+    ) -> None:
+        recs = st.front.claim(CLAIM_BATCH)
+        for rec in recs:
+            rid = rec.get("id")
+            if not rid:
+                continue  # claim() already answered torn files
+            if rid in st.inflight or st.front.has_response(rid):
+                continue  # duplicate submit of a known id
+            submit_time = float(rec.get("submit_time", now))
+            decision = slo.admit(
+                submit_time=submit_time,
+                in_flight=len(st.inflight),
+                now=now,
+            )
+            if decision != ADMIT:
+                self._shed(key, st, rid, decision, submit_time, now)
+                continue
+            st.inflight[rid] = _Inflight(
+                rec=rec, rid=rid, submit_time=submit_time
+            )
+
+    def _dispatch(
+        self, key: str, st: _JobState, slo: SLO, alive: Dict[str, Spool],
+        by_replica: dict, now: float,
+    ) -> None:
+        undispatched = [
+            f for f in st.inflight.values() if f.replica is None
+        ]
+        if not undispatched:
+            return
+        # Router-side outstanding per replica — exact, because every
+        # dispatch goes through here.
+        outstanding: Dict[str, int] = {stem: 0 for stem in alive}
+        for f in st.inflight.values():
+            if f.replica in outstanding:
+                outstanding[f.replica] += 1
+
+        def score(stem: str):
+            tele = (by_replica.get(stem) or {}).get("serve") or {}
+            # Primary: what the router knows it put there and the
+            # engine hasn't answered. Tie-break: the engine's own live
+            # occupancy (free slots first, then shorter queue, then the
+            # p99 it is currently delivering).
+            return (
+                outstanding[stem],
+                -float(tele.get("slots_free", 0.0)),
+                float(tele.get("queued", 0.0)),
+                float(tele.get("tpot_ms_p99", 0.0)),
+                stem,
+            )
+
+        for f in sorted(undispatched, key=lambda f: f.submit_time):
+            if f.retry_at > now:
+                continue
+            if slo.expired(f.submit_time, now):
+                # Aged out before a replica could take it (death-retry
+                # storms land here) — deadline-shed bounds the tail.
+                self._shed(key, st, f.rid, SHED_DEADLINE, f.submit_time, now)
+                st.inflight.pop(f.rid, None)
+                continue
+            if f.recovered:
+                f.recovered = False
+                if self._readopt(key, st, f, alive, now):
+                    continue
+            if not alive:
+                continue  # keep; next tick may have replicas again
+            stem = min(alive, key=score)
+            rec = dict(f.rec)
+            rec["attempts"] = f.attempts + 1
+            alive[stem].enqueue(rec)
+            self.io.dispatches += 1
+            f.replica = stem
+            f.attempts += 1
+            if f.first_dispatch is None:
+                f.first_dispatch = now
+            if f.attempts == 1:
+                st.routed += 1
+            outstanding[stem] += 1
+
+    def _readopt(
+        self, key: str, st: _JobState, f: _Inflight,
+        alive: Dict[str, Spool], now: float,
+    ) -> bool:
+        """Post-restart dedup: before re-dispatching a recovered
+        request, look for the copy a previous router life already
+        placed. Returns True when the request is handled (still in
+        flight somewhere, or its response was found and published)."""
+        for stem, sp in alive.items():
+            resp = sp.read_response(f.rid)
+            if resp is not None:
+                f.replica = stem
+                f.attempts = max(1, f.attempts)
+                self._publish(key, st, f, resp)
+                return True
+            if (sp.requests / f"{f.rid}.json").exists() or (
+                sp.claimed / f"{f.rid}.json"
+            ).exists():
+                f.replica = stem
+                f.attempts = max(1, f.attempts)
+                if f.first_dispatch is None:
+                    f.first_dispatch = now
+                return True
+        return False
+
+    # ---- status-record emission ----
+
+    def _report(self, status_dir, now: float, summary: dict) -> None:
+        """Throttled ``serve`` record into the job's status dir as
+        replica ``router`` — the SAME channel replicas report through,
+        so the tailer, the live watch, and ``tpujob why`` pick up
+        front-queue depth with zero new plumbing."""
+        if status_dir is None:
+            return
+        d = Path(status_dir)
+        if not d.is_dir():
+            return  # job not launched yet; creation is the launch path's
+        rec = {
+            "event": "serve",
+            "ts": now,
+            "queue_depth": summary["queue_depth"],
+            "inflight": summary["inflight"],
+            "replicas": summary["replicas"],
+            "slots_free": summary["slots_free"],
+            "routed": summary["routed"],
+            "shed": summary["shed"],
+        }
+        try:
+            with open(d / "router.jsonl", "a") as fh:
+                fh.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass

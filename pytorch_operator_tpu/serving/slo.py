@@ -1,0 +1,176 @@
+"""Serve-plane SLO: admission decisions and per-request accounting.
+
+The router (serving/router.py) must answer one question per claimed
+request — serve it or shed it — and the bench (workloads/
+serveplane_bench.py) must answer the mirror question per response —
+was the SLO honored. Both judgments live here, pure and clock-free
+(callers pass ``now``), so the admission bar the router enforces and
+the bar the bench audits are the same code: a request the router
+admitted can never be counted as shed by the bench, and vice versa.
+
+Decisions:
+
+- ``ADMIT``          — dispatch to a replica.
+- ``SHED_DEPTH``     — admitted + in-flight already at
+                       ``slo.max_queue_depth``; the client must back
+                       off NOW, not after a timeout.
+- ``SHED_DEADLINE``  — the request aged past ``slo.deadline_s`` before
+                       it could be dispatched (also applied to
+                       re-routes: a retry that cannot finish in time
+                       is answered, not re-queued forever).
+
+A shed request still gets a RESPONSE — an explicit overload record
+(``overload: true`` + the decision) published to the front spool, so
+exactly-once holds for shed traffic too.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+ADMIT = "admit"
+SHED_DEPTH = "shed_depth"
+SHED_DEADLINE = "shed_deadline"
+
+SHED_DECISIONS = (SHED_DEPTH, SHED_DEADLINE)
+
+
+@dataclass(frozen=True)
+class SLO:
+    """Resolved admission bar (api.types.ServingSLOPolicy with the
+    Nones flattened). 0 disables the respective check."""
+
+    max_queue_depth: int = 0
+    deadline_s: float = 0.0
+    retry_limit: int = 2
+
+    @classmethod
+    def from_policy(cls, serving) -> "SLO":
+        """From a ``spec.serving`` block (or None) to the effective bar."""
+        if serving is None or serving.slo is None:
+            return cls()
+        s = serving.slo
+        return cls(
+            max_queue_depth=max(0, int(s.max_queue_depth)),
+            deadline_s=max(0.0, float(s.deadline_s)),
+            retry_limit=max(0, int(s.retry_limit)),
+        )
+
+    def deadline_of(self, submit_time: float) -> Optional[float]:
+        return submit_time + self.deadline_s if self.deadline_s else None
+
+    def admit(self, *, submit_time: float, in_flight: int, now: float) -> str:
+        """The admission decision for one front-queue request."""
+        if self.deadline_s and now - submit_time > self.deadline_s:
+            return SHED_DEADLINE
+        if self.max_queue_depth and in_flight >= self.max_queue_depth:
+            return SHED_DEPTH
+        return ADMIT
+
+    def expired(self, submit_time: float, now: float) -> bool:
+        return bool(self.deadline_s) and now - submit_time > self.deadline_s
+
+
+def overload_response(
+    rid: str, decision: str, *, submit_time: float, now: float
+) -> dict:
+    """The explicit shed response. Carries the overload marker the
+    chaos tests pin plus enough context for a client's backoff logic
+    (which bar tripped, how long the request waited)."""
+    return {
+        "id": rid,
+        "error": f"shed: {decision}",
+        "overload": True,
+        "shed": decision,
+        "queue_wait_ms": round(1000 * max(0.0, now - submit_time), 3),
+    }
+
+
+def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+@dataclass
+class SLOStats:
+    """Response-side SLO accounting: every response the front spool
+    published lands in exactly one bucket (ok / shed / error), so
+    ``accounted == offered`` is a closure check — a response that fits
+    no bucket, or a request that never got one, is a bug. Shared by
+    the bench cells and the router's own counters."""
+
+    offered: int = 0
+    ok: int = 0
+    shed: int = 0
+    shed_depth: int = 0
+    shed_deadline: int = 0
+    errors: int = 0
+    duplicates: int = 0
+    rerouted: int = 0
+    ttft_ms: List[float] = field(default_factory=list)
+    tpot_ms: List[float] = field(default_factory=list)
+    queue_wait_ms: List[float] = field(default_factory=list)
+    _started: float = field(default_factory=time.time)
+    _finished: Optional[float] = None
+
+    def account(self, resp: dict) -> str:
+        """Fold one response record; returns its bucket name."""
+        if resp.get("overload"):
+            self.shed += 1
+            if resp.get("shed") == SHED_DEPTH:
+                self.shed_depth += 1
+            else:
+                self.shed_deadline += 1
+            return "shed"
+        if resp.get("error") is not None:
+            self.errors += 1
+            return "error"
+        self.ok += 1
+        if resp.get("ttft_ms") is not None:
+            self.ttft_ms.append(float(resp["ttft_ms"]))
+        if resp.get("tpot_ms") is not None:
+            self.tpot_ms.append(float(resp["tpot_ms"]))
+        if resp.get("queue_wait_ms") is not None:
+            self.queue_wait_ms.append(float(resp["queue_wait_ms"]))
+        if resp.get("attempts", 1) and int(resp.get("attempts", 1)) > 1:
+            self.rerouted += 1
+        return "ok"
+
+    def finish(self, now: Optional[float] = None) -> None:
+        self._finished = time.time() if now is None else now
+
+    @property
+    def accounted(self) -> int:
+        return self.ok + self.shed + self.errors
+
+    def summary(self) -> dict:
+        """The bench-cell record: goodput, shed rate, tail latencies."""
+        end = self._finished if self._finished is not None else time.time()
+        wall = max(1e-9, end - self._started)
+        out = {
+            "offered": self.offered,
+            "ok": self.ok,
+            "shed": self.shed,
+            "shed_depth": self.shed_depth,
+            "shed_deadline": self.shed_deadline,
+            "errors": self.errors,
+            "duplicates": self.duplicates,
+            "rerouted": self.rerouted,
+            "accounted": self.accounted,
+            "goodput_rps": round(self.ok / wall, 3),
+            "shed_rate": round(self.shed / max(1, self.accounted), 4),
+            "wall_s": round(wall, 3),
+        }
+        for name, vals in (
+            ("ttft_ms", self.ttft_ms),
+            ("tpot_ms", self.tpot_ms),
+            ("queue_wait_ms", self.queue_wait_ms),
+        ):
+            s = sorted(vals)
+            out[f"{name}_p50"] = _quantile(s, 0.50)
+            out[f"{name}_p99"] = _quantile(s, 0.99)
+        return out

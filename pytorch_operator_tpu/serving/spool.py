@@ -71,6 +71,18 @@ class Spool:
         os.rename(tmp, self.requests / f"{rid}.json")
         return rid
 
+    def enqueue(self, rec: dict) -> str:
+        """Drop a fully-formed request record into ``requests/`` (the
+        router's dispatch primitive: unlike :meth:`submit` it preserves
+        the record verbatim — id, prompt, and above all the client's
+        original ``submit_time``, which the engine's TTFT accounting is
+        measured from)."""
+        rid = rec["id"]
+        tmp = self.requests / f".{rid}.tmp"
+        tmp.write_text(json.dumps(rec))
+        os.rename(tmp, self.requests / f"{rid}.json")
+        return rid
+
     def wait_response(self, request_id: str, timeout: float = 60.0) -> dict:
         """Poll for the response record; raises TimeoutError."""
         path = self.responses / f"{request_id}.json"
@@ -112,6 +124,16 @@ class Spool:
             try:
                 out.append(json.loads(dst.read_text()))
             except (OSError, json.JSONDecodeError):
+                # Torn request (a foreign client wrote requests/<id>.json
+                # without the tmp+rename discipline and died mid-write).
+                # Leaving the claim in place would WEDGE admission: the
+                # next recover_claimed() moves it back to requests/,
+                # claim() re-claims it, forever. Answer it with an error
+                # response instead — the id is the filename — which both
+                # unblocks any waiting client and clears the claim.
+                self.respond(
+                    path.stem, {"id": path.stem, "error": "torn request"}
+                )
                 continue
         return out
 
@@ -146,6 +168,73 @@ class Spool:
             claimed.unlink()
         except FileNotFoundError:
             pass
+
+    def respond_once(self, request_id: str, record: dict) -> bool:
+        """Publish a response ONLY if none exists yet; returns whether
+        this call won. ``os.link`` is the exclusivity primitive (it
+        fails with EEXIST where rename silently overwrites), so two
+        racing publishers — a restarted router re-driving a request
+        whose first copy already answered — can never both land: the
+        loser's record is discarded and the client sees ONE response.
+        """
+        dst = self.responses / f"{request_id}.json"
+        tmp = self.responses / f".{request_id}.{os.getpid()}.tmp"
+        tmp.write_text(json.dumps(record))
+        try:
+            os.link(tmp, dst)
+            won = True
+        except FileExistsError:
+            won = False
+        finally:
+            tmp.unlink(missing_ok=True)
+        if won:
+            (self.claimed / f"{request_id}.json").unlink(missing_ok=True)
+        return won
+
+    def has_response(self, request_id: str) -> bool:
+        return (self.responses / f"{request_id}.json").exists()
+
+    def read_response(self, request_id: str) -> Optional[dict]:
+        """The response record if published and parseable, else None."""
+        try:
+            return json.loads(
+                (self.responses / f"{request_id}.json").read_text()
+            )
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def cancel(self, request_id: str) -> None:
+        """Best-effort retraction of an unserved request: removes it
+        from requests/ and claimed/ (the router pulls a dead replica's
+        copy back this way before re-routing — whichever state the
+        crash left it in)."""
+        for d in (self.requests, self.claimed):
+            (d / f"{request_id}.json").unlink(missing_ok=True)
+
+    def sweep_stale(self, max_age_s: float = 60.0) -> int:
+        """GC for crashed writers' debris: a ``.tmp`` that outlived
+        ``max_age_s`` belongs to a client/engine/router that died
+        between write and rename — it will never be renamed into place
+        and must not sit in the admission scan forever. Swept on the
+        same cadence the store sweeps ITS stale tmps. Returns how many
+        were removed."""
+        n = 0
+        cutoff = time.time() - max_age_s
+        for d in (self.requests, self.claimed, self.responses):
+            try:
+                entries = list(d.iterdir())
+            except FileNotFoundError:
+                continue
+            for p in entries:
+                if p.suffix != ".tmp":
+                    continue
+                try:
+                    if p.stat().st_mtime < cutoff:
+                        p.unlink(missing_ok=True)
+                        n += 1
+                except FileNotFoundError:
+                    continue
+        return n
 
     def pending_count(self) -> int:
         try:

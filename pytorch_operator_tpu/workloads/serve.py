@@ -186,6 +186,20 @@ def run(
                 serve_ttft_ms_p50=s["ttft_ms_p50"],
                 serve_tpot_ms_p50=s["tpot_ms_p50"],
             )
+            # Serve-plane load beat: the router's least-loaded dispatch
+            # and the queue_growth/batch_size_collapse detectors read
+            # this replica-side occupancy stream (serving/router.py).
+            rendezvous.report_serve(
+                served,
+                slots=slots,
+                slots_free=engine.slots_free,
+                queued=engine.queued,
+                pending=spool.pending_count(),
+                ttft_ms_p50=s["ttft_ms_p50"],
+                ttft_ms_p99=s["ttft_ms_p99"],
+                tpot_ms_p50=s["tpot_ms_p50"],
+                tpot_ms_p99=s["tpot_ms_p99"],
+            )
             # The LIVE operator surface (`tpujob describe` Training
             # block + per-job gauges) folds only progress records —
             # report through it like training workloads do, with
@@ -232,12 +246,17 @@ def run(
 def main(argv=None) -> int:
     from .llama_train import CONFIGS
 
+    import os
+
     p = argparse.ArgumentParser()
     p.add_argument("--config", choices=sorted(CONFIGS), default="tiny")
     p.add_argument(
-        "--spool", required=True,
+        "--spool",
+        default=os.environ.get("TPUJOB_SPOOL_DIR") or None,
         help="spool directory (requests/ claimed/ responses/) — the "
-        "serving job's request surface",
+        "serving job's request surface; defaults to the "
+        "supervisor-injected TPUJOB_SPOOL_DIR (spec.serving jobs get a "
+        "private per-replica spool the router dispatches into)",
     )
     p.add_argument("--slots", type=int, default=8,
                    help="concurrent cache slots (the serving batch)")
@@ -271,6 +290,10 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
+    if not args.spool:
+        p.error(
+            "--spool is required (no TPUJOB_SPOOL_DIR in the environment)"
+        )
 
     world = rendezvous.initialize_from_env()
     stats = run(

@@ -1,0 +1,229 @@
+"""Jax-free serving replica: the serve-plane bench's engine stand-in.
+
+``workloads/serve.py`` is the REAL serving replica (llama decode under
+jax); this stub keeps its entire service contract — spool claim →
+continuous-batching occupancy → exactly-once responses with the
+TTFT/per-token latency record, ``fail_engine_step`` fault site
+included, serve telemetry on the same ``report_serve`` beat — while
+replacing the model with a clock: each decode block is one
+``tpot_ms`` sleep shared by every occupied slot. That keeps
+``tpujob bench-serve-plane`` about ROUTING (admission, least-loaded
+dispatch, retry-on-death) instead of about CPU-backend matmul noise,
+and lets the bench's tier-1 smoke lane run without importing jax at
+all.
+
+Capacity model: ``slots`` concurrent requests, one block = one token
+per occupied slot = one ``tpot_ms`` sleep — a replica serves
+``slots / (max_new_tokens * tpot_ms)`` requests per second at
+saturation, so the bench can place its offered load exactly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .. import faults
+from ..runtime import rendezvous
+from ..serving import Spool
+
+
+def _pct(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+
+def run(
+    *,
+    spool_dir: str,
+    slots: int = 4,
+    tpot_ms: float = 20.0,
+    max_requests: int = 0,
+    idle_timeout: float = 0.0,
+    poll_interval: float = 0.01,
+    report_every: float = 0.25,
+    log=print,
+) -> dict:
+    """The stub serving loop. Same lifecycle bounds as serve.py:
+    ``max_requests`` / ``idle_timeout`` end the run for benches; both 0
+    serves forever (the supervisor owns the lifecycle)."""
+    spool = Spool(spool_dir)
+    recovered = spool.recover_claimed()
+    if recovered:
+        log(f"[serve-stub] recovered {recovered} claimed request(s) "
+            "from a previous life")
+    rendezvous.report_first_step(0)
+
+    # One dict per occupied slot: the in-flight batch.
+    active: List[dict] = []
+    served = 0
+    faulted = 0
+    ttfts: List[float] = []
+    step_s = max(tpot_ms, 0.0) / 1000.0
+    last_activity = time.time()
+    last_report = 0.0
+
+    while True:
+        for rec in spool.claim(slots - len(active)):
+            rid = rec.get("id")
+            if not rid:
+                continue
+            now = time.time()
+            active.append(
+                {
+                    "id": rid,
+                    "remaining": max(1, int(rec.get("max_new_tokens") or 1)),
+                    "tokens": [],
+                    "submit_time": float(rec.get("submit_time", now)),
+                    "ttft_ms": None,
+                }
+            )
+            last_activity = now
+        if active:
+            try:
+                # The same injection site the real engine steps through:
+                # a faulted block must answer its in-flight requests
+                # with errors, never strand them (exactly-once).
+                faults.engine_step_check()
+            except faults.InjectedFault as e:
+                for a in active:
+                    spool.respond(
+                        a["id"], {"id": a["id"], "error": f"engine fault: {e}"}
+                    )
+                faulted += len(active)
+                log(
+                    f"[serve-stub] engine step fault ({e}); aborted "
+                    f"{len(active)} in-flight request(s) with error "
+                    "responses"
+                )
+                active = []
+                continue
+            time.sleep(step_s)  # one decode block across the whole batch
+            now = time.time()
+            still: List[dict] = []
+            for a in active:
+                if a["ttft_ms"] is None:
+                    # Client-perceived: measured from the client's
+                    # submit_time, which the router preserves verbatim.
+                    a["ttft_ms"] = round(
+                        1000 * max(0.0, now - a["submit_time"]), 3
+                    )
+                a["tokens"].append(len(a["tokens"]))
+                a["remaining"] -= 1
+                if a["remaining"] > 0:
+                    still.append(a)
+                    continue
+                spool.respond(
+                    a["id"],
+                    {
+                        "id": a["id"],
+                        "tokens": a["tokens"],
+                        "ttft_ms": a["ttft_ms"],
+                        "tpot_ms": round(tpot_ms, 3),
+                    },
+                )
+                served += 1
+                ttfts.append(a["ttft_ms"])
+                last_activity = now
+            active = still
+        else:
+            time.sleep(poll_interval)
+        now = time.time()
+        if now - last_report > report_every:
+            last_report = now
+            # The serve-plane load beat the router's dispatch scoring
+            # and the queue_growth/batch_size_collapse detectors read.
+            rendezvous.report_serve(
+                served,
+                slots=slots,
+                slots_free=slots - len(active),
+                queued=len(active),
+                pending=spool.pending_count(),
+                ttft_ms_p50=_pct(ttfts, 0.50),
+                ttft_ms_p99=_pct(ttfts, 0.99),
+                tpot_ms_p50=tpot_ms,
+                tpot_ms_p99=tpot_ms,
+            )
+            rendezvous.report_progress(
+                served,
+                throughput=(
+                    1000.0 * slots / (tpot_ms or 1.0)
+                ) if active else 0.0,
+                unit="tok/s",
+            )
+        if max_requests and served >= max_requests and not active:
+            break
+        if (
+            idle_timeout
+            and not active
+            and now - last_activity > idle_timeout
+        ):
+            log(f"[serve-stub] idle for {idle_timeout}s, exiting")
+            break
+
+    stats = {
+        "served": served,
+        "faulted": faulted,
+        "slots": slots,
+        "tpot_ms": tpot_ms,
+        "ttft_ms_p50": _pct(ttfts, 0.50),
+        "ttft_ms_p99": _pct(ttfts, 0.99),
+    }
+    log(f"[serve-stub] done: {json.dumps(stats)}")
+    return stats
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--spool",
+        default=os.environ.get("TPUJOB_SPOOL_DIR") or None,
+        help="spool directory; defaults to the supervisor-injected "
+        "TPUJOB_SPOOL_DIR (spec.serving jobs get a private per-replica "
+        "spool the router dispatches into)",
+    )
+    p.add_argument("--slots", type=int, default=4,
+                   help="concurrent cache slots (the serving batch)")
+    p.add_argument("--tpot-ms", type=float, default=20.0,
+                   help="simulated per-token decode time")
+    p.add_argument("--max-requests", type=int, default=0,
+                   help="exit after serving N requests (0 = forever)")
+    p.add_argument("--idle-timeout", type=float, default=0.0,
+                   help="exit after this many idle seconds (0 = forever)")
+    p.add_argument("--poll-interval", type=float, default=0.01)
+    p.add_argument("--report-every", type=float, default=0.25,
+                   help="seconds between serve-telemetry beats")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    if not args.spool:
+        p.error(
+            "--spool is required (no TPUJOB_SPOOL_DIR in the environment)"
+        )
+    # Serving replicas are INDEPENDENT engines (each owns its spool; no
+    # collective step), so parse the world from env without joining it —
+    # initialize_from_env would block on jax.distributed for multi-
+    # replica serving jobs and drag jax into the jax-free bench lane.
+    world = rendezvous.world_from_env()
+    stats = run(
+        spool_dir=args.spool,
+        slots=args.slots,
+        tpot_ms=args.tpot_ms,
+        max_requests=args.max_requests,
+        idle_timeout=args.idle_timeout,
+        poll_interval=args.poll_interval,
+        report_every=args.report_every,
+        log=lambda msg: print(msg, flush=True),
+    )
+    if args.json and world.process_id == 0:
+        print(json.dumps(stats), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,658 @@
+"""Serve-plane benchmark: routed goodput, shed behavior, latency tails.
+
+The serve plane's claim is that ONE front spool fans out across N
+engine replicas with admission control and retry-on-death, and that
+the router adds nothing when no serving job exists. This bench proves
+both with numbers, end to end through the REAL stack: a Supervisor
+with its SubprocessRunner spawns ``workloads/serve_stub`` replicas
+(the jax-free engine stand-in with serve.py's exact service contract),
+the supervisor-hosted router (serving/router.py) does discovery /
+admission / least-loaded dispatch / exactly-once publication, and an
+open-loop Poisson client drives the front spool at a FIXED offered
+load while replicas die underneath it.
+
+Cells: replicas {1, 2, 4} x scenario {healthy, kill_replica,
+fail_engine_step}. The stub's capacity model is exact — ``slots``
+concurrent requests, one token per slot per ``tpot_ms`` block — so a
+replica saturates at ``slots / (max_new_tokens * tpot_ms)`` requests
+per second and the offered rate can be placed deliberately ABOVE the
+small cells' capacity: the 1-replica cell sheds (that is the admission
+control working), the 4-replica cell absorbs the same offered load,
+and the goodput ratio between them is the scaling acceptance.
+
+Per cell the artifact (``BENCH_serveplane.json``) reports goodput,
+shed rate (split by depth/deadline), TTFT / per-token / queue-wait
+p50/p99, re-routes, duplicates (pinned 0 — ``respond_once``), and lost
+requests (pinned 0 — every submit gets exactly one response, overload
+and chaos included). An idle-overhead cell runs a non-serving fleet
+and pins the router to ZERO work: no ticks, no ``<state>/serve`` dir.
+
+Accounting closure is the same code the router enforces
+(serving/slo.py ``SLOStats``): every response lands in exactly one
+bucket and ``accounted == offered`` in every cell.
+
+Usage:
+    python -m pytorch_operator_tpu.workloads.serveplane_bench \
+        [--replicas 1,2,4] [--scenarios healthy,kill_replica,fail_engine_step] \
+        [--rate 85] [--duration 6] [--out BENCH_serveplane.json]
+    tpujob bench-serve-plane ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import List, Optional
+
+SCENARIOS = ("healthy", "kill_replica", "fail_engine_step")
+
+
+def _percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    idx = min(len(xs) - 1, max(0, round(q * (len(xs) - 1))))
+    return xs[idx]
+
+
+def _make_serve_job(
+    name: str,
+    replicas: int,
+    *,
+    slots: int,
+    tpot_ms: float,
+    idle_timeout: float,
+    max_queue_depth: int,
+    deadline_s: float,
+    retry_limit: int,
+):
+    """A serving job of ``replicas`` engine replicas: Master(1) +
+    Worker(replicas-1) — validation pins Master at exactly one, and the
+    router treats every active handle as an engine regardless of type."""
+    from ..api.types import (
+        ObjectMeta,
+        ProcessTemplate,
+        ReplicaSpec,
+        ReplicaType,
+        RestartPolicy,
+        ServingPolicy,
+        ServingSLOPolicy,
+        TPUJob,
+        TPUJobSpec,
+    )
+
+    template = ProcessTemplate(
+        module="pytorch_operator_tpu.workloads.serve_stub",
+        args=[
+            "--slots", str(slots),
+            "--tpot-ms", str(tpot_ms),
+            "--idle-timeout", str(idle_timeout),
+            "--report-every", "0.2",
+        ],
+    )
+    specs = {
+        ReplicaType.MASTER: ReplicaSpec(
+            replicas=1,
+            restart_policy=RestartPolicy.ON_FAILURE,
+            template=template,
+        ),
+    }
+    if replicas > 1:
+        specs[ReplicaType.WORKER] = ReplicaSpec(
+            replicas=replicas - 1,
+            restart_policy=RestartPolicy.ON_FAILURE,
+            template=template,
+        )
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            replica_specs=specs,
+            serving=ServingPolicy(
+                slo=ServingSLOPolicy(
+                    max_queue_depth=max_queue_depth,
+                    deadline_s=deadline_s,
+                    retry_limit=retry_limit,
+                )
+            ),
+        ),
+    )
+
+
+def bench_cell(
+    replicas: int,
+    scenario: str,
+    *,
+    rate: float,
+    duration: float,
+    slots: int,
+    tpot_ms: float,
+    max_new_tokens: int,
+    max_queue_depth: int,
+    deadline_s: float,
+    retry_limit: int,
+    idle_timeout: float,
+    state_dir: Path,
+    seed: int = 7,
+    log=print,
+) -> dict:
+    """One (replicas, scenario) cell through the full serve plane."""
+    from .. import faults
+    from ..controller.store import key_to_fs
+    from ..controller.supervisor import Supervisor
+    from ..serving import Spool
+    from ..serving.router import front_spool_dir, serve_root_dir
+    from ..serving.slo import SLOStats
+
+    sup = Supervisor(state_dir=state_dir, poll_interval=0.02)
+    stop = threading.Event()
+    pump_errors: List[str] = []
+
+    def pump() -> None:
+        while not stop.is_set():
+            try:
+                sup.sync_once()
+            except Exception as e:  # surfaced in the cell record
+                pump_errors.append(repr(e))
+            stop.wait(sup.poll_interval)
+
+    # Worker-side faults ride into replicas via TPUJOB_FAULT_PLAN at
+    # SPAWN time, so the engine-step plan must be armed before submit.
+    # One fault per replica injector: each replica aborts exactly one
+    # decode block mid-window, answering its whole in-flight batch with
+    # error responses (the exactly-once contract under engine failure).
+    engine_fault_nth = max(5, int(0.15 * duration * 1000.0 / tpot_ms))
+    if scenario == "fail_engine_step":
+        faults.arm(
+            faults.FaultPlan(
+                seed=seed,
+                faults=[
+                    faults.Fault(kind="fail_engine_step", nth=engine_fault_nth)
+                ],
+            )
+        )
+
+    pump_thread = threading.Thread(target=pump, daemon=True)
+    try:
+        job = _make_serve_job(
+            f"serve-bench-{scenario.replace('_', '-')}-{replicas}",
+            replicas,
+            slots=slots,
+            tpot_ms=tpot_ms,
+            idle_timeout=idle_timeout,
+            max_queue_depth=max_queue_depth,
+            deadline_s=deadline_s,
+            retry_limit=retry_limit,
+        )
+        key = sup.submit(job)
+        pump_thread.start()
+
+        # Readiness: every replica spawned AND reporting (first_step /
+        # serve beats land in the status dir) — the idle_timeout clock
+        # starts inside the replica loop, so arrivals must not lag it.
+        status_dir = Path(state_dir) / "status" / key_to_fs(key)
+        launch_deadline = time.time() + 90.0
+        ready = False
+        while time.time() < launch_deadline:
+            active = [h for h in sup.runner.list_for_job(key) if h.is_active()]
+            reported = (
+                len(list(status_dir.glob("*.jsonl")))
+                if status_dir.is_dir()
+                else 0
+            )
+            if len(active) >= replicas and reported >= replicas:
+                ready = True
+                break
+            time.sleep(0.02)
+        if not ready:
+            raise RuntimeError(
+                f"cell {scenario}x{replicas}: replicas not ready "
+                f"(pump errors: {pump_errors[:3]})"
+            )
+
+        # Controller-side kill: armed at window start so the pass count
+        # ``at`` schedules against begins NOW (the supervisor's fault
+        # pass counter only ticks while a plan is armed). Kill a worker
+        # when the job has one (master survives; the job still ends
+        # Succeeded), the lone master otherwise.
+        if scenario == "kill_replica":
+            kill_at = max(3, int(0.25 * duration / sup.poll_interval))
+            target = "worker-0" if replicas > 1 else "master-0"
+            faults.arm(
+                faults.FaultPlan(
+                    seed=seed,
+                    faults=[
+                        faults.Fault(
+                            kind="kill_replica", target=target, at=kill_at
+                        )
+                    ],
+                )
+            )
+
+        front = Spool(
+            front_spool_dir(serve_root_dir(state_dir), key, job.spec.serving)
+        )
+
+        # ---- open-loop Poisson arrivals at the FIXED offered rate ----
+        rng = random.Random(seed * 7919 + replicas)
+        stats = SLOStats()
+        start = time.time()
+        end = start + duration
+        t_next = start
+        rids: List[str] = []
+        while True:
+            now = time.time()
+            if now >= end:
+                break
+            if now < t_next:
+                time.sleep(min(0.002, t_next - now))
+                continue
+            rids.append(front.submit(prompt_len=4,
+                                     max_new_tokens=max_new_tokens))
+            t_next += rng.expovariate(rate)
+        stats.offered = len(rids)
+
+        # ---- collect: EVERY submit gets exactly one response ----
+        pending = set(rids)
+        collect_deadline = time.time() + deadline_s + max(30.0, 4 * duration)
+        while pending and time.time() < collect_deadline:
+            done = []
+            for rid in pending:
+                resp = front.read_response(rid)
+                if resp is not None:
+                    stats.account(resp)
+                    done.append(rid)
+            pending.difference_update(done)
+            if pending:
+                time.sleep(0.02)
+        stats.finish()
+        lost = len(pending)
+
+        # Duplicates: respond_once makes a second response for a known
+        # id structurally impossible; a response for an id nobody
+        # submitted would be the other way to violate exactly-once.
+        files = {p.stem for p in front.responses.glob("*.json")}
+        stats.duplicates = len(files - set(rids))
+
+        # ---- teardown: replicas idle out, master succeeds ----
+        finish_deadline = time.time() + idle_timeout + 60.0
+        finished = False
+        while time.time() < finish_deadline:
+            j = sup.store.get(key)
+            if j is not None and j.is_finished():
+                finished = True
+                break
+            time.sleep(0.05)
+        stop.set()
+        pump_thread.join(timeout=10.0)
+
+        # TTFT tail bound: an OK response's LAST dispatch passed the
+        # deadline check, and after dispatch it waits out at most the
+        # admitted backlog on the surviving replicas plus its own
+        # decode — deadline-shed is what keeps the tail finite.
+        surviving = max(
+            1, replicas - (1 if scenario == "kill_replica" else 0)
+        )
+        bound_ms = (
+            1000.0 * deadline_s
+            + (max_queue_depth / max(1, slots * surviving) + 1)
+            * max_new_tokens
+            * tpot_ms
+            + 500.0
+        )
+        summary = stats.summary()
+        cell = {
+            "cell": f"{scenario}x{replicas}",
+            "scenario": scenario,
+            "replicas": replicas,
+            "offered_rate_rps": rate,
+            "duration_s": duration,
+            "slots": slots,
+            "tpot_ms": tpot_ms,
+            "max_new_tokens": max_new_tokens,
+            "replica_capacity_rps": round(
+                slots / (max_new_tokens * tpot_ms / 1000.0), 2
+            ),
+            "slo": {
+                "max_queue_depth": max_queue_depth,
+                "deadline_s": deadline_s,
+                "retry_limit": retry_limit,
+            },
+            **summary,
+            "lost": lost,
+            "job_finished": finished,
+            "router_io": sup.router.io.snapshot(),
+            "pump_errors": len(pump_errors),
+            "ttft_p99_bound_ms": round(bound_ms, 1),
+            "ttft_p99_bounded": (
+                summary["ttft_ms_p99"] is None
+                or summary["ttft_ms_p99"] <= bound_ms
+            ),
+        }
+        log(
+            f"[serveplane] {scenario:>16s} x{replicas} "
+            f"offered={cell['offered']:4d} ok={cell['ok']:4d} "
+            f"shed={cell['shed']:4d} errors={cell['errors']:3d} "
+            f"rerouted={cell['rerouted']:2d} lost={lost} "
+            f"goodput={cell['goodput_rps']:6.1f}rps "
+            f"ttft p99={cell['ttft_ms_p99'] or 0:7.1f}ms"
+        )
+        return cell
+    finally:
+        faults.disarm()
+        stop.set()
+        if pump_thread.is_alive():
+            pump_thread.join(timeout=10.0)
+        sup.shutdown()
+
+
+def _make_noop_job(i: int):
+    from ..api.types import (
+        ObjectMeta,
+        ProcessTemplate,
+        ReplicaSpec,
+        ReplicaType,
+        RestartPolicy,
+        TPUJob,
+        TPUJobSpec,
+    )
+
+    return TPUJob(
+        metadata=ObjectMeta(name=f"idle-{i:04d}"),
+        spec=TPUJobSpec(
+            replica_specs={
+                ReplicaType.MASTER: ReplicaSpec(
+                    replicas=1,
+                    restart_policy=RestartPolicy.ON_FAILURE,
+                    template=ProcessTemplate(
+                        module="pytorch_operator_tpu.workloads.noop"
+                    ),
+                )
+            }
+        ),
+    )
+
+
+def bench_idle_overhead(
+    n_jobs: int, passes: int, state_dir: Path, log=print
+) -> dict:
+    """The zero-overhead pin: a fleet with NO serving jobs must cost
+    the router nothing — zero ticks, zero scans, and ``<state>/serve``
+    never materializes on disk."""
+    from ..api.types import ReplicaPhase
+    from ..controller.runner import FakeRunner
+    from ..controller.supervisor import Supervisor
+
+    sup = Supervisor(state_dir=state_dir, runner=FakeRunner())
+    try:
+        for i in range(n_jobs):
+            sup.submit(_make_noop_job(i))
+        sup.sync_once()
+        for h in sup.runner.list_all():
+            if h.phase == ReplicaPhase.PENDING:
+                sup.runner.set_phase(h.name, ReplicaPhase.RUNNING)
+        sup.sync_once()
+        lat_ms: List[float] = []
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            sup.sync_once()
+            lat_ms.append(1000 * (time.perf_counter() - t0))
+        io = sup.router.io.snapshot()
+        cell = {
+            "cell": "idle_overhead",
+            "jobs": n_jobs,
+            "passes": passes,
+            "pass_ms_p50": round(_percentile(lat_ms, 0.50), 3),
+            "pass_ms_p99": round(_percentile(lat_ms, 0.99), 3),
+            "router_io": io,
+            "router_io_total": sum(io.values()),
+            "serve_dir_exists": (Path(state_dir) / "serve").exists(),
+        }
+        log(
+            f"[serveplane] idle overhead: {n_jobs} non-serving jobs, "
+            f"{passes} passes — router_io={cell['router_io_total']} "
+            f"serve_dir={cell['serve_dir_exists']} "
+            f"pass p50={cell['pass_ms_p50']}ms"
+        )
+        return cell
+    finally:
+        sup.shutdown()
+
+
+def run(
+    replica_cells=(1, 2, 4),
+    scenarios=SCENARIOS,
+    rate: float = 85.0,
+    duration: float = 6.0,
+    slots: int = 4,
+    tpot_ms: float = 20.0,
+    max_new_tokens: int = 8,
+    max_queue_depth: int = 32,
+    deadline_s: float = 2.0,
+    retry_limit: int = 2,
+    idle_timeout: float = 4.0,
+    idle_jobs: int = 20,
+    idle_passes: int = 30,
+    out: Optional[str] = None,
+    work_dir: Optional[str] = None,
+    seed: int = 7,
+    log=print,
+) -> dict:
+    cells: List[dict] = []
+    for scenario in scenarios:
+        for n in replica_cells:
+            with tempfile.TemporaryDirectory(
+                prefix=f"serveplane-{scenario}-{n}-", dir=work_dir
+            ) as td:
+                cells.append(
+                    bench_cell(
+                        n,
+                        scenario,
+                        rate=rate,
+                        duration=duration,
+                        slots=slots,
+                        tpot_ms=tpot_ms,
+                        max_new_tokens=max_new_tokens,
+                        max_queue_depth=max_queue_depth,
+                        deadline_s=deadline_s,
+                        retry_limit=retry_limit,
+                        idle_timeout=idle_timeout,
+                        state_dir=Path(td),
+                        seed=seed,
+                        log=log,
+                    )
+                )
+    with tempfile.TemporaryDirectory(
+        prefix="serveplane-idle-", dir=work_dir
+    ) as td:
+        idle = bench_idle_overhead(idle_jobs, idle_passes, Path(td), log=log)
+
+    healthy = {
+        c["replicas"]: c for c in cells if c["scenario"] == "healthy"
+    }
+    duplicates_total = sum(c["duplicates"] for c in cells)
+    lost_total = sum(c["lost"] for c in cells)
+    comparisons: dict = {
+        "duplicates_total": duplicates_total,
+        "lost_total": lost_total,
+        "accounting_closed": all(
+            c["accounted"] == c["offered"] for c in cells
+        ),
+        "rerouted_total": sum(c["rerouted"] for c in cells),
+        "idle_router_io_zero": (
+            idle["router_io_total"] == 0 and not idle["serve_dir_exists"]
+        ),
+    }
+    acceptance: Optional[dict] = None
+    if len(healthy) >= 2:
+        lo_n, hi_n = min(healthy), max(healthy)
+        lo, hi = healthy[lo_n], healthy[hi_n]
+        ratio = hi["goodput_rps"] / max(lo["goodput_rps"], 1e-9)
+        comparisons["goodput_scaling"] = {
+            "replicas_lo": lo_n,
+            "replicas_hi": hi_n,
+            "goodput_lo_rps": lo["goodput_rps"],
+            "goodput_hi_rps": hi["goodput_rps"],
+            "ratio": round(ratio, 2),
+        }
+        kill_cells = [c for c in cells if c["scenario"] == "kill_replica"]
+        kill = (
+            max(kill_cells, key=lambda c: c["replicas"])
+            if kill_cells
+            else None
+        )
+        acceptance = {
+            "goodput_scaling_ratio": round(ratio, 2),
+            "target_ratio": 3.0,
+            "scaling_pass": ratio >= 3.0,
+            "duplicates_total": duplicates_total,
+            "duplicates_pass": duplicates_total == 0,
+            "lost_total": lost_total,
+            "lost_pass": lost_total == 0,
+        }
+        if kill is not None:
+            acceptance["kill_ttft"] = {
+                "replicas": kill["replicas"],
+                "ttft_ms_p99": kill["ttft_ms_p99"],
+                "bound_ms": kill["ttft_p99_bound_ms"],
+                "pass": kill["ttft_p99_bounded"],
+            }
+        acceptance["pass"] = (
+            acceptance["scaling_pass"]
+            and acceptance["duplicates_pass"]
+            and acceptance["lost_pass"]
+            and (kill is None or kill["ttft_p99_bounded"])
+        )
+
+    result = {
+        "bench": "serve_plane",
+        "metric": "goodput_rps",
+        "protocol": (
+            "open-loop Poisson arrivals at a FIXED offered rate into the "
+            "job's front spool; a real Supervisor (SubprocessRunner) "
+            "spawns serve_stub engine replicas (slots concurrent "
+            "requests, one token per slot per tpot_ms block — capacity "
+            "= slots/(max_new_tokens*tpot_ms)); the supervisor-hosted "
+            "router admission-controls against spec.serving.slo, "
+            "dispatches least-loaded, re-routes on replica death, and "
+            "publishes exactly-once. kill_replica SIGKILLs a replica "
+            "mid-window through the runner; fail_engine_step aborts one "
+            "decode block per replica from the env-threaded fault plan. "
+            "Every submit is awaited: accounted == offered is the "
+            "closure check, duplicates/lost are pinned 0, and the idle "
+            "cell pins the router to zero work on a non-serving fleet."
+        ),
+        "cells": cells,
+        "idle_overhead": idle,
+        "comparisons": comparisons,
+        "acceptance": acceptance,
+    }
+    if out:
+        Path(out).write_text(json.dumps(result, indent=2) + "\n")
+        log(f"[serveplane] wrote {out}")
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--replicas",
+        default="1,2,4",
+        help="comma-separated replica counts per scenario",
+    )
+    p.add_argument(
+        "--scenarios",
+        default=",".join(SCENARIOS),
+        help=f"comma-separated from {SCENARIOS}",
+    )
+    p.add_argument("--rate", type=float, default=85.0,
+                   help="offered load, requests/s (open-loop Poisson)")
+    p.add_argument("--duration", type=float, default=6.0,
+                   help="arrival window per cell, seconds")
+    p.add_argument("--slots", type=int, default=4,
+                   help="concurrent slots per engine replica")
+    p.add_argument("--tpot-ms", type=float, default=20.0,
+                   help="simulated per-token decode time")
+    p.add_argument("--max-new-tokens", type=int, default=8)
+    p.add_argument("--max-queue-depth", type=int, default=32,
+                   help="spec.serving.slo.max_queue_depth")
+    p.add_argument("--deadline-s", type=float, default=2.0,
+                   help="spec.serving.slo.deadline_s")
+    p.add_argument("--retry-limit", type=int, default=2,
+                   help="spec.serving.slo.retry_limit")
+    p.add_argument("--idle-jobs", type=int, default=20,
+                   help="non-serving jobs in the zero-overhead cell")
+    p.add_argument("--idle-passes", type=int, default=30)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument(
+        "--smoke",
+        action="store_true",
+        help="tiny under-capacity cells (healthy x {1,2}) — the tier-1 "
+        "sanity shape, minutes -> seconds",
+    )
+    p.add_argument("--out", default=None, help="artifact path (JSON)")
+    p.add_argument("--work-dir", default=None,
+                   help="where the throwaway state dirs live")
+    args = p.parse_args(argv)
+    try:
+        replicas = [int(x) for x in args.replicas.split(",") if x.strip()]
+    except ValueError:
+        print(f"--replicas must be comma-separated ints: {args.replicas!r}",
+              file=sys.stderr)
+        return 2
+    scenarios = [s.strip() for s in args.scenarios.split(",") if s.strip()]
+    bad = [s for s in scenarios if s not in SCENARIOS]
+    if bad:
+        print(f"unknown scenario(s) {bad}; choose from {SCENARIOS}",
+              file=sys.stderr)
+        return 2
+    kwargs = dict(
+        replica_cells=replicas,
+        scenarios=scenarios,
+        rate=args.rate,
+        duration=args.duration,
+        slots=args.slots,
+        tpot_ms=args.tpot_ms,
+        max_new_tokens=args.max_new_tokens,
+        max_queue_depth=args.max_queue_depth,
+        deadline_s=args.deadline_s,
+        retry_limit=args.retry_limit,
+        idle_jobs=args.idle_jobs,
+        idle_passes=args.idle_passes,
+        seed=args.seed,
+        out=args.out,
+        work_dir=args.work_dir,
+    )
+    if args.smoke:
+        kwargs.update(
+            replica_cells=[1, 2],
+            scenarios=["healthy"],
+            rate=20.0,
+            duration=1.5,
+            tpot_ms=10.0,
+            max_new_tokens=4,
+            max_queue_depth=64,
+            deadline_s=5.0,
+            idle_timeout=2.5,
+            idle_jobs=8,
+            idle_passes=10,
+        )
+    result = run(**kwargs)
+    print(
+        json.dumps(
+            {
+                "comparisons": result["comparisons"],
+                "acceptance": result["acceptance"],
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

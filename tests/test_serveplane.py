@@ -1,0 +1,425 @@
+"""Serve plane end-to-end: router + SLO + bench smoke lane.
+
+The serve-plane PR's tier-1 pins, all through the REAL stack (a
+Supervisor spawning ``serve_stub`` replicas, the supervisor-hosted
+router doing admission / dispatch / retry-on-death / exactly-once
+publication):
+
+- bench smoke lane (``-m bench_smoke``): every response is
+  SLO-accounted (``accounted == offered`` in every cell), shed rate is
+  ZERO when healthy under capacity, duplicates and lost are ZERO, and
+  a fleet with no serving jobs costs the router NOTHING — zero ticks,
+  no ``<state>/serve`` dir, sub-millisecond idle passes;
+- chaos through the ROUTER path: ``kill_replica`` mid-request
+  re-routes the dead replica's in-flight requests and still answers
+  every submit exactly once; ``fail_engine_step`` surfaces error
+  responses for the aborted batch, exactly once;
+- the overload contract: a request shed by ``spec.serving.slo``
+  carries the explicit ``overload`` marker;
+- router-restart dedup: a recovered front claim whose response a
+  previous life already collected is re-adopted and published once;
+- ``tpujob why`` cites replica death as the cause of a serve-plane
+  TTFT spike (queue_growth / batch_size_collapse findings carry the
+  coinciding death event as evidence).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from pytorch_operator_tpu.api.types import ReplicaType
+from pytorch_operator_tpu.controller.store import key_to_fs
+from pytorch_operator_tpu.serving import Spool
+from pytorch_operator_tpu.serving.router import (
+    ServeRouter,
+    front_spool_dir,
+    replica_spool_dir,
+    serve_root_dir,
+)
+from pytorch_operator_tpu.workloads import serveplane_bench
+
+pytestmark = pytest.mark.bench_smoke
+
+
+# ---- bench smoke lane ----
+
+
+@pytest.fixture(scope="module")
+def smoke_result(tmp_path_factory):
+    td = tmp_path_factory.mktemp("serveplane")
+    # Small but real: subprocess replicas, the live router, open-loop
+    # Poisson arrivals — sized UNDER capacity so the healthy cells
+    # must not shed at all.
+    return serveplane_bench.run(
+        replica_cells=(1, 2),
+        scenarios=("healthy",),
+        rate=20.0,
+        duration=1.5,
+        slots=4,
+        tpot_ms=10.0,
+        max_new_tokens=4,
+        max_queue_depth=64,
+        deadline_s=5.0,
+        idle_timeout=2.5,
+        idle_jobs=6,
+        idle_passes=10,
+        work_dir=str(td),
+        log=lambda *_: None,
+    )
+
+
+class TestServePlaneSmoke:
+    def test_every_response_slo_accounted(self, smoke_result):
+        # THE closure pin: every submitted request got exactly one
+        # response and every response landed in exactly one SLO bucket.
+        for c in smoke_result["cells"]:
+            assert c["offered"] > 0, c
+            assert c["accounted"] == c["offered"], c
+            assert c["lost"] == 0, c
+        assert smoke_result["comparisons"]["accounting_closed"] is True
+        assert smoke_result["comparisons"]["lost_total"] == 0
+
+    def test_zero_shed_when_healthy_under_capacity(self, smoke_result):
+        for c in smoke_result["cells"]:
+            assert c["scenario"] == "healthy"
+            assert c["shed"] == 0, c
+            assert c["shed_rate"] == 0, c
+            assert c["errors"] == 0, c
+
+    def test_exactly_once_no_duplicates(self, smoke_result):
+        for c in smoke_result["cells"]:
+            assert c["duplicates"] == 0, c
+        assert smoke_result["comparisons"]["duplicates_total"] == 0
+
+    def test_latencies_recorded(self, smoke_result):
+        # TTFT / per-token / queue-wait percentiles exist for every
+        # healthy cell — the columns top/metrics/why surface.
+        for c in smoke_result["cells"]:
+            assert c["ttft_ms_p50"] is not None and c["ttft_ms_p50"] > 0, c
+            assert c["tpot_ms_p50"] is not None, c
+            assert c["queue_wait_ms_p50"] is not None, c
+
+    def test_zero_router_overhead_without_serving_jobs(self, smoke_result):
+        # The idle cell: a non-serving fleet never wakes the router.
+        idle = smoke_result["idle_overhead"]
+        assert idle["router_io_total"] == 0, idle
+        assert all(v == 0 for v in idle["router_io"].values()), idle
+        assert idle["serve_dir_exists"] is False, idle
+        assert smoke_result["comparisons"]["idle_router_io_zero"] is True
+
+    def test_serving_cells_did_route(self, smoke_result):
+        # The mirror of the idle pin: serving cells DID go through the
+        # router (ticks, dispatches, publishes all non-zero).
+        for c in smoke_result["cells"]:
+            io = c["router_io"]
+            assert io["ticks"] > 0, c
+            assert io["dispatches"] >= c["ok"], c
+            assert io["publishes"] >= c["ok"], c
+
+    def test_artifact_shape_is_committed_schema(self, tmp_path):
+        out = tmp_path / "bench.json"
+        serveplane_bench.run(
+            replica_cells=(1,),
+            scenarios=("healthy",),
+            rate=10.0,
+            duration=1.0,
+            slots=4,
+            tpot_ms=10.0,
+            max_new_tokens=4,
+            max_queue_depth=64,
+            deadline_s=5.0,
+            idle_timeout=2.0,
+            idle_jobs=2,
+            idle_passes=3,
+            out=str(out),
+            work_dir=str(tmp_path),
+            log=lambda *_: None,
+        )
+        data = json.loads(out.read_text())
+        assert data["bench"] == "serve_plane"
+        assert {c["cell"] for c in data["cells"]} == {"healthyx1"}
+        for field in (
+            "offered", "ok", "shed", "errors", "duplicates", "rerouted",
+            "accounted", "goodput_rps", "shed_rate", "ttft_ms_p50",
+            "ttft_ms_p99", "tpot_ms_p99", "queue_wait_ms_p99", "lost",
+            "router_io", "ttft_p99_bound_ms",
+        ):
+            assert field in data["cells"][0], field
+        assert "idle_overhead" in data
+        for field in (
+            "duplicates_total", "lost_total", "accounting_closed",
+            "idle_router_io_zero",
+        ):
+            assert field in data["comparisons"], field
+
+
+# ---- chaos through the router path ----
+
+
+class TestServePlaneChaos:
+    def test_kill_replica_rerouted_exactly_once(self, tmp_path):
+        """A replica SIGKILLed mid-request: its in-flight requests are
+        pulled back and re-routed, the client still sees exactly one
+        response per submit, and nothing is lost or duplicated."""
+        # 16 tokens x 25ms -> ~0.4s per request at rate 15/s keeps ~6
+        # requests in flight on the lone replica, so the kill always
+        # catches requests mid-decode.
+        cell = serveplane_bench.bench_cell(
+            1,
+            "kill_replica",
+            rate=15.0,
+            duration=2.5,
+            slots=8,
+            tpot_ms=25.0,
+            max_new_tokens=16,
+            max_queue_depth=64,
+            deadline_s=10.0,
+            retry_limit=3,
+            idle_timeout=2.5,
+            state_dir=tmp_path / "state",
+            log=lambda *_: None,
+        )
+        assert cell["rerouted"] >= 1, cell
+        assert cell["accounted"] == cell["offered"], cell
+        assert cell["lost"] == 0, cell
+        assert cell["duplicates"] == 0, cell
+        assert cell["errors"] == 0, cell  # retries absorbed the death
+        assert cell["ok"] + cell["shed"] == cell["offered"], cell
+
+    def test_fail_engine_step_error_responses_exactly_once(self, tmp_path):
+        """An injected engine-step fault aborts one decode block: every
+        in-flight casualty gets an error response (nobody blocks on a
+        reply nothing will write), later requests complete normally,
+        and the closure pins still hold."""
+        cell = serveplane_bench.bench_cell(
+            1,
+            "fail_engine_step",
+            rate=15.0,
+            duration=2.0,
+            slots=8,
+            tpot_ms=25.0,
+            max_new_tokens=16,
+            max_queue_depth=64,
+            deadline_s=10.0,
+            retry_limit=2,
+            idle_timeout=2.5,
+            state_dir=tmp_path / "state",
+            log=lambda *_: None,
+        )
+        assert cell["errors"] >= 1, cell
+        assert cell["ok"] >= 1, cell  # the engine kept serving after
+        assert cell["accounted"] == cell["offered"], cell
+        assert cell["lost"] == 0, cell
+        assert cell["duplicates"] == 0, cell
+
+
+# ---- router unit surface (no subprocesses) ----
+
+
+class _Handle:
+    def __init__(self, rtype=ReplicaType.MASTER, index=0, active=True):
+        self.replica_type = rtype
+        self.index = index
+        self._active = active
+
+    def is_active(self):
+        return self._active
+
+
+def _serve_job(**slo):
+    return serveplane_bench._make_serve_job(
+        "svc", 1, slots=4, tpot_ms=10.0, idle_timeout=0.0,
+        max_queue_depth=slo.get("max_queue_depth", 0),
+        deadline_s=slo.get("deadline_s", 0.0),
+        retry_limit=slo.get("retry_limit", 2),
+    )
+
+
+class TestRouterContracts:
+    def test_shed_carries_overload_marker(self, tmp_path):
+        """spec.serving.slo depth bar: requests past it get the
+        explicit overload response — marker, decision, queue wait."""
+        state = tmp_path / "state"
+        key = "default/svc"
+        job = _serve_job(max_queue_depth=1)
+        router = ServeRouter(state)
+        front = Spool(front_spool_dir(serve_root_dir(state), key, job.spec.serving))
+        rids = [front.submit(prompt_len=2, max_new_tokens=4) for _ in range(3)]
+        summary = router.tick(key, job, [_Handle()], {})
+        assert summary["shed"] == 2, summary
+        assert summary["inflight"] == 1, summary
+        shed = [r for r in rids if front.has_response(r)]
+        assert len(shed) == 2
+        for rid in shed:
+            resp = front.read_response(rid)
+            assert resp["overload"] is True, resp
+            assert resp["shed"] == "shed_depth", resp
+            assert resp["error"].startswith("shed:"), resp
+            assert resp["queue_wait_ms"] >= 0, resp
+        # The admitted one is sitting in the replica's private spool.
+        rsp = Spool(replica_spool_dir(serve_root_dir(state), key, "Master", 0))
+        assert rsp.pending_count() == 1
+
+    def test_router_restart_dedup_publishes_once(self, tmp_path):
+        """Router restart mid-flight: the new life re-adopts the front
+        claim, finds the copy the engine already answered, and
+        publishes exactly once — respond_once makes a second
+        publication structurally impossible."""
+        state = tmp_path / "state"
+        key = "default/svc"
+        job = _serve_job()
+        r1 = ServeRouter(state)
+        front = Spool(front_spool_dir(serve_root_dir(state), key, job.spec.serving))
+        rid = front.submit(prompt_len=2, max_new_tokens=4)
+        r1.tick(key, job, [_Handle()], {})
+
+        # The engine's half: claim + respond in the replica spool.
+        rsp = Spool(replica_spool_dir(serve_root_dir(state), key, "Master", 0))
+        (rec,) = rsp.claim(4)
+        assert rec["id"] == rid
+        rsp.respond(rid, {"id": rid, "tokens": [0, 1], "ttft_ms": 1.0})
+
+        # A fresh router (the restart): re-adopts, publishes once.
+        r2 = ServeRouter(state)
+        r2.tick(key, job, [_Handle()], {})
+        resp = front.read_response(rid)
+        assert resp is not None and resp["tokens"] == [0, 1]
+        assert resp["attempts"] >= 1
+        files = list(front.responses.glob("*.json"))
+        assert [p.stem for p in files] == [rid]
+        # Exactly-once is enforced at the publication primitive.
+        assert front.respond_once(rid, {"id": rid, "error": "dup"}) is False
+        assert front.read_response(rid)["tokens"] == [0, 1]
+
+    def test_spool_stale_tmp_gc(self, tmp_path):
+        """Spool hygiene: a .tmp outliving the sweep age belongs to a
+        dead writer and is GC'd; fresh tmps and real requests are not."""
+        sp = Spool(tmp_path / "spool")
+        old = sp.requests / "dead.json.tmp"
+        old.write_text("{}")
+        os.utime(old, (time.time() - 120, time.time() - 120))
+        fresh = sp.requests / "alive.json.tmp"
+        fresh.write_text("{}")
+        rid = sp.submit(prompt_len=2)
+        assert sp.sweep_stale(60.0) == 1
+        assert not old.exists()
+        assert fresh.exists()
+        assert sp.pending_count() == 1
+        (rec,) = sp.claim(1)
+        assert rec["id"] == rid
+
+    def test_torn_request_gets_error_response(self, tmp_path):
+        """Torn-request tolerance: a half-written request file is
+        answered with an error instead of wedging the claim scan."""
+        sp = Spool(tmp_path / "spool")
+        (sp.requests / "torn-1.json").write_text('{"id": "torn-1", "pro')
+        good = sp.submit(prompt_len=2)
+        recs = sp.claim(4)
+        assert [r["id"] for r in recs] == [good]
+        resp = sp.read_response("torn-1")
+        assert resp is not None and "torn" in resp["error"]
+
+
+# ---- `tpujob why` cites replica death for the serve plane ----
+
+
+def _write_status(state, key, replica, recs):
+    d = state / "status" / key_to_fs(key)
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / f"{replica}.jsonl", "a") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _write_events(state, key, evs):
+    d = state / "events"
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / (key_to_fs(key) + ".events.jsonl"), "a") as f:
+        for ts, etype, reason, msg in evs:
+            f.write(
+                json.dumps(
+                    {
+                        "timestamp": ts,
+                        "type": etype,
+                        "reason": reason,
+                        "message": msg,
+                        "count": 1,
+                    }
+                )
+                + "\n"
+            )
+
+
+class TestWhyCitesReplicaDeath:
+    def test_serve_findings_cite_death_as_cause(self, tmp_path):
+        """The postmortem story the serve plane owes: a replica dies,
+        the survivors' batch collapses, the front queue ratchets up,
+        TTFT spikes — and `tpujob why` says WHY, citing the death
+        event as evidence on both serve findings."""
+        from pytorch_operator_tpu.obs import analyze as obs_analyze
+
+        state = tmp_path / "state"
+        key = "default/svc"
+        t0 = time.time() - 60.0
+
+        # Two engines at full batch for 10 beats; worker-0 dies at
+        # t0+10; master-0 alone afterwards, its TTFT tail spiking.
+        def engine(replica, beats, t_from, slots_free=0, ttft=80.0):
+            return [
+                {
+                    "event": "serve", "ts": t_from + i, "requests": 10 * i,
+                    "slots": 4, "slots_free": slots_free, "queued": 4,
+                    "pending": 0, "ttft_ms_p50": ttft / 2,
+                    "ttft_ms_p99": ttft,
+                }
+                for i in range(beats)
+            ]
+
+        _write_status(state, key, "master-0", engine("master-0", 10, t0))
+        _write_status(state, key, "worker-0", engine("worker-0", 10, t0))
+        _write_status(
+            state, key, "master-0",
+            engine("master-0", 4, t0 + 10.5, slots_free=2, ttft=900.0),
+        )
+        # The router's beat: front queue only grows once capacity halved.
+        _write_status(
+            state, key, "router",
+            [
+                {
+                    "event": "serve", "ts": t0 + 10.0 + i,
+                    "queue_depth": d, "inflight": d + 4, "replicas": 1,
+                    "slots_free": 0.0, "routed": 100 + 5 * i, "shed": i,
+                }
+                for i, d in enumerate([1, 3, 6, 10, 15])
+            ],
+        )
+        _write_events(
+            state, key,
+            [
+                (
+                    t0 + 10.0, "Warning", "FaultInjected",
+                    "injected kill of default/svc/worker-0 (kill_replica).",
+                ),
+                (
+                    t0 + 10.2, "Warning", "TPUJobRestarting",
+                    "replica worker-0 failed (exit 137, retryable); "
+                    "restarting.",
+                ),
+            ],
+        )
+
+        report = obs_analyze.analyze(state, key)
+        rules = {f["rule"]: f for f in report["findings"]}
+        assert "queue_growth" in rules, report["findings"]
+        assert "batch_size_collapse" in rules, report["findings"]
+        for rule in ("queue_growth", "batch_size_collapse"):
+            f = rules[rule]
+            # The death is cited IN the finding: summary names the
+            # event reason, and the event rides along as evidence.
+            assert "FaultInjected" in f["summary"], f
+            cited = [e for e in f["evidence"] if e.get("source") == "event"]
+            assert cited and cited[0]["reason"] == "FaultInjected", f
