@@ -365,24 +365,33 @@ class ElasticPolicy:
     BASELINE.json:11).
 
     When set, the job may run with worker counts in [min_replicas,
-    max_replicas]; on membership change the gang is re-rendezvoused (fresh
-    jax.distributed world) from the latest checkpoint, up to ``max_restarts``
-    times.
+    max_replicas]. A partial-gang death RESIZES the world in place
+    (survivors re-join at a new resize generation — controller/elastic.py);
+    coordinator death or a death that would leave fewer than
+    ``min_replicas`` workers still re-rendezvouses the whole gang (fresh
+    jax.distributed world) from the latest checkpoint, up to
+    ``max_restarts`` times. ``hot_spares`` keeps N pre-warmed standby
+    processes (controller/standby.py) that a shrink promotes into the
+    gang instead of cold-spawning a replacement.
     """
 
     min_replicas: int = 1
     max_replicas: int = 1
     max_restarts: int = 10
+    hot_spares: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         # Explicit dict, not dataclasses.asdict: this runs on the
         # supervisor's per-pass persistence path and asdict's recursive
         # deep-copy is ~10x the cost of building the flat dict.
-        return {
+        d = {
             "min_replicas": self.min_replicas,
             "max_replicas": self.max_replicas,
             "max_restarts": self.max_restarts,
         }
+        if self.hot_spares:
+            d["hot_spares"] = self.hot_spares
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ElasticPolicy":
@@ -390,6 +399,7 @@ class ElasticPolicy:
             min_replicas=_parse_int(d.get("min_replicas", 1), "elastic_policy.min_replicas"),
             max_replicas=_parse_int(d.get("max_replicas", 1), "elastic_policy.max_replicas"),
             max_restarts=_parse_int(d.get("max_restarts", 10), "elastic_policy.max_restarts"),
+            hot_spares=_parse_int(d.get("hot_spares", 0), "elastic_policy.hot_spares"),
         )
 
 
@@ -801,6 +811,12 @@ class TPUJobStatus:
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     restart_count: int = 0
+    # Elastic resize epoch (controller/elastic.py): bumped once per
+    # in-place world resize. Persisted through the (lease-fenced) store
+    # so a supervisor failover mid-resize completes the SAME generation
+    # exactly once instead of minting a second one. 0 = the world has
+    # never resized.
+    resize_generation: int = 0
     # Observability extras (north-star metric BASELINE.json:2): wall-clock
     # timestamps of submit-accepted and first training step, set by the
     # supervisor from workload status reports.
@@ -816,6 +832,7 @@ class TPUJobStatus:
             "start_time": self.start_time,
             "completion_time": self.completion_time,
             "restart_count": self.restart_count,
+            "resize_generation": self.resize_generation,
             "submit_time": self.submit_time,
             "first_step_time": self.first_step_time,
         }
@@ -832,6 +849,7 @@ class TPUJobStatus:
             start_time=d.get("start_time"),
             completion_time=d.get("completion_time"),
             restart_count=int(d.get("restart_count", 0)),
+            resize_generation=int(d.get("resize_generation", 0)),
             submit_time=d.get("submit_time"),
             first_step_time=d.get("first_step_time"),
         )

@@ -47,6 +47,8 @@ def _validate_elastic(ep: ElasticPolicy, spec: TPUJobSpec) -> List[str]:
         errs.append("elastic_policy.max_replicas: must be >= min_replicas")
     if ep.max_restarts < 0:
         errs.append("elastic_policy.max_restarts: must be >= 0")
+    if ep.hot_spares < 0:
+        errs.append("elastic_policy.hot_spares: must be >= 0")
     workers = spec.replica_specs.get(ReplicaType.WORKER)
     if workers is not None and workers.replicas is not None:
         if not (ep.min_replicas <= workers.replicas <= ep.max_replicas):

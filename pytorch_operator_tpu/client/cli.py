@@ -1362,6 +1362,22 @@ def cmd_bench_serve_plane(args) -> int:
     return serveplane_bench.main(argv)
 
 
+def cmd_bench_elastic(args) -> int:
+    """Elastic benchmark: resize-in-place vs whole-world-restart recovery
+    across real subprocess gangs (workloads/elastic_bench)."""
+    from pytorch_operator_tpu.workloads import elastic_bench
+
+    argv = [
+        "--gangs", args.gangs,
+        "--pre-steps", str(args.pre_steps),
+        "--step-time", str(args.step_time),
+        "--timeout", str(args.timeout),
+    ]
+    if args.out:
+        argv += ["--out", args.out]
+    return elastic_bench.main(argv)
+
+
 def cmd_manifests(args) -> int:
     # Deploy-manifest generation (SURVEY.md §1 layer 6): the CRD schema is
     # introspected from api/types.py so it cannot drift (api/crdgen.py).
@@ -1803,6 +1819,36 @@ def build_parser() -> argparse.ArgumentParser:
         help="write the full artifact here (e.g. BENCH_serveplane.json)",
     )
     sp.set_defaults(func=cmd_bench_serve_plane)
+
+    sp = sub.add_parser(
+        "bench-elastic",
+        help="measure resize-in-place vs whole-world-restart recovery "
+        "(kill one worker of a real subprocess gang; wall-clock to the "
+        "slowest member's first post-recovery step, step loss, rank "
+        "audit); emits a JSON artifact",
+    )
+    sp.add_argument(
+        "--gangs", default="2,4,8",
+        help="comma-separated WORKER counts per gang (each gang also "
+        "has one master)",
+    )
+    sp.add_argument(
+        "--pre-steps", type=int, default=5,
+        help="steps every member must reach before the kill",
+    )
+    sp.add_argument(
+        "--step-time", type=float, default=0.02,
+        help="per-step sleep of the bench workload, seconds",
+    )
+    sp.add_argument(
+        "--timeout", type=float, default=120.0,
+        help="per-phase (warm-up / recovery) timeout, seconds",
+    )
+    sp.add_argument(
+        "--out", default=None,
+        help="write the full artifact here (e.g. BENCH_elastic.json)",
+    )
+    sp.set_defaults(func=cmd_bench_elastic)
 
     sp = sub.add_parser(
         "serve-request",

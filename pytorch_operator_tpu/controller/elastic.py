@@ -1,0 +1,197 @@
+"""Elastic world resize: death classification, rank reassignment, and
+the fenced resize record survivors re-join through.
+
+Before this module, ANY replica death on an elastic job tore the whole
+gang down (``restart_world``) — correct, but the recovery latency is a
+full relaunch: scheduler round trip, process spawn, imports, rendezvous
+from zero. TorchTitan treats preemption as routine, and the TPU
+concurrency-limits study (PAPERS.md) shows recovery latency dominating
+utilization at pod scale, so partial-gang deaths now RESIZE the world in
+place instead:
+
+- :func:`classify_death` decides resize-vs-restart. Coordinator (Master)
+  death, or a death that would leave fewer than
+  ``elastic_policy.min_replicas`` workers, still restarts the world;
+  any other worker death shrinks the gang in place.
+- :func:`reassign_ranks` maps the surviving membership onto contiguous
+  ranks (Master keeps 0; survivors take 1..N in index order) — jax
+  process ids must stay dense.
+- The **resize record** (``resize.json`` in the job's status dir) is the
+  supervisor→survivor contract: one atomically-written JSON carrying the
+  resize generation, the member→rank map, the new world size, the new
+  coordinator address, and the last sidecar-verified checkpoint step to
+  repartition from. Survivors poll it from their step loop
+  (runtime/rendezvous.py) and re-join at the new size; a replica absent
+  from the member map is FENCED — a stale-generation straggler cannot
+  join the new world, because it has no rank there and (for auto-port
+  jobs) the new world rendezvouses on a fresh coordinator port.
+
+Exactly-once across supervisor failover: the generation bump is
+committed through the lease-fenced job store FIRST; the record content
+is a deterministic function of that fenced state, so a new owner
+rewrites the identical record instead of minting a second resize. The
+``handled`` field (the dead replicas this generation consumed) makes the
+classification idempotent — a failover that re-observes the same FAILED
+handles completes the SAME generation's cleanup instead of bumping
+again.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from ..api.types import ElasticPolicy, ReplicaType
+
+# classify_death verdicts.
+RESIZE = "resize"
+RESTART = "restart"
+
+# The resize record's filename inside the job's status dir — next to the
+# per-replica status JSONL files, on the one channel supervisor and
+# replicas already share.
+RESIZE_RECORD = "resize.json"
+
+
+@dataclass
+class ResizeDecision:
+    """The classifier's verdict for one batch of deaths."""
+
+    action: str  # RESIZE or RESTART
+    reason: str  # human-readable, lands in the event message
+    # Surviving worker indices (sorted) — the resized membership.
+    survivors: List[int] = field(default_factory=list)
+    # Dead worker indices (sorted) — what the resize must replace when
+    # hot spares are available.
+    dead_workers: List[int] = field(default_factory=list)
+
+
+def classify_death(
+    policy: ElasticPolicy, handles: Sequence, dead: Sequence
+) -> ResizeDecision:
+    """Partial-gang vs whole-world: decide whether the deaths in ``dead``
+    can be absorbed by shrinking the gang in place.
+
+    Pure function of (policy, handles, dead) — no clock, no I/O — so the
+    fast lane unit-tests it without subprocesses, and a supervisor that
+    re-runs it after failover reaches the identical verdict.
+
+    ``handles``/``dead`` are ReplicaHandle-shaped (``replica_type``,
+    ``index``, ``name``, ``is_active()``); ``dead`` is the subset being
+    classified (restart-eligible failures this pass).
+    """
+    dead_names = {h.name for h in dead}
+    if any(h.replica_type == ReplicaType.MASTER for h in dead):
+        return ResizeDecision(
+            RESTART, "coordinator (Master) died — the rendezvous anchor is gone"
+        )
+    master = next(
+        (h for h in handles if h.replica_type == ReplicaType.MASTER), None
+    )
+    if master is None or not master.is_active():
+        return ResizeDecision(
+            RESTART, "no live coordinator (Master) to anchor a resize"
+        )
+    survivors = sorted(
+        h.index
+        for h in handles
+        if h.replica_type == ReplicaType.WORKER
+        and h.is_active()
+        and h.name not in dead_names
+    )
+    dead_workers = sorted(
+        h.index for h in dead if h.replica_type == ReplicaType.WORKER
+    )
+    if len(survivors) < policy.min_replicas:
+        return ResizeDecision(
+            RESTART,
+            f"{len(survivors)} surviving worker(s) would fall below "
+            f"min_replicas={policy.min_replicas}",
+            survivors=survivors,
+            dead_workers=dead_workers,
+        )
+    return ResizeDecision(
+        RESIZE,
+        f"{len(dead_workers)} worker death(s); {len(survivors)} "
+        f"survivor(s) >= min_replicas={policy.min_replicas}",
+        survivors=survivors,
+        dead_workers=dead_workers,
+    )
+
+
+def member_id(rtype: str, index: int) -> str:
+    """The rank map's key for one replica: ``worker-2``, ``master-0`` —
+    the same ``<type>-<index>`` shape fault targets and status files use."""
+    return f"{str(rtype).lower()}-{index}"
+
+
+def reassign_ranks(worker_indices: Iterable[int]) -> Dict[str, int]:
+    """Contiguous ranks for a resized world: the Master keeps rank 0 (it
+    survived, or there was no resize); surviving workers take 1..N in
+    sorted index order. Survivor indices stay SPARSE (worker-2 keeps its
+    name/logs/status file); only the rank map is compacted — jax
+    process ids must be dense in [0, world)."""
+    ranks = {member_id(ReplicaType.MASTER.value, 0): 0}
+    for pos, idx in enumerate(sorted(worker_indices)):
+        ranks[member_id(ReplicaType.WORKER.value, idx)] = pos + 1
+    return ranks
+
+
+# ---- the resize record (supervisor → survivors) ----
+
+
+def resize_record_path(status_dir) -> Path:
+    return Path(status_dir) / RESIZE_RECORD
+
+
+def build_resize_record(
+    *,
+    generation: int,
+    ranks: Dict[str, int],
+    coordinator: str,
+    restore_step: Optional[int],
+    handled: Sequence[str] = (),
+    ts: Optional[float] = None,
+) -> dict:
+    """The record's one schema. ``handled`` lists the dead replica NAMES
+    this generation consumed (the failover idempotency key);
+    ``restore_step`` is the last sidecar-verified checkpoint step at
+    resize time (None = no checkpoint root / nothing committed yet)."""
+    return {
+        "generation": int(generation),
+        "world_size": len(ranks),
+        "ranks": dict(ranks),
+        "coordinator": coordinator,
+        "restore_step": restore_step,
+        "handled": sorted(handled),
+        "ts": time.time() if ts is None else ts,
+    }
+
+
+def write_resize_record(status_dir, record: dict) -> None:
+    """Atomic tmp+rename: survivors poll this file from their step loops
+    and must never observe a torn write."""
+    path = resize_record_path(status_dir)
+    tmp = path.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(record, sort_keys=True))
+    os.replace(tmp, path)
+
+
+def read_resize_record(status_dir) -> Optional[dict]:
+    try:
+        return json.loads(resize_record_path(status_dir).read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def clear_resize_record(status_dir) -> None:
+    """A whole-world restart invalidates any in-flight resize: the
+    relaunched world is defined by its injected environment again."""
+    try:
+        resize_record_path(status_dir).unlink()
+    except OSError:
+        pass

@@ -131,6 +131,12 @@ class MetricsRegistry:
             "tpujob_jobs_preempted_total",
             "TPUJob worlds evicted for higher-priority gangs",
         )
+        # ---- elastic in-place resize (controller/elastic.py) ----
+        self.elastic_resizes = self.counter(
+            "tpujob_elastic_resizes_total",
+            "In-place world resizes (shrink or spare-backfill) that "
+            "spent NO restart — the resize-vs-restart ledger's fast side",
+        )
         self.replicas_created = self.counter(
             "tpujob_replicas_created_total", "Replica processes launched"
         )
@@ -155,6 +161,15 @@ class MetricsRegistry:
         )
         self.gangs_held = self.gauge(
             "tpujob_gangs_held", "Gangs held Unschedulable in the last pass"
+        )
+        self.world_size = self.gauge(
+            "tpujob_world_size",
+            "Current world size (live replicas incl. Master) per elastic "
+            "job, labeled with the submitted target",
+        )
+        self.hot_spares = self.gauge(
+            "tpujob_hot_spares",
+            "Warm standby processes ready for promotion (runner pool)",
         )
         self.queue_slots_used = self.gauge(
             "tpujob_queue_slots_used", "Device slots in use per queue"

@@ -35,6 +35,16 @@ from ..api.types import (
     TPUJob,
 )
 from ..runtime.env import build_cluster_env
+from .elastic import (
+    RESIZE,
+    build_resize_record,
+    classify_death,
+    clear_resize_record,
+    member_id,
+    read_resize_record,
+    reassign_ranks,
+    write_resize_record,
+)
 from .events import EventRecorder
 from .expectations import ControllerExpectations
 from .gang import GangScheduler
@@ -58,6 +68,14 @@ from .status import (
 CRASH_BACKOFF_BASE_S = 1.0
 CRASH_BACKOFF_CAP_S = 300.0
 CRASH_RESET_UPTIME_S = 600.0
+
+# Grow-back holdoff after an in-place resize: growing is a whole-gang
+# re-rendezvous (restart-based), so chasing capacity immediately after a
+# shrink would convert every partial-gang death into shrink→restart churn
+# — exactly the thrash the resize path exists to avoid. The
+# world_resize_thrash detector (obs/rules.py) alerts when churn happens
+# anyway.
+RESIZE_GROW_HOLDOFF_S = 30.0
 
 
 class Reconciler:
@@ -130,6 +148,10 @@ class Reconciler:
         # respawning every sync pass (observed: an argparse-rejected
         # workload restarted ~2x/second, 1300 restarts in 10 minutes).
         self._crash_backoff: dict = {}
+        # key -> wall time of the last in-place resize; gates elastic
+        # grow-back for RESIZE_GROW_HOLDOFF_S (in-memory on purpose — a
+        # failed-over supervisor growing a little early is harmless).
+        self._last_resize: dict = {}
 
     # ---- helpers ----
 
@@ -277,6 +299,7 @@ class Reconciler:
         replica, spend one restart, set RESTARTING, record the event. The
         ONE implementation shared by failure restarts, elastic grow-back,
         and manual scale."""
+        self._invalidate_resize(job, key)
         self._delete_replicas(handles)
         job.status.restart_count += 1
         self.metrics.jobs_restarted.inc()
@@ -302,6 +325,7 @@ class Reconciler:
         ``preempt``). Unlike restart_world this does NOT spend the victim's
         restart/backoff budget — preemption is the cluster's choice, not
         the job's failure — so priority churn can never fail a victim."""
+        self._invalidate_resize(job, key)
         self._delete_replicas(handles)
         self.metrics.jobs_preempted.inc()
         msg = (
@@ -312,6 +336,20 @@ class Reconciler:
             ConditionType.RESTARTING, reason="TPUJobPreempted", message=msg, now=now
         )
         self.events.warning(key, "TPUJobPreempted", msg)
+
+    def _invalidate_resize(self, job: TPUJob, key: str) -> None:
+        """A whole-world teardown (restart, preemption) obsoletes any
+        in-flight resize: the relaunched world is defined by its injected
+        environment again. Clear the record AND zero the fenced
+        generation — leaving the generation set with no record would make
+        :meth:`_ensure_resize_record` resurrect the dead resize after a
+        supervisor failover."""
+        sd = self._status_dir(key)
+        if sd is not None:
+            clear_resize_record(sd)
+        if job.status.resize_generation:
+            job.status.resize_generation = 0
+            job.touch()
 
     def _delete_replicas(self, handles) -> None:
         """Teardown accounting in one place: batch delete (one shared
@@ -472,6 +510,23 @@ class Reconciler:
                         )
                     except (TypeError, ValueError):
                         pass
+                elif event == "resize_join":
+                    # Survivors confirming the resized membership — the
+                    # resize history in `tpujob why` and the bench's
+                    # duplicate-rank check both read these.
+                    self.events.normal(
+                        key, "ElasticResizeJoined",
+                        f"replica {Path(p).stem} joined resized world: "
+                        f"generation {rec.get('generation')}, rank "
+                        f"{rec.get('rank')}/{rec.get('world_size')}.",
+                    )
+                elif event == "resize_evicted":
+                    self.events.normal(
+                        key, "ElasticResizeEvicted",
+                        f"replica {Path(p).stem} fenced out of resized "
+                        f"world (generation {rec.get('generation')}); "
+                        "exited cleanly.",
+                    )
         if earliest is not None and job.status.first_step_time is None:
             job.status.first_step_time = earliest
             job.touch()
@@ -587,6 +642,11 @@ class Reconciler:
                 if h.slots != w:
                     self.runner.set_slots(h.name, w)
         self._scan_first_step(job, key)
+        if (
+            job.spec.elastic_policy is not None
+            and job.status.resize_generation > 0
+        ):
+            self._ensure_resize_record(job, key, handles)
 
         # ---- completion: job Succeeded ⇔ Master succeeded (status.go) ----
         master = master_handle(handles)
@@ -646,8 +706,7 @@ class Reconciler:
 
         missing = []
         for rtype, rs in job.spec.replica_specs.items():
-            desired = self._desired_replicas(job, rtype)
-            for index in range(desired):
+            for index in self._desired_indices(job, key, rtype):
                 if self.runner.get(replica_name(key, rtype, index)) is None:
                     missing.append((rtype, index))
         # replica_specs preserves user YAML key order, which may list Worker
@@ -774,7 +833,7 @@ class Reconciler:
                     missing = [
                         (rt, i)
                         for rt in job.spec.replica_specs
-                        for i in range(self._desired_replicas(job, rt))
+                        for i in self._desired_indices(job, key, rt)
                         if self.runner.get(replica_name(key, rt, i)) is None
                     ]
                     missing.sort(key=lambda mi: mi[0] != ReplicaType.MASTER)
@@ -813,6 +872,22 @@ class Reconciler:
             num_processes = sum(
                 self._desired_replicas(job, rt) for rt in job.spec.replica_specs
             )
+            # In-place resize in effect: new creations (promoted spares, a
+            # mid-failover recreate) join the RESIZED world — rank from the
+            # record's compacted map (index-derived ranks are wrong once
+            # survivor indices are sparse), the generation's coordinator,
+            # and the record's world size.
+            resize_rec = None
+            if (
+                job.spec.elastic_policy is not None
+                and job.status.resize_generation > 0
+                and status_dir is not None
+            ):
+                resize_rec = read_resize_record(status_dir)
+                if resize_rec is not None and resize_rec.get(
+                    "generation"
+                ) != job.status.resize_generation:
+                    resize_rec = None
             serve_job = (
                 job.spec.serving is not None and self.serve_root is not None
             )
@@ -831,15 +906,35 @@ class Reconciler:
                         )
                         sd.mkdir(parents=True, exist_ok=True)
                         spool_dir = str(sd)
+                    rank = None
+                    coord_port = None
+                    resize_gen = None
+                    world_n = num_processes
+                    if resize_rec is not None:
+                        rank = resize_rec.get("ranks", {}).get(
+                            member_id(rtype.value, index)
+                        )
+                        _, _, p_str = str(
+                            resize_rec.get("coordinator", "")
+                        ).rpartition(":")
+                        if p_str.isdigit():
+                            coord_port = int(p_str)
+                        resize_gen = int(resize_rec.get("generation", 0))
+                        world_n = int(
+                            resize_rec.get("world_size", num_processes)
+                        )
                     env = build_cluster_env(
                         job, rtype, index,
-                        num_processes=num_processes,
+                        num_processes=world_n,
                         coordinator_host=self.coordinator_host,
                         status_dir=status_dir,
                         checkpoint_dir=checkpoint_dir,
                         compile_cache_dir=cache_dir,
                         trace_dir=trace_dir,
                         spool_dir=spool_dir,
+                        rank=rank,
+                        coordinator_port=coord_port,
+                        resize_generation=resize_gen,
                     )
                     self.runner.create(
                         key, rtype, index, job.spec.replica_specs[rtype].template, env
@@ -958,6 +1053,224 @@ class Reconciler:
     def _desired_replicas(self, job: TPUJob, rtype: ReplicaType) -> int:
         return job.spec.replica_specs[rtype].replicas or 0
 
+    def _desired_indices(self, job: TPUJob, key: str, rtype: ReplicaType) -> List[int]:
+        """Which replica INDICES the desired count maps onto.
+
+        Non-elastic (and the Master): dense ``range(count)``. Elastic
+        workers: survivor indices stay SPARSE after an in-place resize
+        (worker-2 keeps its name/logs/status file when worker-1 dies), so
+        desired = the live indices capped at the count, topped up from the
+        lowest indices with NO runner record at all — a FAILED or
+        SUCCEEDED record still occupies its index (``runner.create``
+        refuses to overwrite it, and an evicted replica's SUCCEEDED
+        record is exactly what keeps it from being recreated). A
+        SUCCEEDED replica also fills its SLOT, not just its index:
+        completed work is never respawned at a fresh index (a new worker
+        joining a world that is finishing would die into a restart)."""
+        count = self._desired_replicas(job, rtype)
+        if job.spec.elastic_policy is None or rtype == ReplicaType.MASTER:
+            return list(range(count))
+        recs = [
+            h
+            for h in self.runner.list_for_job(key)
+            if h.replica_type == rtype
+        ]
+        live = sorted(h.index for h in recs if h.is_active())[:count]
+        succeeded = sum(
+            1
+            for h in recs
+            if not h.is_active()
+            and h.phase == ReplicaPhase.SUCCEEDED
+            and h.index not in live
+        )
+        want = max(len(live), count - succeeded)
+        out = list(live)
+        idx = 0
+        while len(out) < want:
+            if idx not in out and self.runner.get(
+                replica_name(key, rtype, idx)
+            ) is None:
+                out.append(idx)
+            idx += 1
+        return sorted(out)
+
+    # ---- elastic in-place resize ----
+
+    def _latest_verified_step(self, key: str) -> Optional[int]:
+        """Last sidecar-verified checkpoint step for this job — what a
+        resized world repartitions from ("fenced, not torn": a crash
+        mid-resize resumes from this same step)."""
+        ckpt_dir = self._checkpoint_dir(key)
+        if ckpt_dir is None:
+            return None
+        try:
+            from ..checkpoint.integrity import latest_verified_step
+
+            return latest_verified_step(ckpt_dir)
+        except Exception:
+            return None
+
+    def _ensure_resize_record(self, job: TPUJob, key: str, handles) -> None:
+        """Failover heal for the resize contract. ``status.resize_generation``
+        is the lease-fenced truth; ``resize.json`` is derived state. A
+        supervisor that crashed between the store commit and the record
+        write — or a new owner after failover — rewrites the SAME
+        generation's record deterministically instead of minting a second
+        resize (exactly-once)."""
+        status_dir = self._status_dir(key)
+        if status_dir is None:
+            return
+        rec = read_resize_record(status_dir)
+        if rec is not None and rec.get("generation") == job.status.resize_generation:
+            return
+        # Membership := the same fill rule the create pass applies; dead
+        # (FAILED) replicas still hold records, so they are excluded
+        # automatically and listed as handled — a later re-observation of
+        # the same deaths completes THIS generation instead of bumping.
+        members = self._desired_indices(job, key, ReplicaType.WORKER)
+        handled = sorted(
+            h.name for h in handles if h.phase == ReplicaPhase.FAILED
+        )
+        write_resize_record(
+            status_dir,
+            build_resize_record(
+                generation=job.status.resize_generation,
+                ranks=reassign_ranks(members),
+                coordinator=f"{self.coordinator_host}:{job.spec.port or 23456}",
+                restore_step=self._latest_verified_step(key),
+                handled=handled,
+            ),
+        )
+        self.events.normal(
+            key, "ElasticResizeHealed",
+            f"rewrote resize record for generation "
+            f"{job.status.resize_generation} after supervisor failover.",
+        )
+
+    def _resize_world(
+        self,
+        job: TPUJob,
+        key: str,
+        handles: List[ReplicaHandle],
+        restarts: List[ReplicaHandle],
+        decision,
+        now: float,
+    ) -> bool:
+        """Shrink (or spare-backfill) the gang IN PLACE: survivors keep
+        running and re-join at the new world size via the resize record —
+        no teardown, no restart spent, no scheduler round trip.
+
+        Commit order is the exactly-once story: (1) bump
+        ``status.resize_generation`` through the lease-fenced store — the
+        commit point; (2) write the resize record (derived state —
+        :meth:`_ensure_resize_record` rewrites it after a crash);
+        (3) delete the dead replicas' records. A failover replay that
+        re-observes the same deaths finds them ⊆ the record's ``handled``
+        set and completes cleanup without a second bump."""
+        from .. import obs
+
+        status_dir = self._status_dir(key)
+        dead_names = sorted(h.name for h in restarts)
+        rec = read_resize_record(status_dir) if status_dir is not None else None
+        if (
+            job.status.resize_generation > 0
+            and rec is not None
+            and rec.get("generation") == job.status.resize_generation
+            and set(dead_names) <= set(rec.get("handled", ()))
+        ):
+            # Failover replay: this generation already consumed exactly
+            # these deaths — finish its cleanup, do NOT mint another.
+            self._delete_replicas(restarts)
+            update_replica_statuses(job, self.runner.list_for_job(key))
+            self.store.update(job)
+            return True
+
+        with obs.span(
+            "resize", cat="supervisor", job=key,
+            generation=job.status.resize_generation + 1,
+        ):
+            elastic = job.spec.elastic_policy
+            workers = job.spec.replica_specs.get(ReplicaType.WORKER)
+            survivors = list(decision.survivors)
+            # Hot spares: backfill dead seats from warm standbys — the
+            # promotion is just a create at the freed index, which the
+            # runner hands to a pre-imported standby (no cold spawn).
+            promote = 0
+            if elastic.hot_spares > 0:
+                ready = getattr(self.runner, "standby_ready", lambda: 0)()
+                slots = self._slots_minus_reserved(key)
+                room = (
+                    len(decision.dead_workers)
+                    if slots is None
+                    else min(len(decision.dead_workers), slots)
+                )
+                promote = max(0, min(ready, room))
+            members = list(survivors)
+            if promote:
+                members += [
+                    i for i in decision.dead_workers if i not in members
+                ][:promote]
+            members.sort()
+            ranks = reassign_ranks(members)
+
+            # A fresh coordinator port per generation (auto-port jobs):
+            # the transport-layer half of the stale-straggler fence — a
+            # zombie from the old generation cannot even reach the new
+            # world's rendezvous.
+            if job.metadata.annotations.get(AUTO_PORT_ANNOTATION) == "true":
+                from .supervisor import _find_free_port
+
+                job.spec.port = _find_free_port()
+            coordinator = f"{self.coordinator_host}:{job.spec.port or 23456}"
+            restore_step = self._latest_verified_step(key)
+
+            # (1) commit point: the generation bump and the new desired
+            # count ride the lease-fenced store together.
+            job.status.resize_generation += 1
+            if workers is not None:
+                workers.replicas = len(members)
+            job.touch()
+            self.store.update(job)
+            # (2) the survivors' re-join contract.
+            if status_dir is not None:
+                write_resize_record(
+                    status_dir,
+                    build_resize_record(
+                        generation=job.status.resize_generation,
+                        ranks=ranks,
+                        coordinator=coordinator,
+                        restore_step=restore_step,
+                        handled=dead_names,
+                        ts=now,
+                    ),
+                )
+            # (3) retire the dead; the create pass backfills promoted
+            # seats at the freed indices next sync.
+            self._delete_replicas(restarts)
+
+        self._last_resize[key] = now
+        self.metrics.elastic_resizes.inc()
+        world = len(members) + 1  # + master
+        if promote:
+            msg = (
+                f"in-place resize (generation "
+                f"{job.status.resize_generation}): {decision.reason}; "
+                f"promoted {promote} hot spare(s), world size {world} "
+                f"(restore step {restore_step})."
+            )
+            self.events.normal(key, "ElasticSparePromoted", msg)
+        else:
+            msg = (
+                f"in-place resize (generation "
+                f"{job.status.resize_generation}): {decision.reason}; "
+                f"world shrinks to {world} "
+                f"(restore step {restore_step}, no restart spent)."
+            )
+            self.events.warning(key, "ElasticScaledDown", msg)
+        update_replica_statuses(job, self.runner.list_for_job(key))
+        self.store.update(job)
+        return True
+
     def _maybe_grow_elastic(
         self, job: TPUJob, key: str, handles: List[ReplicaHandle], now: float
     ) -> bool:
@@ -970,6 +1283,10 @@ class Reconciler:
         """
         elastic = job.spec.elastic_policy
         if elastic is None:
+            return False
+        # Post-resize holdoff: let the shrunken world make progress before
+        # spending a restart to chase the submitted target again.
+        if now - self._last_resize.get(key, 0.0) < RESIZE_GROW_HOLDOFF_S:
             return False
         workers = job.spec.replica_specs.get(ReplicaType.WORKER)
         if workers is None:
@@ -1079,6 +1396,19 @@ class Reconciler:
             self._crash_backoff[h.name] = (streak, now + delay)
 
         elastic = job.spec.elastic_policy
+        decision = None
+        if elastic is not None:
+            # Partial-gang vs whole-world: a death the gang can absorb
+            # shrinks the world IN PLACE — no teardown, no restart spent,
+            # no budget check (resize is recovery, not failure). Falls
+            # through to the restart path when the coordinator died or
+            # the survivors would dip below min_replicas.
+            decision = classify_death(elastic, handles, restarts)
+            if decision.action == RESIZE:
+                return self._resize_world(
+                    job, key, handles, restarts, decision, now
+                )
+
         n_new_restarts = len(restarts)
         backoff = job.spec.run_policy.backoff_limit
         if backoff is not None and job.status.restart_count + n_new_restarts > backoff:
@@ -1104,8 +1434,9 @@ class Reconciler:
                 self.store.update(job)
                 return False
             # Gang re-rendezvous: tear down the whole world.
+            why = decision.reason if decision is not None else "membership change"
             msg = (
-                f"elastic re-rendezvous: membership change "
+                f"elastic re-rendezvous: {why} "
                 f"(restart #{job.status.restart_count + 1})."
             )
             self.restart_world(job, key, handles, "TPUJobRestarting", msg, now=now)

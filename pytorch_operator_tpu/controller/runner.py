@@ -256,6 +256,22 @@ class ProcessRunner:
         graceful delete (the record survives so the reconciler walks the
         real failure-classification path: exit 137, retryable)."""
 
+    def inject_preempt(self, name: str) -> None:
+        """Fault-injection site (faults/ ``preempt_replica``): a
+        SIGTERM-with-grace death, distinct from :meth:`inject_kill`'s
+        abrupt SIGKILL — models a managed eviction (exit 143, retryable).
+        Runners without real signals fall back to kill semantics."""
+        self.inject_kill(name)
+
+    def standby_ready(self) -> int:
+        """Warm standby processes ready for promotion (hot spares);
+        0 for runners without a pool."""
+        return 0
+
+    def set_standby_target(self, n: int) -> None:
+        """Size the warm-standby pool (lazily created on first nonzero
+        target); no-op for runners without one."""
+
 
 class FakeRunner(ProcessRunner):
     """In-memory runner for controller tests (fake clientset analog).
@@ -267,6 +283,9 @@ class FakeRunner(ProcessRunner):
 
     def __init__(self, capacity: Optional[int] = None):
         self.handles: Dict[str, ReplicaHandle] = {}
+        # Warm-standby model for hot-spare tests: a plain counter (set
+        # directly or via set_standby_target) that standby_ready returns.
+        self.standby = 0
         # Per-job handle index: list_for_job is the reconciler's hottest
         # read (every sync of every job), and a flat scan of ALL handles
         # made a pass O(jobs x replicas) in pure bookkeeping.
@@ -392,6 +411,22 @@ class FakeRunner(ProcessRunner):
                 h.exit_code = 137  # signal death, retryable
                 h.finished_at = time.time()
                 self._changed_keys.add(h.job_key)
+
+    def inject_preempt(self, name: str) -> None:
+        with self._lock:
+            h = self.handles.get(name)
+            if h is not None and h.is_active():
+                h.phase = ReplicaPhase.FAILED
+                h.exit_code = 143  # SIGTERM death, retryable
+                h.finished_at = time.time()
+                self._changed_keys.add(h.job_key)
+
+    def standby_ready(self) -> int:
+        return self.standby
+
+    def set_standby_target(self, n: int) -> None:
+        # Tests model the pool as an instantly-warm counter.
+        self.standby = max(0, int(n))
 
     # --- test helpers ---
 
@@ -855,6 +890,47 @@ class SubprocessRunner(ProcessRunner):
             os.killpg(pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
+
+    def inject_preempt(self, name: str) -> None:
+        """Graceful preemption — group SIGTERM, no escalation wait (the
+        sync pass must not block on a TERM-trapping replica). A default
+        handler dies with 143 (retryable ≥128); the reconciler walks the
+        same failure-classification path as a real managed eviction."""
+        with self._lock:
+            h = self.handles.get(name)
+            pid = h.pid if h is not None else None
+        if pid is None:
+            return
+        start = self._pid_starts.get(name)
+        stat = _proc_stat(pid)
+        if stat is not None and start is not None and stat[0] != start:
+            return  # pid reused by a stranger — never signal it
+        try:
+            os.killpg(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def standby_ready(self) -> int:
+        with self._lock:
+            pool = self._standby_pool
+        return pool.ready_count() if pool is not None else 0
+
+    def set_standby_target(self, n: int) -> None:
+        """Grow/shrink the warm pool; lazily creates it when hot spares
+        first demand one (constructor ``standby=0`` stays the default)."""
+        n = max(0, int(n))
+        with self._lock:
+            pool = self._standby_pool
+            if pool is None:
+                if n <= 0:
+                    return
+                from .standby import StandbyPool
+
+                pool = StandbyPool(self.state_dir, n)
+                self._standby_pool = pool
+            else:
+                pool.set_size(n)
+        pool.replenish()
 
     def delete(self, name, grace_seconds: float = 5.0):
         self.delete_many([name], grace_seconds)

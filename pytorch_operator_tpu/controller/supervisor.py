@@ -172,6 +172,11 @@ class Supervisor:
         self.runner = runner if runner is not None else SubprocessRunner(
             self.state_dir, max_slots=max_slots, standby=standby
         )
+        # Warm-standby sizing: the operator's --standby is the floor; the
+        # max elastic_policy.hot_spares across unfinished elastic jobs
+        # raises it per pass (set_standby_target is called only on change).
+        self._standby_base = max(0, int(standby))
+        self._standby_want = self._standby_base
         self.gang = GangScheduler(enabled=gang_enabled)
         # volcano `preempt` action analog; opt-in (--preempt).
         self.preempt_enabled = preempt
@@ -857,6 +862,34 @@ class Supervisor:
                         "FaultInjected",
                         f"injected kill of {h.name} ({f.label()}).",
                     )
+        for f in inj.preempts_due(self._fault_pass):
+            for h in self.runner.list_all():
+                if h.is_active() and faults.FaultInjector.target_matches(
+                    f.target, h.replica_type.value, h.index
+                ):
+                    self.runner.inject_preempt(h.name)
+                    self.events.warning(
+                        h.job_key,
+                        "FaultInjected",
+                        f"injected preemption of {h.name} ({f.label()}).",
+                    )
+        for f in inj.storms_due(self._fault_pass):
+            victims = [
+                h
+                for h in self.runner.list_all()
+                if h.is_active()
+                and faults.FaultInjector.target_matches(
+                    f.target, h.replica_type.value, h.index
+                )
+            ][: max(1, f.times)]
+            for h in victims:
+                self.runner.inject_kill(h.name)
+                self.events.warning(
+                    h.job_key,
+                    "FaultInjected",
+                    f"injected kill of {h.name} "
+                    f"({f.label()}, storm of {len(victims)} this pass).",
+                )
 
     def _update_gauges(self, jobs, queue_usage: Optional[dict]) -> None:
         """Point-in-time scheduler state for /metrics, refreshed per pass
@@ -890,6 +923,29 @@ class Supervisor:
             for qname, cap in self.reconciler.queue_slots.items():
                 m.queue_slots_capacity.set(cap, queue=qname)
                 m.queue_slots_used.set(queue_usage.get(qname, 0), queue=qname)
+        # Elastic world state: current world size per unfinished elastic
+        # job (tagged with the pre-shrink target so `3→4` is readable off
+        # /metrics alone) and the warm hot-spare pool depth; the same walk
+        # folds hot_spares demand into the standby pool target.
+        m.world_size.clear()
+        hot_want = self._standby_base
+        for key, j in jobs:
+            ep = j.spec.elastic_policy
+            if ep is None:
+                continue
+            if key not in skipped and j.is_finished():
+                continue
+            hot_want = max(hot_want, ep.hot_spares)
+            target = j.metadata.annotations.get(ELASTIC_TARGET_ANNOTATION)
+            m.world_size.set(
+                j.spec.total_replicas(),
+                job=key,
+                target=str(target) if target else "",
+            )
+        m.hot_spares.set(self.runner.standby_ready())
+        if hot_want != self._standby_want:
+            self.runner.set_standby_target(hot_want)
+            self._standby_want = hot_want
         if self.shards is not None:
             m.shards_owned.set(len(self.shards.owned))
             m.shard_jobs.clear()

@@ -201,6 +201,31 @@ class FaultInjector:
                     out.append(f)
         return out
 
+    def preempts_due(self, pass_index: int) -> List[Fault]:
+        """preempt_replica faults scheduled for this supervisor pass
+        (graceful SIGTERM eviction, vs kills_due's abrupt SIGKILL)."""
+        out = []
+        with self._lock:
+            for i, f in self._candidates("preempt_replica"):
+                if f.at == pass_index:
+                    self._consume(i, f)
+                    out.append(f)
+        return out
+
+    def storms_due(self, pass_index: int) -> List[Fault]:
+        """kill_storm faults scheduled for this pass. ``times`` is the
+        victim budget of the ONE burst, not a firing count — a due
+        storm is consumed whole and the caller kills up to ``times``
+        matching live replicas inside this single pass/window."""
+        out = []
+        with self._lock:
+            for i, f in self._candidates("kill_storm"):
+                if f.at == pass_index:
+                    self._remaining[i] = 0
+                    self.fired.append(f.label())
+                    out.append(f)
+        return out
+
     def supervisor_kill_due(self, pass_index: int, identity: str) -> bool:
         """kill_supervisor: whether THIS supervisor dies at this pass.
         ``target`` matches the supervisor identity (fnmatch) or ``*``;

@@ -40,6 +40,16 @@ Fault kinds (``Fault.kind``):
                              step
 - ``kill_replica``           controller-side: SIGKILL the target replica
                              at supervisor pass ``at`` (preemption model)
+- ``preempt_replica``        controller-side: SIGTERM-with-grace the
+                             target replica at supervisor pass ``at`` —
+                             the managed-eviction model (exit 143,
+                             retryable), distinct from ``kill_replica``'s
+                             abrupt SIGKILL
+- ``kill_storm``             controller-side: SIGKILL up to ``times``
+                             matching live replicas in the ONE
+                             supervisor pass ``at`` — the correlated
+                             burst that can drive an elastic gang below
+                             ``min_replicas`` within a single window
 - ``kill_supervisor``        controller-side: the targeted SUPERVISOR
                              (``target`` = supervisor identity or ``*``)
                              dies abruptly at its pass ``at`` — shard
@@ -86,6 +96,8 @@ KINDS = frozenset(
         "torn_checkpoint_write",
         "enospc_checkpoint_write",
         "kill_replica",
+        "preempt_replica",
+        "kill_storm",
         "kill_supervisor",
         "drop_lease",
         "fail_spawn",

@@ -19,7 +19,12 @@ express are captured:
   (so the replay trains visibly, then goes silent at the same point);
 - a replica that failed with an exit code (the restart/fail events'
   ``"failed with exit code N"`` message) maps to ``crash_at_step`` at
-  its last reported step + 1 with the same exit code;
+  its last reported step + 1 with the same exit code — except the two
+  externally-signaled codes: a 143 exit (SIGTERM, a managed eviction)
+  maps to ``preempt_replica`` at pass 1, and two or more 137 exits
+  (SIGKILL) within one :data:`STORM_WINDOW_S` window collapse into a
+  single ``kill_storm`` sized to the burst (lone 137s stay
+  ``crash_at_step`` — a single preemption replays fine in-process);
 - recorded checkpoint-save failures (``checkpoint_save_failed`` status
   records) map to ``fail_checkpoint_write`` — or the persistent
   ``enospc_checkpoint_write`` when the recorded error names ENOSPC /
@@ -46,6 +51,10 @@ from .plan import Fault, FaultPlan
 
 _EXIT_RE = re.compile(r"replica (\S+) failed with exit code (\d+)")
 _TAKEOVER_RE = re.compile(r"after lease expiry of (\S+?)\.?$")
+
+# Two SIGKILL deaths at most this far apart are one correlated burst
+# (kill_storm), not independent crashes.
+STORM_WINDOW_S = 5.0
 
 
 def _replica_target(name: str, key: str) -> str:
@@ -83,18 +92,49 @@ def plan_from_recording(state_dir, key: str) -> FaultPlan:
             )
         )
 
-    # ---- crash exits -> crash_at_step at the last reported step ----
+    # ---- crash exits -> crash_at_step / preempt_replica / kill_storm ----
     seen_crash = set()
+    exits: List[tuple] = []  # (replica, code, ts) in event order, deduped
     for e in tl.events:
         m = _EXIT_RE.search(str(e.get("message", "")))
         if not m:
             continue
         replica = _replica_target(m.group(1), key)
-        code = int(m.group(2))
         if replica in seen_crash:
             continue  # one fault per replica: the plan re-fires per incarnation
         seen_crash.add(replica)
-        last_step = _last_step_before(tl, replica, float(e.get("timestamp", 0.0)))
+        exits.append((replica, int(m.group(2)), float(e.get("timestamp", 0.0))))
+    # SIGKILL deaths clustered inside one window are a correlated burst:
+    # replay them as ONE kill_storm (times = burst size) so the rebuilt
+    # plan drives the same N-deaths-in-one-window path the incident did.
+    kills = sorted(
+        (ts, replica) for replica, code, ts in exits if code == 137
+    )
+    stormed: set = set()
+    i = 0
+    while i < len(kills):
+        j = i
+        while j + 1 < len(kills) and kills[j + 1][0] - kills[j][0] <= STORM_WINDOW_S:
+            j += 1
+        if j > i:
+            burst = kills[i : j + 1]
+            stormed.update(r for _, r in burst)
+            faults.append(
+                Fault(kind="kill_storm", target="*", at=1, times=len(burst))
+            )
+        i = j + 1
+    for replica, code, ts in exits:
+        if replica in stormed:
+            continue
+        if code == 143:
+            # SIGTERM exit: a managed eviction, replayed as the external
+            # signal it was (not an in-process crash the workload would
+            # have to reach a step to reproduce).
+            faults.append(
+                Fault(kind="preempt_replica", target=replica, at=1)
+            )
+            continue
+        last_step = _last_step_before(tl, replica, ts)
         faults.append(
             Fault(
                 kind="crash_at_step",

@@ -401,6 +401,32 @@ def analyze(
         if tl.in_window(float(e.get("timestamp", 0.0)))
     ]
 
+    # Elastic resize history: every world-membership transition the
+    # reconciler committed (shrink-in-place, spare promotion, grow-back)
+    # plus the worker-side joins/evictions it fenced — the `why` face of
+    # the resize-generation protocol.
+    _RESIZE_HISTORY_REASONS = {
+        "ElasticScaledDown",
+        "ElasticScaledUp",
+        "ElasticSparePromoted",
+        "ElasticResizeJoined",
+        "ElasticResizeEvicted",
+        "ElasticResizeHealed",
+    }
+    resize_history = sorted(
+        (
+            {
+                "ts": float(e.get("timestamp", 0.0)),
+                "reason": e.get("reason"),
+                "message": e.get("message"),
+            }
+            for e in tl.events
+            if e.get("reason") in _RESIZE_HISTORY_REASONS
+            and tl.in_window(float(e.get("timestamp", 0.0)))
+        ),
+        key=lambda r: r["ts"],
+    )
+
     replicas = {
         replica: {
             "beats": len(rs),
@@ -424,6 +450,7 @@ def analyze(
         "exemplars": exemplars,
         "alerts": alerts,
         "shard_handoffs": shard_handoffs,
+        "resize_history": resize_history,
         "findings": [f.to_dict() for f in findings],
     }
 
@@ -487,7 +514,12 @@ def render_report(report: dict) -> str:
         lines.append("clock:    " + "; ".join(parts))
     alerts = report.get("alerts", [])
     findings = report.get("findings", [])
-    if not findings and not alerts and not report.get("shard_handoffs"):
+    if (
+        not findings
+        and not alerts
+        and not report.get("shard_handoffs")
+        and not report.get("resize_history")
+    ):
         lines.append("")
         lines.append("no findings — the recorded window looks healthy.")
         return "\n".join(lines)
@@ -526,6 +558,15 @@ def render_report(report: dict) -> str:
         for rec in handoffs:
             lines.append(
                 f"  {rec.get('reason', '?'):<16} @ "
+                f"{float(rec.get('ts', 0.0)):.3f}  {rec.get('message', '')}"
+            )
+    resizes = report.get("resize_history", [])
+    if resizes:
+        lines.append("")
+        lines.append(f"RESIZE HISTORY ({len(resizes)} transition(s)):")
+        for rec in resizes:
+            lines.append(
+                f"  {rec.get('reason', '?'):<20} @ "
                 f"{float(rec.get('ts', 0.0)):.3f}  {rec.get('message', '')}"
             )
     return "\n".join(lines)

@@ -93,6 +93,13 @@ class Thresholds:
     collapse_min_baseline: float = 2.0
     collapse_min_samples: int = 3
 
+    # world_resize_thrash (elastic): this many resize transitions
+    # (scale-down / scale-up / spare promotion) inside one window means
+    # the gang is oscillating between sizes instead of training — each
+    # resize pays a restore-and-repartition, so thrash is pure waste.
+    resize_thrash_count: int = 3
+    resize_thrash_window_s: float = 120.0
+
 
 DEFAULT_THRESHOLDS = Thresholds()
 
@@ -563,6 +570,37 @@ _DEATH_REASONS = (
     "ElasticScaledDown",
 )
 
+#: World-membership transitions the elastic reconciler emits — one
+#: event per committed resize generation (or restart-based grow).
+_RESIZE_REASONS = (
+    "ElasticScaledDown",
+    "ElasticScaledUp",
+    "ElasticSparePromoted",
+)
+
+
+def _iter_events(tl: TimelineView, *reasons: str) -> List[dict]:
+    """Every matching event as a normalized dict, oldest first. Both
+    views carry ``.events`` (Timeline: dicts; LiveWindow: Event objects
+    or dicts) but the protocol only promises find_event — this is its
+    find-ALL sibling, shared by rules that need the full history."""
+    out: List[dict] = []
+    for e in getattr(tl, "events", ()) or ():
+        if isinstance(e, dict):
+            if e.get("reason") in reasons:
+                out.append(e)
+        elif getattr(e, "reason", None) in reasons:
+            out.append(
+                {
+                    "reason": e.reason,
+                    "type": e.type,
+                    "timestamp": e.timestamp,
+                    "message": e.message,
+                }
+            )
+    out.sort(key=lambda e: float(e.get("timestamp", 0.0)))
+    return out
+
 
 def detect_queue_growth(
     tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
@@ -700,6 +738,79 @@ def detect_batch_size_collapse(
     ]
 
 
+def detect_world_resize_thrash(
+    tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
+) -> List[Finding]:
+    """The elastic gang oscillating between world sizes: at least
+    ``resize_thrash_count`` resize transitions (scale-down, scale-up,
+    spare promotion) inside one ``resize_thrash_window_s`` window. Each
+    transition pays a checkpoint restore and state repartition, so a
+    thrashing gang burns its time re-joining instead of training. The
+    finding cites the triggering death events (kills, preemptions,
+    restarts) inside the same span — capacity churn, not the job, is
+    usually the cause."""
+    resizes = _iter_events(tl, *_RESIZE_REASONS)
+    if len(resizes) < th.resize_thrash_count:
+        return []
+    ts = [float(e.get("timestamp", 0.0)) for e in resizes]
+    # Densest qualifying cluster: the earliest sliding window of
+    # resize_thrash_count transitions that fits inside the time window.
+    best: Optional[tuple] = None  # (i, j) inclusive
+    k = th.resize_thrash_count
+    for i in range(len(ts) - k + 1):
+        j = i + k - 1
+        if ts[j] - ts[i] > th.resize_thrash_window_s:
+            continue
+        # Extend right while still inside the window.
+        while j + 1 < len(ts) and ts[j + 1] - ts[i] <= th.resize_thrash_window_s:
+            j += 1
+        best = (i, j)
+        break
+    if best is None:
+        return []
+    i, j = best
+    cluster = resizes[i : j + 1]
+    span = ts[j] - ts[i]
+    deaths = [
+        e
+        for e in _iter_events(tl, *_DEATH_REASONS)
+        if e.get("reason") not in _RESIZE_REASONS
+        and ts[i] - th.resize_thrash_window_s
+        <= float(e.get("timestamp", 0.0))
+        <= ts[j]
+    ]
+    evidence = [ev_event(e) for e in cluster[:4]]
+    evidence.extend(ev_event(e) for e in deaths[:3])
+    kinds = ", ".join(
+        sorted({str(e.get("reason", "?")) for e in cluster})
+    )
+    cause = (
+        f"; triggered by {len(deaths)} death event(s) in the same span"
+        if deaths
+        else ""
+    )
+    return [
+        Finding(
+            rule="world_resize_thrash",
+            severity="warning",
+            summary=(
+                f"world resized {len(cluster)} times within {span:.1f}s "
+                f"(threshold {th.resize_thrash_count} in "
+                f"{th.resize_thrash_window_s:.0f}s; {kinds}) — the gang "
+                f"is thrashing between sizes instead of training{cause}"
+            ),
+            evidence=evidence,
+            metrics={
+                "resizes": len(cluster),
+                "span_s": span,
+                "deaths": len(deaths),
+                "threshold_count": th.resize_thrash_count,
+                "threshold_window_s": th.resize_thrash_window_s,
+            },
+        )
+    ]
+
+
 DETECTORS: Tuple[Callable[..., List[Finding]], ...] = (
     detect_heartbeat_silence,
     detect_step_time_regression,
@@ -708,6 +819,7 @@ DETECTORS: Tuple[Callable[..., List[Finding]], ...] = (
     detect_straggler,
     detect_queue_growth,
     detect_batch_size_collapse,
+    detect_world_resize_thrash,
 )
 
 #: Every rule either engine can produce (the alert/report inventory).
@@ -719,6 +831,7 @@ RULES = (
     "straggler",
     "queue_growth",
     "batch_size_collapse",
+    "world_resize_thrash",
     "noisy_neighbor",
 )
 

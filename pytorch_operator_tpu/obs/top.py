@@ -36,6 +36,7 @@ SERVE_QUEUE_GAUGE = "tpujob_job_serve_queue_depth"
 COLUMNS = (
     ("JOB", "job"),
     ("SHARD", "shard"),
+    ("WORLD", "world"),
     ("STEP", "step"),
     ("STEPS/S", "steps_per_sec"),
     ("P50(ms)", "p50_ms"),
@@ -98,6 +99,7 @@ def _gauge(metrics: Dict, name: str, job: str) -> Optional[float]:
 def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
     """One snapshot of the fleet: a dict per unfinished job (finished
     jobs are noise on a live screen), newest-first by heartbeat."""
+    from ..api.defaults import ELASTIC_TARGET_ANNOTATION
     from ..controller.progress import job_status_dir, read_latest_event
     from ..controller.store import JobStore, job_key
 
@@ -149,6 +151,24 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
         # ``serve`` status record so a daemon-less snapshot still
         # answers; client-perceived TTFT p99 from the serve histogram
         # with the engines' self-reported percentile as fallback.
+        # Elastic world state: current world size (the committed spec)
+        # vs the grow-back target pinned in the elastic-target
+        # annotation — `3→4` means shrunken, waiting on capacity.
+        world = world_target = None
+        if job.spec.elastic_policy is not None:
+            world = job.spec.total_replicas()
+            world_target = world
+            tgt = job.metadata.annotations.get(ELASTIC_TARGET_ANNOTATION)
+            if tgt:
+                workers = sum(
+                    rs.replicas or 0
+                    for rt, rs in job.spec.replica_specs.items()
+                    if rt.value.lower() == "worker"
+                )
+                try:
+                    world_target = world - workers + int(tgt)
+                except ValueError:
+                    pass
         sv = read_latest_event(d, "serve") or {}
         serve_q = _gauge(metrics, SERVE_QUEUE_GAUGE, key)
         if serve_q is None:
@@ -170,6 +190,8 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
             {
                 "job": key,
                 "shard": shard,
+                "world": world,
+                "world_target": world_target,
                 "shard_owner": (
                     shard_owners.get(shard) if shard is not None else None
                 ),
@@ -260,10 +282,21 @@ def _shard_cell(r: dict) -> str:
     return f"{r['shard']}@{owner[:12] if owner else '?'}"
 
 
+def _world_cell(r: dict) -> str:
+    """``4`` at target, ``3→4`` while shrunken below the grow-back
+    target, ``-`` for non-elastic jobs."""
+    w = r.get("world")
+    if w is None:
+        return "-"
+    t = r.get("world_target")
+    return str(w) if t is None or t == w else f"{w}→{t}"
+
+
 def _cells(r: dict) -> tuple:
     return (
         r["job"],
         _shard_cell(r),
+        _world_cell(r),
         _fmt(None if r["step"] is None else int(r["step"])),
         _fmt(r["steps_per_sec"], ".2f"),
         _fmt(r["p50_ms"], ".1f"),
@@ -344,6 +377,12 @@ def diff_rows(prev: List[dict], rows: List[dict]) -> List[str]:
         for key, label in (("ckpt_lag", "ckpt lag"), ("restarts", "restarts")):
             if p.get(key) != c.get(key) and c.get(key) is not None:
                 changes.append(f"{label} {_fmt(p.get(key))}→{_fmt(c.get(key))}")
+        # Elastic resize transitions: the committed world size moved
+        # (shrink-in-place, spare promotion, or grow-back).
+        pw, cw = p.get("world"), c.get("world")
+        if pw is not None and cw is not None and pw != cw:
+            direction = "shrunk" if cw < pw else "grew"
+            changes.append(f"world {pw}→{cw} ({direction})")
         pa, ca = p.get("age_s"), c.get("age_s")
         if pa is not None and ca is not None and ca > max(3 * pa, pa + 2.0):
             changes.append(f"hb age {pa:.0f}s→{ca:.0f}s (going silent?)")

@@ -39,4 +39,12 @@ def setup_backend(platform: Optional[str] = None) -> str:
         # (observed on this jaxlib), taking every single-process jax
         # test/workload down with it.
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # The persistent compilation cache is poison for this combination:
+        # an XLA:CPU executable with gloo collective thunks deserializes
+        # into something that heap-corrupts on execution (observed on this
+        # jaxlib: every cache-HIT life of a restarted gang segfaults in
+        # the jitted step within seconds, while every cold-compile life is
+        # fine). The cache's win is the TPU cold-compile skip; CPU test
+        # worlds compile in ~3s, so trade that for not crashing.
+        jax.config.update("jax_enable_compilation_cache", False)
     return platform

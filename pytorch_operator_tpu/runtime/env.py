@@ -39,15 +39,25 @@ def build_cluster_env(
     compile_cache_dir: Optional[str] = None,
     trace_dir: Optional[str] = None,
     spool_dir: Optional[str] = None,
+    rank: Optional[int] = None,
+    coordinator_port: Optional[int] = None,
+    resize_generation: Optional[int] = None,
 ) -> Dict[str, str]:
     """Build the injected environment for one replica process.
 
     ``num_processes`` overrides the spec's total (elastic re-rendezvous with
     a different world size); defaults to spec.total_replicas().
+    ``rank``/``coordinator_port`` override the index-derived rank and the
+    spec's port — a replica joining a RESIZED world (controller/elastic.py)
+    takes its rank from the resize record's compacted map (survivor
+    indices stay sparse, ranks must be dense) and the generation's own
+    coordinator port. ``resize_generation`` stamps the world epoch this
+    replica belongs to; the rendezvous layer fences it against newer
+    resize records.
     """
     total = num_processes if num_processes is not None else job.spec.total_replicas()
-    rank = replica_rank(rtype, index)
-    port = job.spec.port or 23456
+    rank = replica_rank(rtype, index) if rank is None else rank
+    port = coordinator_port if coordinator_port is not None else (job.spec.port or 23456)
     coordinator = f"{coordinator_host}:{port}"
     key = f"{job.metadata.namespace}/{job.metadata.name}"
 
@@ -71,6 +81,11 @@ def build_cluster_env(
         "TPUJOB_REPLICA_TYPE": rtype.value,
         "TPUJOB_REPLICA_INDEX": str(index),
         "TPUJOB_RESTART_COUNT": str(job.status.restart_count),
+        "TPUJOB_RESIZE_GENERATION": str(
+            job.status.resize_generation
+            if resize_generation is None
+            else resize_generation
+        ),
     }
 
     resources = job.spec.replica_specs[rtype].template.resources

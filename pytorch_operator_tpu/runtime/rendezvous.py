@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 from dataclasses import dataclass
 from pathlib import Path
@@ -36,6 +37,11 @@ class WorldInfo:
     replica_index: int
     restart_count: int
     job_key: str
+    # Elastic resize epoch (controller/elastic.py): the generation of the
+    # world this process belongs to. A resize record with a NEWER
+    # generation in the status dir means the world moved on — poll_resize
+    # yields either the process's place in the new world or its eviction.
+    resize_generation: int = 0
 
     @property
     def is_coordinator(self) -> bool:
@@ -52,6 +58,7 @@ def world_from_env() -> WorldInfo:
         replica_index=int(os.environ.get("TPUJOB_REPLICA_INDEX", "0")),
         restart_count=int(os.environ.get("TPUJOB_RESTART_COUNT", "0")),
         job_key=os.environ.get("TPUJOB_KEY", "default/local"),
+        resize_generation=int(os.environ.get("TPUJOB_RESIZE_GENERATION", "0")),
     )
 
 
@@ -87,6 +94,145 @@ def join_backoff(timeout_s: float, base_s: float, seed: int):
     )
 
 
+# ---- elastic resize (controller/elastic.py is the writer) ----
+
+
+@dataclass
+class ResizeSignal:
+    """One observed resize-record advance: either this process's place in
+    the new world, or its eviction from it."""
+
+    generation: int
+    evicted: bool
+    world: Optional[WorldInfo]  # None when evicted
+    restore_step: Optional[int]  # last sidecar-verified step at resize time
+    record: dict
+
+
+def _member_id(world: WorldInfo) -> str:
+    return f"{world.replica_type.lower()}-{world.replica_index}"
+
+
+def read_resize_record() -> Optional[dict]:
+    d = os.environ.get("TPUJOB_STATUS_DIR")
+    if not d:
+        return None
+    try:
+        with open(Path(d) / "resize.json") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def poll_resize(world: WorldInfo) -> Optional[ResizeSignal]:
+    """Step-loop resize check: has the supervisor advanced the world past
+    this process's generation? One stat+read per call, nothing without a
+    status dir. Returns None while the world is current; otherwise a
+    signal carrying the new membership — or the eviction fence: a
+    process absent from the record's rank map has NO place in the new
+    world and must exit rather than join (the stale-generation-straggler
+    guard)."""
+    rec = read_resize_record()
+    if rec is None:
+        return None
+    try:
+        gen = int(rec.get("generation", 0))
+    except (TypeError, ValueError):
+        return None
+    if gen <= world.resize_generation:
+        return None
+    ranks = rec.get("ranks") or {}
+    restore = rec.get("restore_step")
+    restore = int(restore) if restore is not None else None
+    rank = ranks.get(_member_id(world))
+    if rank is None:
+        return ResizeSignal(gen, True, None, restore, rec)
+    from dataclasses import replace
+
+    new_world = replace(
+        world,
+        num_processes=int(rec.get("world_size", len(ranks))),
+        process_id=int(rank),
+        coordinator=str(rec.get("coordinator", world.coordinator)),
+        resize_generation=gen,
+    )
+    return ResizeSignal(gen, False, new_world, restore, rec)
+
+
+def adopt_resize(sig: ResizeSignal) -> WorldInfo:
+    """Become a member of the resized world (jax-free path: the caller's
+    step loop keeps running with the returned WorldInfo). Reports the
+    re-join on the status channel — `tpujob why`'s resize history and
+    the bench's duplicate-rank check both read these records."""
+    report(
+        "resize_join",
+        generation=sig.generation,
+        rank=sig.world.process_id,
+        world_size=sig.world.num_processes,
+    )
+    return sig.world
+
+
+def exit_for_resize(sig: ResizeSignal) -> None:
+    """Terminal resize outcomes. Evicted: report and exit 0 — this
+    process has no rank in the new world (fenced out). Member of a REAL
+    jax.distributed world: re-exec in place — same pid, same log file,
+    no scheduler round trip — with the environment rewritten to the new
+    generation's coordinates; the fresh ``main()`` re-joins at the new
+    coordinator and restores from the last verified checkpoint. (In-
+    process jax.distributed re-initialization is not reliably supported;
+    exec is the surgical alternative to a gang teardown.)"""
+    import sys
+
+    if sig.evicted:
+        report("resize_evicted", generation=sig.generation)
+        print(
+            f"[rendezvous] evicted by resize generation {sig.generation}; "
+            "exiting.",
+            flush=True,
+        )
+        sys.stdout.flush()
+        sys.stderr.flush()
+        raise SystemExit(0)
+    w = sig.world
+    host, _, port = w.coordinator.rpartition(":")
+    os.environ.update(
+        {
+            "TPUJOB_NUM_PROCESSES": str(w.num_processes),
+            "TPUJOB_PROCESS_ID": str(w.process_id),
+            "TPUJOB_COORDINATOR_ADDRESS": w.coordinator,
+            "TPUJOB_RESIZE_GENERATION": str(w.resize_generation),
+            "WORLD_SIZE": str(w.num_processes),
+            "RANK": str(w.process_id),
+            "MASTER_ADDR": host or "127.0.0.1",
+            "MASTER_PORT": port,
+            "TPU_WORKER_ID": str(w.process_id),
+            "TPU_WORKER_HOSTNAMES": ",".join(
+                [host or "127.0.0.1"] * w.num_processes
+            ),
+        }
+    )
+    report(
+        "resize_join",
+        generation=sig.generation,
+        rank=w.process_id,
+        world_size=w.num_processes,
+        via="exec",
+    )
+    print(
+        f"[rendezvous] re-joining resized world: generation "
+        f"{sig.generation}, rank {w.process_id}/{w.num_processes} "
+        f"at {w.coordinator} (in-place exec)",
+        flush=True,
+    )
+    sys.stdout.flush()
+    sys.stderr.flush()
+    argv = getattr(sys, "orig_argv", None)
+    if argv and len(argv) > 1:
+        os.execv(sys.executable, [sys.executable] + list(argv[1:]))
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
 def initialize_from_env(
     timeout_s: float = 60.0, retry_interval_s: float = 1.0
 ) -> WorldInfo:
@@ -106,6 +252,18 @@ def initialize_from_env(
     fault_stall_if_armed()
     setup_backend()
     world = world_from_env()
+    # Resize fence: an environment stamped with an older generation than
+    # the status dir's resize record describes a world that no longer
+    # exists. A straggler still named in the new member map adopts its
+    # new coordinates BEFORE the first join (a promoted spare or a
+    # replica recreated mid-failover lands here); one absent from the
+    # map is fenced out and exits cleanly — it must not camp on the old
+    # coordinator port waiting for a gang that will never assemble.
+    sig = poll_resize(world)
+    if sig is not None:
+        if sig.evicted:
+            exit_for_resize(sig)
+        world = adopt_resize(sig)
     if world.num_processes <= 1:
         return world
 
@@ -142,6 +300,52 @@ def initialize_from_env(
             f"rendezvous with coordinator {world.coordinator} failed after "
             f"{timeout_s}s: {e}"
         ) from e
+
+
+def finalize(world: WorldInfo, exit_code: int = 0) -> None:
+    """Leave a multi-process world deterministically after the workload
+    finished: coordination-service barrier, leader grace, hard
+    ``os._exit``.
+
+    The hard exit is the point. jax's implicit atexit teardown races
+    its own gloo/coordination threads and intermittently segfaults a
+    replica that COMPLETED all its work — and a 139 is retryable, so
+    every such exit burns a restart and re-runs a finished life. A
+    replica that reached finalize owes nothing to interpreter teardown;
+    flush and leave. Single-process worlds (nothing was initialized)
+    return normally so in-process callers (unit tests) survive.
+
+    The barrier is the coordination service's key-value barrier (pure
+    RPC), NOT a jax collective — multi-process collectives are backend-
+    dependent (unimplemented on CPU) and ``jax.distributed.shutdown``
+    itself is part of the teardown being avoided. After the barrier,
+    every peer is provably done; non-leaders exit immediately, and the
+    leader lingers one beat so the coordination service it hosts stays
+    up while they leave (a leader that vanishes first turns its peers'
+    clean exits into "leader task died" aborts).
+
+    A barrier failure is swallowed: it means a PEER died, and that is
+    the supervisor's problem — this replica's work is done and its exit
+    code must say so.
+    """
+    if world.num_processes <= 1:
+        return
+    sys.stdout.flush()
+    sys.stderr.flush()
+    try:
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is not None:
+            try:
+                client.wait_at_barrier("tpujob_finalize", 10_000)
+            except Exception:
+                pass
+            if world.process_id == 0:
+                time.sleep(1.0)
+    except Exception:
+        pass
+    os._exit(exit_code)
 
 
 # ---- status reporting (workload → supervisor) ----

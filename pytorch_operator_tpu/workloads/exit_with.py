@@ -155,7 +155,31 @@ def _run_steps(
             time.sleep(snapshot_time)
         return {"step": step}
     rendezvous.report_first_step(start + 1)
-    for step in range(start + 1, steps + 1):
+    world = rendezvous.world_from_env()
+    step = start + 1
+    while step <= steps:
+        # Elastic resize check (jax-free adoption): a newer resize record
+        # either hands this process its place in the shrunken/backfilled
+        # world — repartition = resume from the record's verified step —
+        # or fences it out (eviction exits 0).
+        sig = rendezvous.poll_resize(world)
+        if sig is not None:
+            if sig.evicted:
+                if writer is not None:
+                    writer.close()
+                rendezvous.exit_for_resize(sig)  # raises SystemExit(0)
+            world = rendezvous.adopt_resize(sig)
+            resume = sig.restore_step
+            if resume is None and root is not None:
+                resume = _restore_step(root)
+            if resume is not None:
+                print(
+                    f"[exit_with] resized world (generation {sig.generation}, "
+                    f"rank {world.process_id}/{world.num_processes}); "
+                    f"resumed from checkpoint at step {resume}",
+                    flush=True,
+                )
+                step = resume + 1
         with obs.span("step", cat="step", step=step):
             rendezvous.report_progress(
                 step,
@@ -184,6 +208,7 @@ def _run_steps(
                         _report_save_failed(step, e)
             if step_time:
                 time.sleep(step_time)
+        step += 1
     if writer is not None:
         writer.close()  # exit drains: every submitted save is decided
     rec = obs.tracer()

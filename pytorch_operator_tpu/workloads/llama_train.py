@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 import time
 
@@ -260,6 +261,34 @@ def run(
             sys.stderr.flush()
             os._exit(138)
 
+    # Elastic in-place resize (controller/elastic.py): polled once per
+    # step from the host side of the feed. jax.distributed cannot be
+    # re-initialized in-process, so a survivor drains its host resources
+    # and RE-EXECS with the new world's coordinates — same pid, same log
+    # file, no scheduler round trip; the fresh main() re-joins at the new
+    # coordinator and resumes from the last verified checkpoint. An
+    # evicted replica exits 0 instead.
+    train_world = rendezvous.world_from_env()
+
+    def maybe_resize(step: int):
+        sig = rendezvous.poll_resize(train_world)
+        if sig is None:
+            return
+        log(
+            f"[llama] resize generation {sig.generation} observed at "
+            f"step {step}; draining for in-place re-join"
+        )
+        for drain in (
+            lambda: prefetcher.close() if prefetcher is not None else None,
+            lambda: loader.close() if loader is not None else None,
+            lambda: mgr.close() if mgr is not None else None,
+        ):
+            try:
+                drain()
+            except Exception:
+                pass
+        rendezvous.exit_for_resize(sig)
+
     validated_files: dict = {}
 
     def open_token_file(path: str, flag: str, seed: int, do_open: bool = True):
@@ -421,6 +450,7 @@ def run(
 
             def batches(step: int):
                 maybe_preempt(step)
+                maybe_resize(step)
                 # Already device-resident: batch step+prefetch is being
                 # transferred on the feed thread while this step runs.
                 return prefetcher.get()
@@ -429,6 +459,7 @@ def run(
 
             def batches(step: int):
                 maybe_preempt(step)
+                maybe_resize(step)
                 return put_global(host_batch(step), batch_sharding)
 
         def on_first():
@@ -723,6 +754,14 @@ def main(argv=None) -> int:
         "on the replica's first life (simulated TPU preemption)",
     )
     p.add_argument(
+        "--preempt-index", default=None,
+        help="restrict --preempt-at to the replicas whose "
+        "TPUJOB_REPLICA_INDEX is in this comma-separated list (replicas "
+        "of one spec share args; this lets a chosen subset of the gang "
+        "preempt — e.g. two of three workers so an fsdp=4 world shrinks "
+        "to the still-divisible fsdp=2 — instead of all of them)",
+    )
+    p.add_argument(
         "--profile-dir", default=None,
         help="write a jax.profiler trace of the timed window here",
     )
@@ -775,7 +814,15 @@ def main(argv=None) -> int:
         pp_microbatches=args.pp_microbatches,
         pp_schedule=args.pp_schedule,
         grad_accum=args.grad_accum,
-        preempt_at=args.preempt_at,
+        preempt_at=(
+            None
+            if args.preempt_index is not None
+            and int(os.environ.get("TPUJOB_REPLICA_INDEX", "0"))
+            not in {
+                int(s) for s in str(args.preempt_index).split(",") if s.strip()
+            }
+            else args.preempt_at
+        ),
         profile_dir=args.profile_dir,
         log=lambda msg: print(
             f"[rank {world.process_id}/{world.num_processes}] {msg}"
@@ -786,6 +833,11 @@ def main(argv=None) -> int:
     )
     if args.json and world.process_id == 0:
         print(json.dumps(result), flush=True)
+    # Deterministic multi-process teardown (never returns for real
+    # worlds): jax's implicit atexit teardown intermittently segfaults
+    # a COMPLETED replica, and that 139 is retryable — it would burn a
+    # restart re-running a finished life.
+    rendezvous.finalize(world)
     return 0
 
 

@@ -1,0 +1,358 @@
+"""Fast-lane units for the elastic resize machinery — no subprocesses.
+
+- classify_death: the partial-gang vs whole-world decision table
+  (coordinator death, below-min_replicas, no live master, resizable
+  worker deaths);
+- reassign_ranks: contiguous dense ranks over sparse survivor indices,
+  zero duplicates, master pinned to 0;
+- the resize record: atomic write/read/clear roundtrip, corrupt and
+  missing records read as None;
+- poll_resize fencing: a stale-generation process adopts its place in
+  the new world or is evicted; a current-generation process sees
+  nothing;
+- the world_resize_thrash rule: fires on >= K resize transitions in one
+  window (citing the triggering death events), stays quiet below the
+  bar, and honors spec.observability.alerts threshold overrides;
+- preempt_replica / kill_storm fault kinds: plan validation, injector
+  due/consumption semantics, and chaos --record reconstruction (143
+  exits -> preempt_replica; clustered SIGKILLs -> one kill_storm).
+"""
+
+from __future__ import annotations
+
+import json
+
+from pytorch_operator_tpu.api.types import ElasticPolicy, ReplicaType
+from pytorch_operator_tpu.controller.elastic import (
+    RESIZE,
+    RESTART,
+    build_resize_record,
+    classify_death,
+    clear_resize_record,
+    member_id,
+    read_resize_record,
+    reassign_ranks,
+    resize_record_path,
+    write_resize_record,
+)
+from pytorch_operator_tpu.faults import Fault, FaultInjector, FaultPlan
+from pytorch_operator_tpu.obs import rules as obs_rules
+from pytorch_operator_tpu.runtime import rendezvous
+
+
+class _H:
+    """ReplicaHandle-shaped stub for the pure classifier."""
+
+    def __init__(self, rtype, index, active=True):
+        self.replica_type = rtype
+        self.index = index
+        self.name = f"{rtype.value.lower()}-{index}"
+        self._active = active
+
+    def is_active(self):
+        return self._active
+
+
+def _gang(workers=3, master_active=True):
+    handles = [_H(ReplicaType.MASTER, 0, active=master_active)]
+    handles += [_H(ReplicaType.WORKER, i) for i in range(workers)]
+    return handles
+
+
+class TestClassifyDeath:
+    def test_worker_death_with_enough_survivors_resizes(self):
+        handles = _gang(workers=3)
+        dead = [handles[2]]  # worker-1
+        d = classify_death(ElasticPolicy(1, 3, 4), handles, dead)
+        assert d.action == RESIZE
+        assert d.survivors == [0, 2]
+        assert d.dead_workers == [1]
+
+    def test_master_death_restarts_world(self):
+        handles = _gang(workers=3)
+        d = classify_death(ElasticPolicy(1, 3, 4), handles, [handles[0]])
+        assert d.action == RESTART
+        assert "coordinator" in d.reason.lower()
+
+    def test_below_min_replicas_restarts_world(self):
+        handles = _gang(workers=2)
+        d = classify_death(ElasticPolicy(2, 2, 4), handles, [handles[1]])
+        assert d.action == RESTART
+        assert "min_replicas=2" in d.reason
+
+    def test_no_live_master_restarts_world(self):
+        handles = _gang(workers=2, master_active=False)
+        d = classify_death(ElasticPolicy(1, 2, 4), handles, [handles[1]])
+        assert d.action == RESTART
+
+    def test_storm_of_deaths_classified_as_one_batch(self):
+        # Three of four workers die in one pass: survivors 1 >= min 1
+        # resizes; with min 2 the SAME batch restarts — the window is
+        # the pass, not per-death.
+        handles = _gang(workers=4)
+        dead = [handles[1], handles[2], handles[4]]  # workers 0, 1, 3
+        d = classify_death(ElasticPolicy(1, 4, 5), handles, dead)
+        assert d.action == RESIZE
+        assert d.survivors == [2]
+        d = classify_death(ElasticPolicy(2, 4, 5), handles, dead)
+        assert d.action == RESTART
+
+
+class TestReassignRanks:
+    def test_sparse_survivors_get_dense_ranks(self):
+        ranks = reassign_ranks([4, 0, 2])
+        assert ranks == {
+            "master-0": 0,
+            "worker-0": 1,
+            "worker-2": 2,
+            "worker-4": 3,
+        }
+
+    def test_no_duplicate_ranks_and_dense(self):
+        ranks = reassign_ranks([7, 1, 3, 5])
+        vals = sorted(ranks.values())
+        assert vals == list(range(len(ranks)))
+
+    def test_member_id_shape(self):
+        assert member_id("Worker", 2) == "worker-2"
+        assert member_id(ReplicaType.MASTER.value, 0) == "master-0"
+
+
+class TestResizeRecord:
+    def test_roundtrip_and_clear(self, tmp_path):
+        rec = build_resize_record(
+            generation=2,
+            ranks=reassign_ranks([0, 2]),
+            coordinator="127.0.0.1:4242",
+            restore_step=9,
+            handled=["worker-1"],
+            ts=123.0,
+        )
+        assert rec["world_size"] == 3
+        write_resize_record(tmp_path, rec)
+        got = read_resize_record(tmp_path)
+        assert got == rec
+        assert not resize_record_path(tmp_path).with_suffix(
+            ".json.tmp"
+        ).exists()
+        clear_resize_record(tmp_path)
+        assert read_resize_record(tmp_path) is None
+        clear_resize_record(tmp_path)  # idempotent
+
+    def test_corrupt_record_reads_as_none(self, tmp_path):
+        resize_record_path(tmp_path).write_text("{not json")
+        assert read_resize_record(tmp_path) is None
+
+
+class TestPollResize:
+    def _arm(self, tmp_path, monkeypatch, ranks, generation=1, step=7):
+        monkeypatch.setenv("TPUJOB_STATUS_DIR", str(tmp_path))
+        write_resize_record(
+            tmp_path,
+            build_resize_record(
+                generation=generation,
+                ranks=ranks,
+                coordinator="127.0.0.1:5151",
+                restore_step=step,
+                ts=1.0,
+            ),
+        )
+
+    def _world(self, rtype="Worker", index=2, gen=0):
+        return rendezvous.WorldInfo(
+            num_processes=4,
+            process_id=3,
+            coordinator="127.0.0.1:23456",
+            replica_type=rtype,
+            replica_index=index,
+            restart_count=0,
+            job_key="default/ej",
+            resize_generation=gen,
+        )
+
+    def test_member_adopts_new_coordinates(self, tmp_path, monkeypatch):
+        self._arm(tmp_path, monkeypatch, reassign_ranks([0, 2]))
+        sig = rendezvous.poll_resize(self._world())
+        assert sig is not None and not sig.evicted
+        assert sig.world.process_id == 2  # worker-2 compacted to rank 2
+        assert sig.world.num_processes == 3
+        assert sig.world.coordinator == "127.0.0.1:5151"
+        assert sig.world.resize_generation == 1
+        assert sig.restore_step == 7
+
+    def test_absent_member_is_evicted(self, tmp_path, monkeypatch):
+        self._arm(tmp_path, monkeypatch, reassign_ranks([0, 1]))
+        sig = rendezvous.poll_resize(self._world(index=2))
+        assert sig is not None and sig.evicted
+        assert sig.world is None
+
+    def test_current_generation_sees_nothing(self, tmp_path, monkeypatch):
+        # A process already at the record's generation (it adopted, or
+        # was spawned into it) must not re-trigger — the fence is
+        # strictly monotone.
+        self._arm(tmp_path, monkeypatch, reassign_ranks([0, 2]))
+        assert rendezvous.poll_resize(self._world(gen=1)) is None
+        assert rendezvous.poll_resize(self._world(gen=5)) is None
+
+    def test_no_status_dir_is_a_noop(self, monkeypatch):
+        monkeypatch.delenv("TPUJOB_STATUS_DIR", raising=False)
+        assert rendezvous.poll_resize(self._world()) is None
+
+
+def _ev(ts, reason, message=""):
+    return {
+        "timestamp": float(ts),
+        "type": "Warning",
+        "reason": reason,
+        "message": message,
+    }
+
+
+def _window(events, now=200.0):
+    from pytorch_operator_tpu.obs.watch import LiveWindow
+
+    return LiveWindow(progress={}, records={}, events=events, now=now)
+
+
+class TestResizeThrashRule:
+    def test_fires_on_three_resizes_in_window(self):
+        tl = _window(
+            [
+                _ev(100.0, "FaultInjected", "injected kill of w-1"),
+                _ev(101.0, "ElasticScaledDown", "resized to 3"),
+                _ev(130.0, "ElasticScaledUp", "grew back to 4"),
+                _ev(160.0, "ElasticScaledDown", "resized to 3"),
+            ]
+        )
+        found = obs_rules.detect_world_resize_thrash(tl)
+        assert len(found) == 1
+        f = found[0]
+        assert f.rule == "world_resize_thrash"
+        assert f.metrics["resizes"] == 3
+        # The triggering death event rides along as evidence.
+        assert any(
+            e.get("reason") == "FaultInjected" for e in f.evidence
+        )
+
+    def test_quiet_below_count_or_outside_window(self):
+        assert not obs_rules.detect_world_resize_thrash(
+            _window(
+                [
+                    _ev(100.0, "ElasticScaledDown"),
+                    _ev(110.0, "ElasticScaledUp"),
+                ]
+            )
+        )
+        # Three transitions, but spread wider than the window.
+        assert not obs_rules.detect_world_resize_thrash(
+            _window(
+                [
+                    _ev(100.0, "ElasticScaledDown"),
+                    _ev(300.0, "ElasticScaledUp"),
+                    _ev(500.0, "ElasticScaledDown"),
+                ],
+                now=600.0,
+            )
+        )
+
+    def test_threshold_overrides_apply(self):
+        events = [
+            _ev(100.0, "ElasticScaledDown"),
+            _ev(101.0, "ElasticSparePromoted"),
+            _ev(102.0, "ElasticScaledUp"),
+        ]
+        th = obs_rules.thresholds_from_overrides({"resize_thrash_count": 5})
+        assert not obs_rules.detect_world_resize_thrash(_window(events), th)
+        th = obs_rules.thresholds_from_overrides(
+            {"resize_thrash_count": 2, "resize_thrash_window_s": 0.5}
+        )
+        # Count met but no 2 transitions inside 0.5s... tighten window.
+        assert not obs_rules.detect_world_resize_thrash(_window(events), th)
+        th = obs_rules.thresholds_from_overrides(
+            {"resize_thrash_count": 2, "resize_thrash_window_s": 10.0}
+        )
+        assert obs_rules.detect_world_resize_thrash(_window(events), th)
+
+    def test_registered_in_both_inventories(self):
+        assert "world_resize_thrash" in obs_rules.RULES
+        assert obs_rules.detect_world_resize_thrash in obs_rules.DETECTORS
+        assert "resize_thrash_count" in obs_rules.THRESHOLD_FIELDS
+
+
+class TestNewFaultKinds:
+    def test_kinds_validate_and_roundtrip(self):
+        plan = FaultPlan(
+            seed=3,
+            faults=[
+                Fault(kind="preempt_replica", target="worker-1", at=2),
+                Fault(kind="kill_storm", target="worker-*", at=3, times=2),
+            ],
+        )
+        got = FaultPlan.from_json(plan.to_json())
+        assert [f.kind for f in got.faults] == [
+            "preempt_replica",
+            "kill_storm",
+        ]
+
+    def test_preempts_due_consumes_at_pass(self):
+        inj = FaultInjector(
+            FaultPlan(
+                faults=[Fault(kind="preempt_replica", target="worker-0", at=2)]
+            )
+        )
+        assert inj.preempts_due(1) == []
+        due = inj.preempts_due(2)
+        assert len(due) == 1 and due[0].target == "worker-0"
+        assert inj.preempts_due(2) == []  # consumed
+
+    def test_storm_consumed_whole_in_one_pass(self):
+        # times is the victim budget of ONE burst, not a firing count:
+        # the storm is due exactly once, at its pass.
+        inj = FaultInjector(
+            FaultPlan(
+                faults=[Fault(kind="kill_storm", target="*", at=1, times=3)]
+            )
+        )
+        due = inj.storms_due(1)
+        assert len(due) == 1 and due[0].times == 3
+        assert inj.storms_due(1) == []
+        assert inj.storms_due(2) == []
+
+    def test_record_maps_143_to_preempt_and_burst_to_storm(self, tmp_path):
+        from pytorch_operator_tpu.controller.store import key_to_fs
+        from pytorch_operator_tpu.faults.record import plan_from_recording
+
+        state = tmp_path / "state"
+        key = "default/storm"
+        ev_dir = state / "events"
+        ev_dir.mkdir(parents=True)
+        death = (
+            "replica default_storm-{} failed with exit code {} (restart #1)."
+        )
+        events = [
+            # Two SIGKILLs one second apart: one correlated burst.
+            {"timestamp": 100.0, "type": "Warning",
+             "reason": "TPUJobRestarting",
+             "message": death.format("worker-0", 137), "count": 1},
+            {"timestamp": 101.0, "type": "Warning",
+             "reason": "TPUJobRestarting",
+             "message": death.format("worker-1", 137), "count": 1},
+            # A SIGTERM eviction, minutes later.
+            {"timestamp": 400.0, "type": "Warning",
+             "reason": "TPUJobRestarting",
+             "message": death.format("worker-2", 143), "count": 1},
+        ]
+        with open(ev_dir / (key_to_fs(key) + ".events.jsonl"), "a") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        plan = plan_from_recording(state, key)
+        kinds = sorted(f.kind for f in plan.faults)
+        assert kinds == ["kill_storm", "preempt_replica"]
+        storm = next(f for f in plan.faults if f.kind == "kill_storm")
+        assert storm.times == 2
+        pre = next(f for f in plan.faults if f.kind == "preempt_replica")
+        assert pre.target == "worker-2"
+        # The reconstructed plan replays through a fresh injector.
+        inj = FaultInjector(plan)
+        assert len(inj.storms_due(1)) == 1
+        assert len(inj.preempts_due(1)) == 1

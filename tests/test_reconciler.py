@@ -440,8 +440,11 @@ class TestDeadline:
 
 
 class TestElastic:
-    def test_worker_loss_triggers_gang_restart(self):
-        store, runner, _, _, rec = make_harness()
+    def test_worker_loss_resizes_in_place(self):
+        """Partial-gang death on an elastic job shrinks the world IN
+        PLACE: survivors keep running, no restart is spent, and the
+        dead seat is simply retired (controller/elastic.py)."""
+        store, runner, events, metrics, rec = make_harness()
         key = store.add(
             new_job(
                 workers=3,
@@ -458,15 +461,205 @@ class TestElastic:
         )
         rec.sync(key)
         job = store.get(key)
+        # NOT a whole-world restart: survivors untouched, budget intact.
+        assert not job.has_condition(ConditionType.RESTARTING)
+        assert job.status.restart_count == 0
+        assert job.status.resize_generation == 1
+        live = [h.name for h in runner.list_for_job(key)]
+        assert replica_name(key, ReplicaType.MASTER, 0) in live
+        assert replica_name(key, ReplicaType.WORKER, 0) in live
+        assert replica_name(key, ReplicaType.WORKER, 2) in live
+        assert replica_name(key, ReplicaType.WORKER, 1) not in live
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 2
+        assert any(
+            e.reason == "ElasticScaledDown" for e in events.for_job(key)
+        )
+        assert metrics.elastic_resizes.get() == 1
+        # Survivor indices stay sparse: the next sync must NOT recreate
+        # worker-1 (the desired indices are the live ones).
+        rec.sync(key)
+        assert len(runner.list_for_job(key)) == 3
+
+    def test_hot_spare_backfills_dead_seat_without_restart(self):
+        """With a warm standby ready, a partial-gang death is absorbed at
+        FULL world size: the resize record keeps the dead seat in the
+        member map, the create pass backfills it (the runner hands the
+        create to a pre-imported standby — no cold spawn, pinned in
+        test_standby), and the event says ElasticSparePromoted."""
+        store, runner, events, _, rec = make_harness()
+        key = store.add(
+            new_job(
+                workers=2,
+                restart_policy=RestartPolicy.EXIT_CODE,
+                elastic=ElasticPolicy(
+                    min_replicas=1, max_replicas=3, max_restarts=5,
+                    hot_spares=1,
+                ),
+            )
+        )
+        rec.sync(key)
+        runner.set_all_running(key)
+        runner.set_standby_target(1)
+        rec.sync(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.WORKER, 1), ReplicaPhase.FAILED, 137
+        )
+        rec.sync(key)
+        job = store.get(key)
+        assert not job.has_condition(ConditionType.RESTARTING)
+        assert job.status.restart_count == 0
+        assert job.status.resize_generation == 1
+        # The promoted seat keeps the target world size: 2 workers.
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 2
+        assert any(
+            e.reason == "ElasticSparePromoted" for e in events.for_job(key)
+        )
+        assert not any(
+            e.reason == "ElasticScaledDown" for e in events.for_job(key)
+        )
+        # Next pass backfills the freed index — world back to 3 members.
+        rec.sync(key)
+        names = [h.name for h in runner.list_for_job(key) if h.is_active()]
+        assert replica_name(key, ReplicaType.WORKER, 1) in names
+        assert len(names) == 3
+
+    def test_succeeded_worker_is_not_respawned_at_a_fresh_index(self):
+        """A worker that ran to SUCCESS filled its slot forever: the
+        elastic sparse-index fill must not top the count back up with a
+        fresh index (a new worker joining a finishing world would die
+        into a restart — the finishing-gang refill bug)."""
+        store, runner, _, _, rec = make_harness()
+        key = store.add(
+            new_job(
+                workers=1,
+                restart_policy=RestartPolicy.EXIT_CODE,
+                elastic=ElasticPolicy(min_replicas=1, max_replicas=2, max_restarts=4),
+            )
+        )
+        rec.sync(key)
+        runner.set_all_running(key)
+        rec.sync(key)
+        # Worker finishes first (the leader lingers in finalize); the
+        # master is still RUNNING when the next pass looks at the gang.
+        runner.set_phase(
+            replica_name(key, ReplicaType.WORKER, 0),
+            ReplicaPhase.SUCCEEDED,
+            0,
+        )
+        rec.sync(key)
+        names = [h.name for h in runner.list_for_job(key)]
+        assert replica_name(key, ReplicaType.WORKER, 1) not in names
+        job = store.get(key)
+        assert job.status.restart_count == 0
+
+    def test_failover_replay_completes_resize_exactly_once(self, tmp_path):
+        """Supervisor crash mid-resize: the generation bump + resize
+        record committed, but the dead replica's record survived the
+        crash. The NEW owner re-observes the same death, finds it ⊆ the
+        record's ``handled`` set, and finishes the cleanup WITHOUT
+        minting a second generation (the exactly-once contract)."""
+        from pytorch_operator_tpu.controller import Reconciler as Rec
+
+        store = JobStore()
+        runner = FakeRunner()
+        events_a = EventRecorder()
+        rec_a = Rec(
+            store=store, runner=runner, events=events_a,
+            status_root=tmp_path / "status",
+        )
+        key = store.add(
+            new_job(
+                workers=2,
+                restart_policy=RestartPolicy.EXIT_CODE,
+                elastic=ElasticPolicy(min_replicas=1, max_replicas=3, max_restarts=5),
+            )
+        )
+        rec_a.sync(key)
+        runner.set_all_running(key)
+        rec_a.sync(key)
+        dead = replica_name(key, ReplicaType.WORKER, 1)
+        runner.set_phase(dead, ReplicaPhase.FAILED, 137)
+        rec_a.sync(key)
+        assert store.get(key).status.resize_generation == 1
+        # Crash aftermath: the dead record was NOT yet deleted when the
+        # old owner died — the failover owner's rescan re-adopts it.
+        job = store.get(key)
+        runner.create(
+            key, ReplicaType.WORKER, 1,
+            job.spec.replica_specs[ReplicaType.WORKER].template, {},
+        )
+        runner.set_phase(dead, ReplicaPhase.FAILED, 137)
+
+        events_b = EventRecorder()
+        rec_b = Rec(
+            store=store, runner=runner, events=events_b,
+            status_root=tmp_path / "status",
+        )
+        rec_b.sync(key)
+        job = store.get(key)
+        assert job.status.resize_generation == 1  # no second bump
+        assert job.status.restart_count == 0
+        assert runner.get(dead) is None  # cleanup completed
+        assert not any(
+            e.reason in ("ElasticScaledDown", "ElasticSparePromoted")
+            for e in events_b.for_job(key)
+        )
+
+    def test_master_loss_still_restarts_world(self):
+        """The coordinator is the rendezvous anchor: its death cannot be
+        absorbed by a resize — whole-world restart, as before."""
+        store, runner, _, _, rec = make_harness()
+        key = store.add(
+            new_job(
+                workers=2,
+                restart_policy=RestartPolicy.EXIT_CODE,
+                elastic=ElasticPolicy(min_replicas=1, max_replicas=4, max_restarts=5),
+            )
+        )
+        rec.sync(key)
+        runner.set_all_running(key)
+        rec.sync(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.MASTER, 0), ReplicaPhase.FAILED, 137
+        )
+        rec.sync(key)
+        job = store.get(key)
         assert job.has_condition(ConditionType.RESTARTING)
         assert job.status.restart_count == 1
+        assert job.status.resize_generation == 0
         # the WHOLE gang was torn down (elastic re-rendezvous)
         assert runner.list_for_job(key) == []
-        # next sync recreates all 4 with bumped restart count in env
+        # next sync recreates all 3 with bumped restart count in env
         rec.sync(key)
-        assert len(runner.list_for_job(key)) == 4
+        assert len(runner.list_for_job(key)) == 3
         env = runner.envs[replica_name(key, ReplicaType.MASTER, 0)]
         assert env["TPUJOB_RESTART_COUNT"] == "1"
+
+    def test_death_below_min_replicas_restarts_world(self):
+        """Survivors under min_replicas cannot form a legal world — the
+        classifier falls back to the whole-world restart path."""
+        store, runner, _, _, rec = make_harness()
+        key = store.add(
+            new_job(
+                workers=2,
+                restart_policy=RestartPolicy.EXIT_CODE,
+                elastic=ElasticPolicy(min_replicas=2, max_replicas=4, max_restarts=5),
+            )
+        )
+        rec.sync(key)
+        runner.set_all_running(key)
+        rec.sync(key)
+        runner.set_phase(
+            replica_name(key, ReplicaType.WORKER, 0), ReplicaPhase.FAILED, 137
+        )
+        rec.sync(key)
+        job = store.get(key)
+        assert job.has_condition(ConditionType.RESTARTING)
+        assert job.status.restart_count == 1
+        assert job.status.resize_generation == 0
+        assert "min_replicas" in job.get_condition(
+            ConditionType.RESTARTING
+        ).message
 
     def test_elastic_max_restarts_exceeded(self):
         store, runner, _, _, rec = make_harness()
