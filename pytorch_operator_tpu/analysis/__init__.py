@@ -1,0 +1,34 @@
+"""tpujob's project-native invariant checker (``tpujob
+verify-invariants``): stdlib-``ast`` static analysis that mechanizes
+the correctness contracts the control/data/serve planes were reviewed
+against. See :mod:`.rules` for the rule catalog and ARCHITECTURE.md
+("Static analysis & invariant catalog") for the operator view.
+"""
+
+from .baseline import Baseline, BaselineEntry, BaselineError, BaselineResult
+from .engine import (
+    AnalysisIO,
+    Report,
+    SourceModule,
+    analyze,
+    discover_sources,
+    run_verify,
+)
+from .findings import Finding, RawFinding, WAIVER_RE, scan_waivers
+
+__all__ = [
+    "AnalysisIO",
+    "Baseline",
+    "BaselineEntry",
+    "BaselineError",
+    "BaselineResult",
+    "Finding",
+    "RawFinding",
+    "Report",
+    "SourceModule",
+    "WAIVER_RE",
+    "analyze",
+    "discover_sources",
+    "run_verify",
+    "scan_waivers",
+]

@@ -1,0 +1,155 @@
+"""Baseline: the committed catalog of accepted findings.
+
+``analysis/baseline.json`` is a reviewed artifact, not a dumping
+ground: every entry MUST carry a non-empty ``justification`` string
+(load refuses entries without one), and an entry whose fingerprint no
+longer matches any current finding is reported STALE so it gets
+re-justified or deleted rather than silently inherited.
+
+Schema::
+
+    {
+      "version": 1,
+      "entries": [
+        {
+          "fingerprint": "0123456789abcdef",
+          "rule": "clock-discipline",
+          "location": "controller/leases.py:210",   # informational
+          "justification": "lease records cross process boundaries; ..."
+        },
+        ...
+      ]
+    }
+
+Matching is by fingerprint alone — ``location`` is a human breadcrumb
+that may drift as code moves without invalidating the entry.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from .findings import Finding
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (bad JSON, missing justification, ...)."""
+
+
+@dataclass
+class BaselineEntry:
+    fingerprint: str
+    rule: str
+    location: str
+    justification: str
+
+    def to_dict(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint,
+            "rule": self.rule,
+            "location": self.location,
+            "justification": self.justification,
+        }
+
+
+@dataclass
+class Baseline:
+    entries: List[BaselineEntry] = field(default_factory=list)
+    path: Optional[Path] = None
+
+    def by_fingerprint(self) -> Dict[str, BaselineEntry]:
+        return {e.fingerprint: e for e in self.entries}
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        try:
+            data = json.loads(path.read_text())
+        except json.JSONDecodeError as e:
+            raise BaselineError(f"{path}: not valid JSON: {e}") from e
+        if not isinstance(data, dict) or "entries" not in data:
+            raise BaselineError(f"{path}: expected an object with 'entries'")
+        entries: List[BaselineEntry] = []
+        for i, raw in enumerate(data["entries"]):
+            fp = raw.get("fingerprint", "")
+            just = raw.get("justification", "")
+            if not isinstance(fp, str) or not fp:
+                raise BaselineError(
+                    f"{path}: entry {i} has no fingerprint"
+                )
+            if not isinstance(just, str) or not just.strip():
+                raise BaselineError(
+                    f"{path}: entry {i} ({raw.get('location', fp)}) has "
+                    "no justification — every accepted finding must say "
+                    "why it is accepted"
+                )
+            entries.append(
+                BaselineEntry(
+                    fingerprint=fp,
+                    rule=str(raw.get("rule", "")),
+                    location=str(raw.get("location", "")),
+                    justification=just.strip(),
+                )
+            )
+        return cls(entries=entries, path=path)
+
+    def save(self, path: Path) -> None:
+        payload = {
+            "version": 1,
+            "entries": [
+                e.to_dict()
+                for e in sorted(
+                    self.entries, key=lambda e: (e.location, e.rule)
+                )
+            ],
+        }
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(payload, indent=2) + "\n")
+        tmp.replace(path)
+
+    # ------------------------------------------------------------------
+    def apply(self, findings: List[Finding]) -> "BaselineResult":
+        """Split findings into suppressed / unsuppressed and detect
+        stale entries."""
+        by_fp = self.by_fingerprint()
+        suppressed: List[Finding] = []
+        unsuppressed: List[Finding] = []
+        matched: set = set()
+        for f in findings:
+            entry = by_fp.get(f.fingerprint)
+            if entry is not None:
+                matched.add(f.fingerprint)
+                suppressed.append(f)
+            else:
+                unsuppressed.append(f)
+        stale = [e for e in self.entries if e.fingerprint not in matched]
+        return BaselineResult(suppressed, unsuppressed, stale)
+
+    @classmethod
+    def from_findings(
+        cls, findings: List[Finding], justification: str
+    ) -> "Baseline":
+        """A baseline accepting every given finding (used by
+        ``--write-baseline``; the operator then edits the per-entry
+        justifications before committing)."""
+        return cls(
+            entries=[
+                BaselineEntry(
+                    fingerprint=f.fingerprint,
+                    rule=f.rule,
+                    location=f.location(),
+                    justification=justification,
+                )
+                for f in findings
+            ]
+        )
+
+
+@dataclass
+class BaselineResult:
+    suppressed: List[Finding]
+    unsuppressed: List[Finding]
+    stale: List[BaselineEntry]

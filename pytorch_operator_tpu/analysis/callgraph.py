@@ -1,0 +1,219 @@
+"""A deliberately conservative static call graph for the invariant
+rules that need reachability (fenced-store-write) or inter-procedural
+lock tracking (lock-order).
+
+Resolution is NAME-BASED but narrow — precision beats recall here,
+because an over-approximated edge can manufacture a fake lock cycle:
+
+- ``self.foo(...)``          -> method ``foo`` of the enclosing class
+                                (same module; single-inheritance base
+                                in the same module is followed too)
+- ``self.attr.foo(...)``     -> method ``foo`` of the class that
+                                ``self.attr = ClassName(...)`` assigned
+                                in the SAME class (any method, usually
+                                ``__init__``) — the typed-attribute map
+- ``foo(...)``               -> module-level function ``foo`` in the
+                                same module
+- ``ClassName(...)``         -> ``ClassName.__init__`` when the class
+                                is in the analyzed set
+
+Anything else (``job.foo()``, imported callables, dynamic dispatch)
+resolves to nothing on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # "Class.method" or "func"
+    module: str  # module relpath
+    node: ast.AST  # FunctionDef / AsyncFunctionDef
+    class_name: Optional[str] = None
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str
+    bases: List[str] = field(default_factory=list)
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+    # self.<attr> = ClassName(...)  ->  {attr: ClassName}
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    # self.<attr> = threading.Lock()/RLock()/Condition()
+    lock_attrs: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class Program:
+    """The analyzed function/class universe across modules."""
+
+    functions: Dict[Tuple[str, str], FunctionInfo] = field(
+        default_factory=dict
+    )  # (module, qualname) -> info
+    classes: Dict[str, List[ClassInfo]] = field(
+        default_factory=dict
+    )  # class name -> infos (name collisions possible across modules)
+    module_funcs: Dict[Tuple[str, str], FunctionInfo] = field(
+        default_factory=dict
+    )  # (module, bare name) -> module-level function
+
+    def class_in_module(self, name: str, module: str) -> Optional[ClassInfo]:
+        for ci in self.classes.get(name, ()):
+            if ci.module == module:
+                return ci
+        infos = self.classes.get(name, [])
+        return infos[0] if len(infos) == 1 else None
+
+    def method_of(self, ci: ClassInfo, name: str) -> Optional[FunctionInfo]:
+        """Method lookup with single-level base-class fallback."""
+        fi = ci.methods.get(name)
+        if fi is not None:
+            return fi
+        for base in ci.bases:
+            bi = self.class_in_module(base, ci.module)
+            if bi is not None and name in bi.methods:
+                return bi.methods[name]
+        return None
+
+
+def _ctor_name(value: ast.AST) -> Optional[str]:
+    """``ClassName(...)`` / ``mod.ClassName(...)`` -> "ClassName"."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def build_program(modules) -> Program:
+    """``modules``: iterable of objects with ``.relpath`` and ``.tree``."""
+    prog = Program()
+    for mod in modules:
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FunctionInfo(node.name, mod.relpath, node)
+                prog.functions[(mod.relpath, node.name)] = fi
+                prog.module_funcs[(mod.relpath, node.name)] = fi
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(
+                    name=node.name,
+                    module=mod.relpath,
+                    bases=[
+                        b.id
+                        for b in node.bases
+                        if isinstance(b, ast.Name)
+                    ],
+                )
+                for item in node.body:
+                    if isinstance(
+                        item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        qual = f"{node.name}.{item.name}"
+                        fi = FunctionInfo(
+                            item.name, mod.relpath, item, node.name
+                        )
+                        fi.qualname = qual
+                        ci.methods[item.name] = fi
+                        prog.functions[(mod.relpath, qual)] = fi
+                    # self.<attr> = <ctor>() typing + lock attrs, from
+                    # every method (locks are usually made in __init__
+                    # but lazily-created ones count too).
+                for item in ast.walk(node):
+                    if not isinstance(item, ast.Assign):
+                        continue
+                    for tgt in item.targets:
+                        if not (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            continue
+                        ctor = _ctor_name(item.value)
+                        if ctor is None:
+                            continue
+                        if ctor in _LOCK_CTORS:
+                            ci.lock_attrs.add(tgt.attr)
+                        else:
+                            ci.attr_types.setdefault(tgt.attr, ctor)
+                prog.classes.setdefault(node.name, []).append(ci)
+    return prog
+
+
+def resolve_call(
+    call: ast.Call, caller: FunctionInfo, prog: Program
+) -> List[FunctionInfo]:
+    """The FunctionInfos a call MAY dispatch to (empty when unknown)."""
+    f = call.func
+    # foo(...) -> same-module function, or ClassName(...) -> __init__
+    if isinstance(f, ast.Name):
+        fi = prog.module_funcs.get((caller.module, f.id))
+        if fi is not None:
+            return [fi]
+        ci = prog.class_in_module(f.id, caller.module)
+        if ci is not None:
+            init = prog.method_of(ci, "__init__")
+            return [init] if init is not None else []
+        return []
+    if not isinstance(f, ast.Attribute):
+        return []
+    recv = f.value
+    # self.foo(...)
+    if isinstance(recv, ast.Name) and recv.id == "self":
+        if caller.class_name is None:
+            return []
+        ci = prog.class_in_module(caller.class_name, caller.module)
+        if ci is None:
+            return []
+        fi = prog.method_of(ci, f.attr)
+        return [fi] if fi is not None else []
+    # self.attr.foo(...) via the typed-attribute map
+    if (
+        isinstance(recv, ast.Attribute)
+        and isinstance(recv.value, ast.Name)
+        and recv.value.id == "self"
+        and caller.class_name is not None
+    ):
+        ci = prog.class_in_module(caller.class_name, caller.module)
+        if ci is None:
+            return []
+        tname = ci.attr_types.get(recv.attr)
+        if tname is None:
+            return []
+        ti = prog.class_in_module(tname, caller.module) or (
+            prog.classes.get(tname, [None])[0]
+        )
+        if ti is None:
+            return []
+        fi = prog.method_of(ti, f.attr)
+        return [fi] if fi is not None else []
+    return []
+
+
+def reachable_from(
+    seeds: List[FunctionInfo], prog: Program
+) -> Set[Tuple[str, str]]:
+    """Transitive closure of (module, qualname) over resolve_call."""
+    seen: Set[Tuple[str, str]] = set()
+    stack = list(seeds)
+    while stack:
+        fi = stack.pop()
+        key = (fi.module, fi.qualname)
+        if key in seen:
+            continue
+        seen.add(key)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                for callee in resolve_call(node, fi, prog):
+                    if (callee.module, callee.qualname) not in seen:
+                        stack.append(callee)
+    return seen

@@ -1,0 +1,229 @@
+"""The analysis engine: load sources, run rules, apply waivers and the
+baseline, render the report.
+
+The engine reads Python sources ONCE into in-memory
+:class:`SourceModule` objects (text + parsed tree + waiver map) and
+every rule works off those — the analyzer performs **zero state-dir
+I/O** and zero writes anywhere (``--write-baseline`` being the one
+explicit, operator-requested exception). ``AnalysisIO`` counts the
+reads so the bench lane can pin that contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from .baseline import Baseline, BaselineResult
+from .findings import (
+    Finding,
+    fingerprint_findings,
+    find_waiver,
+    scan_waivers,
+)
+from .rules import ProjectRule, Rule, iter_functions, module_rules, project_rules
+
+# Analyzed subtree roots, relative to the package root. Tests and
+# benches are excluded: they intentionally simulate the anti-patterns.
+DEFAULT_EXCLUDE = (
+    "analysis/*",  # the checker's own pattern tables would self-flag
+    "_vendor/*",
+)
+
+
+@dataclass
+class SourceModule:
+    """One parsed source file."""
+
+    relpath: str  # posix, relative to the analysis root
+    path: Path
+    text: str
+    tree: ast.Module
+    lines: List[str]
+    waivers: Dict[int, str]
+
+    @classmethod
+    def load(cls, root: Path, path: Path) -> "SourceModule":
+        text = path.read_text()
+        lines = text.splitlines()
+        return cls(
+            relpath=path.relative_to(root).as_posix(),
+            path=path,
+            text=text,
+            tree=ast.parse(text, filename=str(path)),
+            lines=lines,
+            waivers=scan_waivers(lines),
+        )
+
+
+@dataclass
+class AnalysisIO:
+    """I/O accounting: the analyzer must only ever READ sources."""
+
+    files_read: int = 0
+    files_written: int = 0
+    state_dir_touches: int = 0
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)  # all, incl. waived
+    result: Optional[BaselineResult] = None
+    io: AnalysisIO = field(default_factory=AnalysisIO)
+    modules_scanned: int = 0
+
+    @property
+    def unsuppressed(self) -> List[Finding]:
+        if self.result is not None:
+            return self.result.unsuppressed
+        return [f for f in self.findings if not f.waived]
+
+    @property
+    def stale_entries(self):
+        return self.result.stale if self.result is not None else []
+
+    def exit_code(self) -> int:
+        return 1 if self.unsuppressed else 0
+
+    # ------------------------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "modules_scanned": self.modules_scanned,
+                "total_findings": len(self.findings),
+                "waived": sum(1 for f in self.findings if f.waived),
+                "suppressed": len(self.result.suppressed)
+                if self.result
+                else 0,
+                "unsuppressed": [f.to_dict() for f in self.unsuppressed],
+                "stale_baseline_entries": [
+                    e.to_dict() for e in self.stale_entries
+                ],
+                "io": {
+                    "files_read": self.io.files_read,
+                    "files_written": self.io.files_written,
+                    "state_dir_touches": self.io.state_dir_touches,
+                },
+            },
+            indent=2,
+        )
+
+    def render_text(self) -> str:
+        out: List[str] = []
+        for f in sorted(
+            self.unsuppressed, key=lambda f: (f.path, f.line, f.rule)
+        ):
+            out.append(f"{f.location()}: [{f.rule}] {f.message}")
+            out.append(f"    fingerprint: {f.fingerprint}")
+        for e in self.stale_entries:
+            out.append(
+                f"STALE baseline entry [{e.rule}] {e.location} "
+                f"({e.fingerprint}): flagged code changed or disappeared "
+                "— re-justify or delete the entry"
+            )
+        waived = sum(1 for f in self.findings if f.waived)
+        suppressed = len(self.result.suppressed) if self.result else 0
+        out.append(
+            f"verify-invariants: {self.modules_scanned} modules, "
+            f"{len(self.findings)} findings "
+            f"({waived} waived inline, {suppressed} baseline-suppressed, "
+            f"{len(self.unsuppressed)} unsuppressed)"
+        )
+        return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+
+
+def discover_sources(root: Path, exclude: Sequence[str] = DEFAULT_EXCLUDE):
+    out: List[Path] = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if any(fnmatch.fnmatch(rel, pat) for pat in exclude):
+            continue
+        out.append(path)
+    return out
+
+
+def _qualname_at(mod: SourceModule, line: int) -> str:
+    """Innermost enclosing function qualname for a line ("" = module)."""
+    best = ""
+    best_span = None
+    for qual, fn in iter_functions(mod.tree):
+        end = fn.end_lineno or fn.lineno
+        if fn.lineno <= line <= end:
+            span = end - fn.lineno
+            if best_span is None or span <= best_span:
+                best, best_span = qual, span
+    return best
+
+
+def analyze(
+    root: Path,
+    *,
+    exclude: Sequence[str] = DEFAULT_EXCLUDE,
+    rules: Optional[Sequence[Rule]] = None,
+    proj_rules: Optional[Sequence[ProjectRule]] = None,
+) -> Report:
+    """Run every rule over the package rooted at ``root``."""
+    report = Report()
+    mods: List[SourceModule] = []
+    for path in discover_sources(root, exclude):
+        mods.append(SourceModule.load(root, path))
+        report.io.files_read += 1
+    report.modules_scanned = len(mods)
+
+    findings: List[Finding] = []
+
+    def attach(mod: SourceModule, rule_id: str, raw) -> None:
+        f = Finding(
+            rule=rule_id,
+            path=mod.relpath,
+            line=raw.line,
+            message=raw.message,
+            qualname=_qualname_at(mod, raw.line),
+        )
+        reason = find_waiver(mod.waivers, raw.line, raw.span)
+        if reason is not None:
+            f.waived = True
+            f.waive_reason = reason
+        findings.append(f)
+
+    for rule in rules if rules is not None else module_rules():
+        for mod in mods:
+            if not rule.scope(mod.relpath):
+                continue
+            for raw in rule.run(mod):
+                attach(mod, rule.id, raw)
+
+    for prule in proj_rules if proj_rules is not None else project_rules():
+        for mod, raw in prule.run(mods):
+            attach(mod, prule.id, raw)
+
+    fingerprint_findings(
+        findings, {m.relpath: m.lines for m in mods}
+    )
+    report.findings = findings
+    return report
+
+
+def run_verify(
+    root: Path,
+    baseline_path: Optional[Path] = None,
+    *,
+    exclude: Sequence[str] = DEFAULT_EXCLUDE,
+) -> Report:
+    """The full verify-invariants pass: analyze + waivers + baseline."""
+    report = analyze(root, exclude=exclude)
+    active = [f for f in report.findings if not f.waived]
+    if baseline_path is not None and baseline_path.exists():
+        bl = Baseline.load(baseline_path)
+        report.io.files_read += 1
+        report.result = bl.apply(active)
+    else:
+        report.result = BaselineResult([], active, [])
+    return report

@@ -1,0 +1,134 @@
+"""Finding model for the invariant checker.
+
+A finding is (rule, file, line, message) plus a FINGERPRINT — a stable
+content hash that survives unrelated edits elsewhere in the file. The
+fingerprint is what ``analysis/baseline.json`` suppresses by, so a
+baseline entry keeps suppressing its site as surrounding code moves,
+and goes STALE (warning) the moment the flagged code itself changes or
+disappears — the reviewer re-justifies or deletes it, never inherits
+it blindly.
+
+Fingerprint inputs, in order of stability intent:
+
+- rule id (a site may be accepted for one invariant, not all),
+- module path relative to the analysis root,
+- the enclosing function's qualname (``Class.method`` — so two
+  identical lines in different functions don't collide, and a line
+  move WITHIN a function doesn't invalidate),
+- the flagged source line with all whitespace removed,
+- an ordinal among same-(rule, path, qualname, line-text) findings —
+  last-resort disambiguation for truly identical sites.
+
+Waivers: a site can be accepted inline instead of via the baseline
+with a tag comment the analyzer recognizes::
+
+    except Exception:
+        pass  # invariant: waived — telemetry must never kill the step loop
+
+The tag must carry a non-empty reason after the dash. It is honored on
+the flagged line, the line directly above it, or (for region-shaped
+findings like an ``except`` handler) anywhere in the finding's span.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+WAIVER_RE = re.compile(
+    r"#\s*invariant:\s*waived\s*(?:—|–|--|-)\s*(?P<reason>\S.*?)\s*$"
+)
+
+
+@dataclass
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str
+    path: str  # posix path relative to the analysis root
+    line: int  # 1-based
+    message: str
+    qualname: str = ""  # enclosing function ("" = module scope)
+    fingerprint: str = ""
+    waived: bool = False
+    waive_reason: str = ""
+    # Baseline suppression is recorded by the engine, not stored here.
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "qualname": self.qualname,
+            "fingerprint": self.fingerprint,
+            "waived": self.waived,
+            "waive_reason": self.waive_reason,
+        }
+
+
+@dataclass
+class RawFinding:
+    """What a rule emits before the engine attaches fingerprints and
+    waiver state. ``span`` widens the waiver search window beyond the
+    single flagged line (an ``except`` handler body, a ``with`` block)."""
+
+    line: int
+    message: str
+    span: Optional[Tuple[int, int]] = None  # inclusive (start, end) lines
+
+
+def scan_waivers(lines: List[str]) -> Dict[int, str]:
+    """line (1-based) -> waiver reason, for every tagged line."""
+    out: Dict[int, str] = {}
+    for i, text in enumerate(lines, start=1):
+        m = WAIVER_RE.search(text)
+        if m:
+            out[i] = m.group("reason")
+    return out
+
+
+def find_waiver(
+    waivers: Dict[int, str],
+    line: int,
+    span: Optional[Tuple[int, int]] = None,
+) -> Optional[str]:
+    """The waiver reason covering a finding, or None. Checked: the
+    flagged line, the line above it, then every line of ``span``."""
+    for cand in (line, line - 1):
+        if cand in waivers:
+            return waivers[cand]
+    if span is not None:
+        for cand in range(span[0], span[1] + 1):
+            if cand in waivers:
+                return waivers[cand]
+    return None
+
+
+def _norm(line_text: str) -> str:
+    return "".join(line_text.split())
+
+
+def fingerprint_findings(
+    findings: List[Finding], lines_by_path: Dict[str, List[str]]
+) -> None:
+    """Attach fingerprints in place. Ordinals are assigned in (path,
+    line) order among identical (rule, path, qualname, normalized
+    line text) tuples, so the Nth identical site keeps the Nth
+    fingerprint as long as the earlier ones survive."""
+    seen: Dict[Tuple[str, str, str, str], int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        lines = lines_by_path.get(f.path, [])
+        text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        key = (f.rule, f.path, f.qualname, _norm(text))
+        ordinal = seen.get(key, 0)
+        seen[key] = ordinal + 1
+        h = hashlib.blake2b(
+            "|".join((*key, str(ordinal))).encode(), digest_size=8
+        ).hexdigest()
+        f.fingerprint = h

@@ -1,0 +1,288 @@
+"""lock-order: the static lock-acquisition graph.
+
+Two failure shapes are checked across the threaded planes
+(``controller/supervisor.py``, ``controller/leases.py``,
+``checkpoint/async_writer.py``, ``data/device_prefetch.py`` — plus any
+module that defines a lock attribute):
+
+1. **Cyclic acquisition order.** For every ``with self.a: ...
+   with self.b:`` nesting (directly, or through one resolvable call
+   while ``a`` is held) an edge ``a -> b`` is recorded, keyed by
+   (class, attr). A cycle in that graph means two threads can acquire
+   the same pair in opposite orders and deadlock.
+
+2. **Blocking under a lock.** A call that can block indefinitely on
+   the outside world — ``subprocess.*``, ``Popen``, ``.wait()``,
+   ``.join()``, ``select``, ``sleep`` of non-trivial duration — while
+   a lock is held starves every other thread that needs the lock (the
+   renewal thread missing its TTL is the canonical casualty). Checked
+   in the lock-holding function itself and one resolvable call deep —
+   deliberately not transitively, so deep by-design orchestration
+   (reconciler's per-key spawn pipeline) stays out of scope while a
+   direct ``Popen`` under ``self._lock`` is flagged.
+
+Lock identity is name-based: a ``with`` item whose expression source
+matches ``/lock|_cv|cond/i`` or resolves to a known lock attribute
+(``self.x = threading.Lock()``). ``Condition.wait`` is exempt from the
+blocking check — releasing the lock while waiting is its whole point.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from . import callgraph
+from .findings import RawFinding
+from .rules import ProjectRule, _call_name, _src
+
+_LOCKY = re.compile(r"(lock|_cv\b|cond)", re.IGNORECASE)
+
+# Calls that block on the outside world. Substring match on the dotted
+# call name; kept short so lock-protected in-memory work never trips it.
+_BLOCKING = (
+    "subprocess.",
+    "Popen",
+    "check_call",
+    "check_output",
+    "communicate",
+    "sleep",
+    "select.select",
+)
+_BLOCKING_ATTRS = {"wait", "join", "communicate"}
+# .wait()/.join() receivers that are fine: Condition.wait under its own
+# lock, and Event.wait with a timeout is typically a paced poll.
+_WAIT_EXEMPT_RECV = re.compile(r"(_cv|cond|event|_ev\b|stop)", re.IGNORECASE)
+
+
+def _lock_key(mod, item: ast.withitem, caller) -> Optional[str]:
+    """Stable identity for an acquired lock, or None if not a lock.
+
+    ``self._lock`` in class C -> ``C._lock`` so the same attribute seen
+    from two methods is one node, while unrelated classes' ``_lock``
+    attrs stay distinct.
+    """
+    expr = item.context_expr
+    # Condition/Lock used via acquire-helper calls are not `with` items;
+    # we only model `with`-scoped acquisition (the repo's idiom).
+    src = _src(mod, expr)
+    e = expr
+    if isinstance(e, ast.Call):  # with self.key_lock(key): ...
+        e = e.func
+        src = _src(mod, e)
+    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) and (
+        e.value.id == "self"
+    ):
+        if caller is not None and caller.class_name:
+            if _LOCKY.search(e.attr) or _is_known_lock_attr(
+                mod, caller, e.attr
+            ):
+                return f"{caller.class_name}.{e.attr}"
+        return f"?.{e.attr}" if _LOCKY.search(e.attr) else None
+    if _LOCKY.search(src):
+        return f"{mod.relpath}:{src}"
+    return None
+
+
+def _is_known_lock_attr(mod, caller, attr: str) -> bool:
+    prog = getattr(mod, "_prog", None)
+    if prog is None or caller.class_name is None:
+        return False
+    ci = prog.class_in_module(caller.class_name, caller.module)
+    return ci is not None and attr in ci.lock_attrs
+
+
+def _blocking_calls(mod, fn: ast.AST) -> List[Tuple[int, str]]:
+    """(line, description) for every blocking call directly in fn,
+    ignoring nested defs."""
+    out: List[Tuple[int, str]] = []
+    for node in _own_body(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if any(b in name for b in _BLOCKING):
+            if name.endswith("sleep") and _tiny_sleep(node):
+                continue
+            out.append((node.lineno, name))
+            continue
+        if isinstance(node.func, ast.Attribute) and (
+            node.func.attr in _BLOCKING_ATTRS
+        ):
+            recv = _src(mod, node.func.value)
+            if _WAIT_EXEMPT_RECV.search(recv):
+                continue
+            if node.args or any(k.arg == "timeout" for k in node.keywords):
+                continue  # bounded wait
+            out.append((node.lineno, f"{recv}.{node.func.attr}()"))
+    return out
+
+
+def _tiny_sleep(node: ast.Call) -> bool:
+    if node.args and isinstance(node.args[0], ast.Constant):
+        v = node.args[0].value
+        return isinstance(v, (int, float)) and v <= 0.2
+    return False
+
+
+def _own_body(fn: ast.AST):
+    """Walk a function body without descending into nested defs."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class LockOrder(ProjectRule):
+    id = "lock-order"
+    summary = (
+        "lock acquisition must be acyclic, and no lock may be held "
+        "across blocking I/O or subprocess calls"
+    )
+
+    SCOPE_PREFIXES = ("controller/", "checkpoint/", "data/", "serving/", "obs/")
+
+    def run(self, mods) -> Iterator[tuple]:
+        in_scope = [
+            m for m in mods if m.relpath.startswith(self.SCOPE_PREFIXES)
+        ]
+        prog = callgraph.build_program(in_scope)
+        for m in in_scope:
+            m._prog = prog  # for _is_known_lock_attr
+        by_rel = {m.relpath: m for m in in_scope}
+
+        # locks each function acquires at its top `with` level, and
+        # what happens while held.
+        edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+        held_findings: List[tuple] = []
+
+        for (module, qualname), fi in prog.functions.items():
+            mod = by_rel[module]
+            self._scan_fn(mod, fi, prog, by_rel, edges, held_findings)
+
+        yield from held_findings
+
+        # Cycle detection over the acquisition edges (only class-attr
+        # keys — path-keyed locals can't deadlock across threads the
+        # same way and would add noise).
+        graph: Dict[str, Set[str]] = {}
+        where: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for (a, b), sites in edges.items():
+            graph.setdefault(a, set()).add(b)
+            where[(a, b)] = sites[0]
+        for cyc in self._cycles(graph):
+            a, b = cyc[0], cyc[1 % len(cyc)]
+            module, line = where.get((a, b), ("", 0))
+            mod = by_rel.get(module)
+            if mod is None:
+                continue
+            yield mod, RawFinding(
+                line,
+                "cyclic lock acquisition order: "
+                + " -> ".join(cyc + [cyc[0]])
+                + " — two threads taking these in opposite orders "
+                "deadlock; impose a single global order",
+            )
+
+    # ------------------------------------------------------------------
+    def _scan_fn(self, mod, fi, prog, by_rel, edges, held_findings):
+        """Walk fi recording (outer lock -> inner lock) edges and
+        blocking-while-held findings."""
+
+        def walk(node, held: List[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    acquired = []
+                    for item in child.items:
+                        key = _lock_key(mod, item, fi)
+                        if key is None:
+                            continue
+                        for outer in held:
+                            if outer != key:
+                                edges.setdefault((outer, key), []).append(
+                                    (mod.relpath, child.lineno)
+                                )
+                        acquired.append(key)
+                    if acquired:
+                        self._check_held(
+                            mod, fi, child, held + acquired, prog, by_rel,
+                            held_findings,
+                        )
+                    walk(child, held + acquired)
+                else:
+                    walk(child, held)
+
+        walk(fi.node, [])
+
+    def _check_held(
+        self, mod, fi, with_node, held, prog, by_rel, held_findings
+    ):
+        """Blocking calls inside this with-block: direct, plus one
+        resolvable call deep."""
+        reported: Set[Tuple[str, int]] = set()
+
+        def report(target_mod, line, desc, via=""):
+            if (target_mod.relpath, line) in reported:
+                return
+            reported.add((target_mod.relpath, line))
+            suffix = f" (reached via {via})" if via else ""
+            held_findings.append(
+                (
+                    target_mod,
+                    RawFinding(
+                        line,
+                        f"blocking call {desc} while holding "
+                        f"{', '.join(held)}{suffix} — a stalled child "
+                        "starves every thread waiting on the lock; move "
+                        "the blocking work outside the critical section",
+                    ),
+                )
+            )
+
+        # direct blocking calls in the with body
+        body_fn = ast.Module(body=with_node.body, type_ignores=[])
+        for line, desc in _blocking_calls(mod, body_fn):
+            report(mod, line, desc)
+        # one level of callees
+        for node in _own_body(body_fn):
+            if not isinstance(node, ast.Call):
+                continue
+            for callee in callgraph.resolve_call(node, fi, prog):
+                cmod = by_rel.get(callee.module)
+                if cmod is None:
+                    continue
+                for line, desc in _blocking_calls(cmod, callee.node):
+                    report(cmod, line, desc, via=f"{fi.qualname} -> "
+                           f"{callee.qualname}")
+
+    @staticmethod
+    def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+        """Simple cycles (as node lists) via DFS; deduplicated by the
+        sorted node set so each cycle reports once."""
+        out: List[List[str]] = []
+        seen_sets: Set[frozenset] = set()
+
+        def dfs(start, node, path, onpath):
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    key = frozenset(path)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        out.append(list(path))
+                elif nxt not in onpath and len(path) < 6:
+                    path.append(nxt)
+                    onpath.add(nxt)
+                    dfs(start, nxt, path, onpath)
+                    onpath.discard(nxt)
+                    path.pop()
+
+        for start in sorted(graph):
+            dfs(start, start, [start], {start})
+        return out

@@ -311,6 +311,7 @@ class AsyncCheckpointWriter:
             try:
                 self._on_error(step, e)
             except Exception:
+                # invariant: waived — a broken error-callback must not mask the original write failure being reported
                 pass
 
     def _retire(self) -> None:
@@ -388,7 +389,8 @@ class AsyncCheckpointWriter:
                     try:
                         self._on_commit(*args)
                     except Exception:
-                        pass  # telemetry must never fail a commit
+                        # invariant: waived — telemetry must never fail a committed checkpoint
+                        pass
             except BaseException as e:  # noqa: BLE001 — a failed commit
                 # must never take the commit thread (and with it every
                 # queued save) down; the failure is recorded and the

@@ -181,12 +181,14 @@ def corrupt_step(root, step: int, *, mode: str = "flip") -> Path:
     victim = max(files, key=lambda p: (p.stat().st_size, str(p)))
     data = bytearray(victim.read_bytes())
     if mode == "truncate":
+        # invariant: waived — deliberate in-place corruption; this simulator exists to defeat atomicity
         victim.write_bytes(bytes(data[: len(data) // 2]))
     elif mode == "flip":
         if not data:
             data = bytearray(b"\xff")
         else:
             data[len(data) // 2] ^= 0xFF
+        # invariant: waived — deliberate in-place corruption; this simulator exists to defeat atomicity
         victim.write_bytes(bytes(data))
     else:
         raise ValueError(f"unknown corruption mode {mode!r}")

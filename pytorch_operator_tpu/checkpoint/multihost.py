@@ -134,6 +134,7 @@ class CommitBarrier:
                             missing=sorted(missing),
                         )
                     except Exception:
+                        # invariant: waived — a broken telemetry hook must not mask the BarrierTimeout raised below
                         pass
                 raise BarrierTimeout(
                     f"commit barrier {phase}-{step}: processes "
@@ -213,6 +214,7 @@ def make_multihost_commit(
                 try:
                     on_abort(step)
                 except Exception:
+                    # invariant: waived — abort-callback failure must not mask the original commit failure re-raised below
                     pass
             raise
         if barrier.is_primary:
@@ -237,6 +239,7 @@ def make_multihost_commit(
                 try:
                     report("ckpt_commit_ack", step=step, process=process_id)
                 except Exception:
+                    # invariant: waived — the ack is telemetry; the commit itself is already durable
                     pass
 
     return commit

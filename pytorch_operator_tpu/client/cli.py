@@ -150,7 +150,10 @@ def _run_foreground(args, fault_plan=None, chaos: bool = False) -> int:
                 f"fault plan armed: {fault_plan.summary()}",
             )
         printed = 0
-        deadline = None if args.timeout is None else time.time() + args.timeout
+        # monotonic: the foreground wait budget must not move with NTP.
+        deadline = (
+            None if args.timeout is None else time.monotonic() + args.timeout
+        )
         while True:
             if fault_plan is not None:
                 # The daemon's sync_once runs this hook; the foreground
@@ -166,7 +169,7 @@ def _run_foreground(args, fault_plan=None, chaos: bool = False) -> int:
             j = sup.get(key)
             if j is None or j.is_finished():
                 break
-            if deadline is not None and time.time() > deadline:
+            if deadline is not None and time.monotonic() > deadline:
                 print(f"error: timeout after {args.timeout}s", file=sys.stderr)
                 sup.delete_job(key)
                 return 3
@@ -810,7 +813,9 @@ def cmd_top(args) -> int:
         tty.setcbreak(fd)
         deadline = 0.0
         while True:
-            now = time.time()
+            # monotonic: repaint pacing is pure interval math; an NTP
+            # step would freeze or spin the TUI.
+            now = time.monotonic()
             if now >= deadline:
                 paint(True)
                 deadline = now + args.interval
@@ -1391,6 +1396,43 @@ def cmd_manifests(args) -> int:
     return crdgen.main(argv)
 
 
+def cmd_verify_invariants(args) -> int:
+    """Static invariant checker (analysis/): AST rules over the package,
+    gated on zero unsuppressed findings. Tier-1 runs this via
+    tests/test_static_analysis.py; the CLI verb is for operators and
+    pre-commit use."""
+    from pytorch_operator_tpu import analysis
+
+    pkg_root = Path(analysis.__file__).resolve().parent.parent
+    root = Path(args.root).resolve() if args.root else pkg_root
+    baseline = (
+        Path(args.baseline)
+        if args.baseline
+        else root / "analysis" / "baseline.json"
+    )
+    try:
+        report = analysis.run_verify(root, baseline)
+    except analysis.BaselineError as e:
+        print(f"verify-invariants: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        bl = analysis.Baseline.from_findings(
+            report.unsuppressed, justification="TODO: justify or fix"
+        )
+        bl.save(baseline)
+        print(
+            f"wrote {len(bl.entries)} entries to {baseline} — edit every "
+            "justification before committing",
+            file=sys.stderr,
+        )
+        return 0
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render_text())
+    return report.exit_code()
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpujob", description="TPU-native distributed training jobs"
@@ -1849,6 +1891,33 @@ def build_parser() -> argparse.ArgumentParser:
         help="write the full artifact here (e.g. BENCH_elastic.json)",
     )
     sp.set_defaults(func=cmd_bench_elastic)
+
+    sp = sub.add_parser(
+        "verify-invariants",
+        help="run the static invariant checker (atomic-state-write, "
+        "fenced-store-write, lock-order, swallowed-exception, "
+        "retry-discipline, clock-discipline) over the package; exit 1 "
+        "on any unsuppressed finding",
+    )
+    sp.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    sp.add_argument(
+        "--baseline", default=None,
+        help="baseline file of accepted findings "
+        "(default: <root>/analysis/baseline.json)",
+    )
+    sp.add_argument(
+        "--root", default=None,
+        help="package root to analyze (default: the installed "
+        "pytorch_operator_tpu package)",
+    )
+    sp.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept every current unsuppressed finding into the "
+        "baseline (justifications must then be edited by hand)",
+    )
+    sp.set_defaults(func=cmd_verify_invariants)
 
     sp = sub.add_parser(
         "serve-request",

@@ -94,6 +94,7 @@ class LeaderLease:
                 ):
                     os.close(fd)
                     return False
+                # invariant: waived — 50ms flock contention poll, deadline-bounded above; no herd (one writer wins)
                 time.sleep(0.05)
         # Record the holder for observability (healthz, error messages).
         # Any failure here must release + close the locked fd: leaking it

@@ -1107,7 +1107,14 @@ class Reconciler:
             from ..checkpoint.integrity import latest_verified_step
 
             return latest_verified_step(ckpt_dir)
-        except Exception:
+        except Exception as e:
+            # Probe failure must be visible: a resize that silently sees
+            # "no verified checkpoint" restarts the world from step 0.
+            self.events.warning(
+                key, "CheckpointProbeFailed",
+                f"could not determine last verified step under "
+                f"{ckpt_dir}: {e}",
+            )
             return None
 
     def _ensure_resize_record(self, job: TPUJob, key: str, handles) -> None:

@@ -634,13 +634,19 @@ class SubprocessRunner(ProcessRunner):
                     log_path=rec.get("log_path"),
                     slots=int(rec.get("slots", 1)),
                 )
-            except Exception:
+            except Exception as e:
                 # A corrupt/foreign-schema record must not brick every
-                # supervisor start; quarantine it and move on.
+                # supervisor start; quarantine it — loudly, so an
+                # operator learns replicas went untracked — and move on.
+                print(
+                    f"[tpujob] quarantining corrupt replica record "
+                    f"{rec_file.name}: {e}",
+                    file=sys.stderr,
+                )
                 try:
                     rec_file.replace(rec_file.with_suffix(".json.corrupt"))
                 except OSError:
-                    pass
+                    pass  # invariant: waived — quarantine rename is best-effort; the parse failure was already reported
                 continue
             pid_start = rec.get("pid_start")
             self._pid_starts[h.name] = pid_start
@@ -1037,8 +1043,11 @@ class SubprocessRunner(ProcessRunner):
         waiting = set(pgids)
         if not waiting:
             return
-        deadline = time.time() + grace_seconds
-        while waiting and time.time() < deadline:
+        # monotonic: a clock step during teardown must not skip the
+        # grace period (SIGKILL lands on a checkpoint-flushing child) or
+        # extend it indefinitely.
+        deadline = time.monotonic() + grace_seconds
+        while waiting and time.monotonic() < deadline:
             waiting &= _live_pgids()
             if not waiting:
                 return
@@ -1048,8 +1057,8 @@ class SubprocessRunner(ProcessRunner):
                 os.killpg(pgid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 waiting.discard(pgid)
-        kill_deadline = time.time() + 2.0
-        while waiting and time.time() < kill_deadline:
+        kill_deadline = time.monotonic() + 2.0
+        while waiting and time.monotonic() < kill_deadline:
             waiting &= _live_pgids()
             time.sleep(0.05)
 

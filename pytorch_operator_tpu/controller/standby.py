@@ -99,7 +99,8 @@ def _run_assignment(spec: dict) -> int:
             try:
                 jax.config.update(cfg_key, _coerce(cfg_key, env[env_key]))
             except Exception:
-                pass  # unknown option on this jax version: env route only
+                # invariant: waived — unknown option on this jax version; the env-var route still applies it
+                pass
     # Route all output to the replica's log file (kubectl-logs analog) —
     # fd-level dup2 so subprocesses and C extensions follow too.
     log_fd = os.open(
@@ -170,6 +171,7 @@ def main(argv=None) -> int:
             try:
                 spec = json.loads(assign.read_text())
             except (OSError, ValueError):
+                # invariant: waived — 10ms paced re-read of an assign file caught mid-rename, not a retry loop
                 time.sleep(0.01)
                 continue
             try:
@@ -281,7 +283,10 @@ class StandbyPool:
                     if not was_ready:
                         self._fail_streak += 1
                         delay = min(60.0, 2.0 ** min(self._fail_streak, 6))
-                        self._not_before = time.time() + delay
+                        # monotonic: an NTP step must not collapse the
+                        # crash-loop holdoff (respawn storm) or stretch
+                        # it (pool stays empty for minutes).
+                        self._not_before = time.monotonic() + delay
                         print(
                             f"[standby] {sid} died (exit {proc.returncode}) "
                             f"before READY — backing off {delay:.0f}s "
@@ -292,7 +297,7 @@ class StandbyPool:
                 (self.dir / f"{sid}.ready").exists() for sid in self._procs
             ):
                 self._fail_streak = 0
-            if time.time() < self._not_before:
+            if time.monotonic() < self._not_before:
                 return
             # Bounded: a persistent spawn failure (fork limit, ENOMEM)
             # must not busy-loop under the pool lock — try once per
@@ -341,8 +346,11 @@ class StandbyPool:
         except OSError:
             self.kill(sid, proc)
             return False
-        deadline = time.time() + self.ACK_TIMEOUT_S
-        while time.time() < deadline:
+        # monotonic: the ACK window is a within-process budget; a clock
+        # step here would either kill a healthy standby mid-claim or
+        # stall assignment on a dead one.
+        deadline = time.monotonic() + self.ACK_TIMEOUT_S
+        while time.monotonic() < deadline:
             if claimed.exists():
                 claimed.unlink(missing_ok=True)
                 # The sid leaves the pool here: drop its ready marker AND

@@ -290,6 +290,7 @@ class JobStore:
                 # so the next cross-process reader exercises the
                 # corrupt-state-file recovery path above. The clean form
                 # is NOT recorded: the next persist must rewrite.
+                # invariant: waived — deliberate torn write; the fault exists to defeat the atomic discipline
                 path.write_text(text[: len(text) // 2])
                 self.io.writes += 1
                 return

@@ -1321,7 +1321,10 @@ class Supervisor:
         also reconcile jobs owned by a daemon sharing the state dir (two
         supervisors spawning duplicate worlds for the same job).
         """
-        deadline = None if timeout is None else time.time() + timeout
+        # monotonic: an NTP step while a caller waits must not stretch
+        # (job hangs past its timeout) or collapse (spurious TimeoutError
+        # on a healthy job) the budget.
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             self.reconciler.sync(key)
             job = self.store.get(key)
@@ -1329,7 +1332,7 @@ class Supervisor:
                 raise KeyError(f"job {key} disappeared (TTL GC or deletion)")
             if job.is_finished():
                 return job
-            if deadline is not None and time.time() > deadline:
+            if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"job {key} did not finish within {timeout}s")
             time.sleep(self.poll_interval)
 
@@ -1418,8 +1421,15 @@ class Supervisor:
         return self.state_dir / f"metrics-{safe}.prom"
 
     def write_metrics_file(self) -> None:
-        """Expose counters for ``tpujob metrics`` (monitoring-port analog)."""
-        self.metrics_file_path().write_text(self.metrics.render_text())
+        """Expose counters for ``tpujob metrics`` (monitoring-port analog).
+
+        tmp+replace: ``tpujob top`` polls this file on a timer and must
+        never read a half-rendered exposition page.
+        """
+        path = self.metrics_file_path()
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_text(self.metrics.render_text())
+        tmp.replace(path)
 
     def shutdown(self) -> None:
         with self._sync_pool_lock:

@@ -273,6 +273,26 @@ def validate_against_job(plan: "FaultPlan", job) -> List[str]:
             replica_ids.append((rtype.value, index))
     warnings: List[str] = []
     for f in plan.faults:
+        if f.kind == "kill_storm":
+            # A storm SIGKILLs up to ``times`` distinct replicas; a
+            # ``times`` beyond what the target can ever match (including
+            # "*" = the whole gang) is a plan aimed at a bigger job.
+            matchable = sum(
+                1
+                for rtype, index in replica_ids
+                if f.target == "*"
+                or FaultInjector.target_matches(f.target, rtype, index)
+            )
+            if f.times > matchable:
+                have = ", ".join(
+                    f"{rt.lower()}-{i}" for rt, i in replica_ids[:8]
+                ) or "<no replicas>"
+                warnings.append(
+                    f"{f.label()}: times={f.times} exceeds the "
+                    f"{matchable} replica(s) target {f.target!r} can "
+                    f"match on {key} (spec declares: {have}); the storm "
+                    "cannot reach its advertised width."
+                )
         if (
             f.kind in UNTARGETED_KINDS
             or f.kind in SUPERVISOR_TARGET_KINDS

@@ -106,14 +106,17 @@ def write_probe(status_dir, now: float) -> Optional[int]:
     """Best-effort probe-file rewrite (supervisor side); returns the
     probe's ``seq`` (the writer remembers it and accepts only echoes of
     seqs it wrote — a stale echo observed by a restarted daemon would
-    otherwise contribute a garbage round trip). A tiny atomic-enough
-    single write; replicas tolerate torn reads by JSON parse failure."""
+    otherwise contribute a garbage round trip). tmp+replace so a torn
+    probe is never readable — replicas would echo its garbage ts back
+    into skew accounting before JSON parse failure could save them."""
     if status_dir is None:
         return None
     p = Path(status_dir) / PROBE_FILE
     seq = int(now * 1e6)
     try:
-        p.write_text(json.dumps({"probe_ts": round(now, 6), "seq": seq}))
+        tmp = p.with_suffix(p.suffix + ".tmp")
+        tmp.write_text(json.dumps({"probe_ts": round(now, 6), "seq": seq}))
+        tmp.replace(p)
     except OSError:
         return None
     return seq

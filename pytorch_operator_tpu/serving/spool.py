@@ -86,8 +86,10 @@ class Spool:
     def wait_response(self, request_id: str, timeout: float = 60.0) -> dict:
         """Poll for the response record; raises TimeoutError."""
         path = self.responses / f"{request_id}.json"
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        # monotonic: the poll budget is a within-process interval; a
+        # clock step must not time out a request that is still cooking.
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if path.exists():
                 return json.loads(path.read_text())
             time.sleep(0.02)
@@ -219,6 +221,7 @@ class Spool:
         same cadence the store sweeps ITS stale tmps. Returns how many
         were removed."""
         n = 0
+        # invariant: waived — compared against st_mtime of files other processes wrote; wall clock is the shared axis
         cutoff = time.time() - max_age_s
         for d in (self.requests, self.claimed, self.responses):
             try:

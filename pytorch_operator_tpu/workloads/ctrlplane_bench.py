@@ -281,8 +281,8 @@ def bench_sharded(
     try:
         # ---- settle: tick until the fair-share split is stable ----
         t_settle0 = time.perf_counter()
-        deadline = time.time() + max(10 * lease_ttl, 20.0)
-        while time.time() < deadline:
+        deadline = time.monotonic() + max(10 * lease_ttl, 20.0)
+        while time.monotonic() < deadline:
             for sup in sups:
                 _daemon_pass(sup)
             owned = [len(sup.shards.owned) for sup in sups]

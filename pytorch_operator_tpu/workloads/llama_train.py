@@ -286,6 +286,7 @@ def run(
             try:
                 drain()
             except Exception:
+                # invariant: waived — best-effort drain on resize; a broken loader must not block the world exit
                 pass
         rendezvous.exit_for_resize(sig)
 

@@ -196,9 +196,9 @@ def bench_cell(
         # serve beats land in the status dir) — the idle_timeout clock
         # starts inside the replica loop, so arrivals must not lag it.
         status_dir = Path(state_dir) / "status" / key_to_fs(key)
-        launch_deadline = time.time() + 90.0
+        launch_deadline = time.monotonic() + 90.0
         ready = False
-        while time.time() < launch_deadline:
+        while time.monotonic() < launch_deadline:
             active = [h for h in sup.runner.list_for_job(key) if h.is_active()]
             reported = (
                 len(list(status_dir.glob("*.jsonl")))
@@ -259,8 +259,8 @@ def bench_cell(
 
         # ---- collect: EVERY submit gets exactly one response ----
         pending = set(rids)
-        collect_deadline = time.time() + deadline_s + max(30.0, 4 * duration)
-        while pending and time.time() < collect_deadline:
+        collect_deadline = time.monotonic() + deadline_s + max(30.0, 4 * duration)
+        while pending and time.monotonic() < collect_deadline:
             done = []
             for rid in pending:
                 resp = front.read_response(rid)
@@ -280,9 +280,9 @@ def bench_cell(
         stats.duplicates = len(files - set(rids))
 
         # ---- teardown: replicas idle out, master succeeds ----
-        finish_deadline = time.time() + idle_timeout + 60.0
+        finish_deadline = time.monotonic() + idle_timeout + 60.0
         finished = False
-        while time.time() < finish_deadline:
+        while time.monotonic() < finish_deadline:
             j = sup.store.get(key)
             if j is not None and j.is_finished():
                 finished = True

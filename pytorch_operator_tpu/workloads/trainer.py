@@ -644,7 +644,8 @@ def heartbeat_reporter(report_progress, *, batch=None, n_dev=1, unit=None,
                     "feed_stall_ms_recent", s["feed_stall_ms_avg"]
                 )
             except Exception:
-                pass  # telemetry must never kill the step loop
+                # invariant: waived — feed-stall telemetry must never kill the step loop
+                pass
         report_progress(
             step,
             loss=loss,

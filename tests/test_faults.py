@@ -179,6 +179,61 @@ class TestPlanValidation:
         )
         assert self._validate(plan, new_job(workers=0)) == []
 
+    # ---- PR-11 kinds: preempt_replica / kill_storm ----
+
+    def test_preempt_replica_out_of_range_warns(self):
+        plan = FaultPlan(
+            faults=[Fault(kind="preempt_replica", target="worker-5", at=1)]
+        )
+        warnings = self._validate(plan, new_job(workers=2))
+        assert len(warnings) == 1
+        assert "worker-5" in warnings[0]
+
+    def test_preempt_replica_in_range_is_clean(self):
+        plan = FaultPlan(
+            faults=[Fault(kind="preempt_replica", target="worker-1", at=1)]
+        )
+        assert self._validate(plan, new_job(workers=2)) == []
+
+    def test_kill_storm_times_beyond_gang_warns_even_for_star(self):
+        # workers=2 + 1 master = 3 replicas; a width-8 storm on "*" can
+        # never reach its advertised width.
+        plan = FaultPlan(
+            faults=[Fault(kind="kill_storm", target="*", at=1, times=8)]
+        )
+        warnings = self._validate(plan, new_job(workers=2))
+        assert len(warnings) == 1
+        assert "times=8" in warnings[0]
+
+    def test_kill_storm_times_counts_only_matching_replicas(self):
+        plan = FaultPlan(
+            faults=[
+                Fault(kind="kill_storm", target="worker-*", at=1, times=3)
+            ]
+        )
+        warnings = self._validate(plan, new_job(workers=2))
+        assert len(warnings) == 1
+        assert "worker-*" in warnings[0]
+
+    def test_kill_storm_within_gang_is_clean(self):
+        plan = FaultPlan(
+            faults=[Fault(kind="kill_storm", target="*", at=1, times=3)]
+        )
+        assert self._validate(plan, new_job(workers=2)) == []
+
+    def test_kill_storm_counts_elastic_max_replicas(self):
+        from pytorch_operator_tpu.api.types import ElasticPolicy
+
+        job = new_job(
+            workers=2,
+            elastic=ElasticPolicy(min_replicas=1, max_replicas=4),
+        )
+        # 4 elastic workers + 1 master: width 5 is reachable post-grow.
+        plan = FaultPlan(
+            faults=[Fault(kind="kill_storm", target="*", at=1, times=5)]
+        )
+        assert self._validate(plan, job) == []
+
     def test_chaos_cli_prints_the_warning(self, tmp_path, capsys):
         """`tpujob chaos` surfaces the lint on stderr before running."""
         from pytorch_operator_tpu.client import cli
