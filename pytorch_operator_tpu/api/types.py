@@ -644,6 +644,18 @@ class ServingPolicy:
     # default layout: <state>/serve/<ns>_<job>/front.
     spool_dir: Optional[str] = None
     slo: Optional[ServingSLOPolicy] = None
+    # Router↔engine transport tier (serving/shmring.py). "spool" (the
+    # default) keeps every request on the durable file path; "shmring"
+    # adds per-replica shared-memory rings for co-host traffic, with
+    # the file spool as the automatic spill (ring full) and cross-host
+    # path — durability semantics are identical either way, because
+    # the front spool's respond_once is the exactly-once point.
+    transport: str = "spool"
+    # 0 = the router data plane rides the supervisor sync pass (legacy,
+    # single-threaded). N >= 1 = N continuously-running router shard
+    # workers partitioned by request hash — the serve-plane analog of
+    # the N-supervisor lease split.
+    router_shards: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -651,6 +663,10 @@ class ServingPolicy:
             d["spool_dir"] = self.spool_dir
         if self.slo is not None and (s := self.slo.to_dict()):
             d["slo"] = s
+        if self.transport != "spool":
+            d["transport"] = self.transport
+        if self.router_shards:
+            d["router_shards"] = self.router_shards
         return d
 
     @classmethod
@@ -663,6 +679,8 @@ class ServingPolicy:
                 if d.get("slo") is not None
                 else None
             ),
+            transport=str(d.get("transport", "spool") or "spool"),
+            router_shards=int(d.get("router_shards", 0) or 0),
         )
 
 

@@ -151,6 +151,18 @@ def validate_spec(spec: TPUJobSpec) -> List[str]:
 
     if spec.serving is not None:
         sv = spec.serving
+        if sv.transport not in ("spool", "shmring"):
+            errs.append(
+                "spec.serving.transport: must be 'spool' or 'shmring' "
+                f"(got {sv.transport!r})"
+            )
+        if sv.router_shards < 0:
+            errs.append("spec.serving.router_shards: must be >= 0")
+        if sv.router_shards > 64:
+            errs.append(
+                "spec.serving.router_shards: must be <= 64 (each shard "
+                "is a live router thread)"
+            )
         if sv.slo is not None:
             slo = sv.slo
             if slo.max_queue_depth < 0:

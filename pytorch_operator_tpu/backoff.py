@@ -15,6 +15,7 @@ decorrelate real workers.
 from __future__ import annotations
 
 import hashlib
+import math
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Tuple, Type
@@ -32,7 +33,19 @@ class Backoff:
     seed: int = 0
 
     def delay(self, attempt: int) -> float:
-        d = min(self.cap_s, self.base_s * self.factor ** max(0, attempt))
+        attempt = max(0, attempt)
+        exp = attempt
+        if self.factor > 1.0 and self.base_s > 0:
+            # Clamp the exponent at the cap crossover: past it the
+            # un-jittered delay is cap_s regardless, and an unbounded
+            # attempt counter (an idle poll loop running for hours)
+            # would overflow float pow. Jitter still hashes the REAL
+            # attempt, so capped delays stay decorrelated.
+            limit = math.log(
+                max(self.cap_s, self.base_s) / self.base_s
+            ) / math.log(self.factor)
+            exp = min(exp, int(limit) + 1)
+        d = min(self.cap_s, self.base_s * self.factor ** exp)
         if self.jitter:
             h = hashlib.blake2b(
                 f"{self.seed}:{attempt}".encode(), digest_size=8

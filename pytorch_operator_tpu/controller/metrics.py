@@ -402,7 +402,8 @@ class MetricsRegistry:
                 "when no serving jobs exist)",
             )
             for k in ("ticks", "front_scans", "dispatches", "publishes",
-                      "sweeps")
+                      "sweeps", "ring_sends", "ring_recvs", "ring_spills",
+                      "shard_passes")
         }
 
     def counter(self, name: str, help_text: str = "") -> Counter:

@@ -238,7 +238,7 @@ class Supervisor:
         from ..serving.router import ServeRouter
 
         self.router = ServeRouter(self.state_dir, metrics=self.metrics)
-        self._router_io_seen = self.router.io.snapshot()
+        self._router_io_seen = self.router.io_snapshot()
         # Serving jobs whose end-of-life drain already ran (the drain
         # scans the front spool — once, not every pass).
         self._serve_finalized: set = set()
@@ -986,7 +986,7 @@ class Supervisor:
             if delta:
                 counter.inc(delta)
         self._progress_io_seen = cur
-        cur = self.router.io.snapshot()
+        cur = self.router.io_snapshot()
         for k, counter in m.router_io.items():
             delta = cur[k] - self._router_io_seen.get(k, 0)
             if delta:
@@ -1438,6 +1438,7 @@ class Supervisor:
             pool.shutdown(wait=True)
         if isinstance(self.runner, SubprocessRunner):
             self.runner.shutdown()
+        self.router.close()
         if self.shards is not None:
             # Voluntary drain: hand every shard back NOW so survivors
             # rebalance immediately instead of waiting out the TTL.

@@ -146,6 +146,9 @@ def build_cluster_env(
         import json as _json
 
         env["TPUJOB_SERVING"] = _json.dumps(sv.to_dict(), sort_keys=True)
+        # The transport tier rides its own var so the engine loop can
+        # gate ring-attach on one string compare, no JSON parse.
+        env["TPUJOB_SERVE_TRANSPORT"] = sv.transport
     # Data-plane policy (spec.data_plane): workloads read these as the
     # defaults for --async-checkpoint / --prefetch, so host-I/O overlap
     # is a SPEC property, not per-workload args plumbing.

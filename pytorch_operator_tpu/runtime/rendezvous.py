@@ -470,6 +470,7 @@ def report_serve(
     ttft_ms_p99: Optional[float] = None,
     tpot_ms_p50: Optional[float] = None,
     tpot_ms_p99: Optional[float] = None,
+    block_ms: Optional[float] = None,
 ) -> None:
     """Serve-plane load beat: slot occupancy, queue depth, and latency
     percentiles for this engine replica. The supervisor's router
@@ -488,6 +489,10 @@ def report_serve(
         ("ttft_ms_p99", ttft_ms_p99),
         ("tpot_ms_p50", tpot_ms_p50),
         ("tpot_ms_p99", tpot_ms_p99),
+        # Decode-block phase: ms until the engine's current decode
+        # block completes and a batch slot can actually be filled —
+        # the router's continuous-batching dispatch tie-breaker.
+        ("block_ms", block_ms),
     ):
         if v is not None:
             fields[k] = round(float(v), 3)

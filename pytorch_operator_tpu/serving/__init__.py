@@ -5,15 +5,22 @@ training operator's long-running reconciled workload).
   decode-block boundaries, per-row positions, chunked prefill, latency
   accounting (TTFT / per-token percentiles).
 - :mod:`spool` — file-based request/response IPC (this environment has
-  no network; local spool directories are the transport).
+  no network; local spool directories are the transport), with batched
+  ``.jsonb`` framing so a burst costs one fsync, not N.
+- :mod:`shmring` — the memory-speed tier: mmap'd SPSC rings between
+  the router and co-host engines, file spool as the durable spill and
+  cross-host path.
 - :mod:`router` — the supervisor-hosted serve-plane router: front-spool
-  admission control (:mod:`slo`) + least-loaded dispatch across the
-  job's replica spools with bounded retry-on-replica-death.
+  admission control (:mod:`slo`) + continuous-batching-aware dispatch
+  across the job's replica spools/rings with bounded
+  retry-on-replica-death; optionally sharded onto N worker threads
+  (``spec.serving.router_shards``).
 - :mod:`slo` — admission decisions and per-request SLO accounting
   shared by the router and the serve-plane bench.
 """
 
 from .engine import Request, RequestResult, ServingEngine  # noqa: F401
 from .router import ServeRouter  # noqa: F401
+from .shmring import EngineTransport, ShmRing  # noqa: F401
 from .slo import SLO, SLOStats  # noqa: F401
-from .spool import Spool  # noqa: F401
+from .spool import Spool, make_request  # noqa: F401

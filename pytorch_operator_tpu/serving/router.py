@@ -13,16 +13,20 @@ None`` check per job when no serving jobs exist):
    ``TPUJOB_SPOOL_DIR`` (runtime/env.py).
 2. **Load tracking** — per-replica live load comes from the ``serve``
    telemetry records the heartbeat fold already tails (slots free,
-   queue depth, p99 per-token latency — zero extra I/O), corrected by
-   the router's own in-flight accounting for dispatches newer than the
-   last telemetry beat.
+   queue depth, decode-block phase, p99 per-token latency — zero extra
+   I/O), corrected by the router's own in-flight accounting for
+   dispatches newer than the last telemetry beat.
 3. **Admission** — every front-queue claim is judged by
    ``spec.serving.slo`` (serving/slo.py): over-depth or past-deadline
    requests are SHED with an explicit overload response instead of
    queueing unboundedly.
-4. **Dispatch** — admitted requests go to the least-loaded alive
-   replica's spool, record verbatim (the client's ``submit_time``
-   rides along, so engine TTFT stays client-perceived).
+4. **Dispatch** — admitted requests go to the replica whose batch the
+   request best FILLS (continuous-batching-aware: smallest positive
+   slot headroom first, decode-block phase as tie-break), over the
+   fastest transport available: the shm ring pair when
+   ``spec.serving.transport == "shmring"`` and the replica is co-host
+   (serving/shmring.py), spilling to the file spool when the ring is
+   full or absent. The file spool is always the durable floor.
 5. **Retry-on-death** — an in-flight request whose replica died is
    pulled back (best-effort cancel from the dead replica's spool) and
    re-enqueued on the shared ``backoff.py`` schedule, at most
@@ -38,6 +42,22 @@ None`` check per job when no serving jobs exist):
    watch, and ``tpujob why`` all see the serve plane through the
    channels they already read.
 
+**Sharding** (``spec.serving.router_shards >= 1``): the data plane
+moves off the supervisor pass onto N continuously-running worker
+threads — the same scale-out shape as the PR-7 N-supervisor lease
+split, but in-process. Every request id hashes to exactly one shard
+(``crc32(rid) % N``), every replica to exactly one collector shard
+(``crc32(stem) % N``); a shard that claims or collects a record it
+does not own hands it to the owner's inbox, so each request has ONE
+owner for admission, dispatch, retry and publication — exactly-once
+re-adoption on shard handoff included, because the hash map is
+derived from the id, not from which thread touched it first. Each
+shard keeps its own :class:`RouterIOCounters`; ``tick`` still runs
+per pass but only refreshes the shared snapshots (alive set,
+telemetry, SLO) and emits the surface. ``router_shards == 0`` (the
+default) keeps the legacy single-threaded tick-driven data plane —
+one lane, zero threads, byte-for-byte the old behavior.
+
 Router restart is a non-event: front ``claimed/`` entries without a
 front response are re-adopted on the first tick (checked against every
 alive replica's spool before re-dispatch), and ``respond_once``
@@ -47,26 +67,37 @@ guarantees the client still sees exactly one response.
 from __future__ import annotations
 
 import json
+import sys
+import threading
 import time
 import zlib
+from collections import deque
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..backoff import Backoff
 from .slo import ADMIT, SHED_DEADLINE, SLO, overload_response
+from .shmring import RouterRingPort
 from .spool import Spool
 
-# Front-claim bound per tick: keeps one pass O(batch) even when a
+# Front-claim bound per pass: keeps one pass O(batch) even when a
 # client floods the spool; the rest is claimed next pass (and judged
 # against the deadline then — aging in requests/ still counts).
 CLAIM_BATCH = 256
 # Stale-tmp GC cadence — the store's stale-tmp sweep cadence, applied
-# to the spool dirs the router owns.
+# to the spool dirs the router owns. Aged response files get a longer
+# leash (clients poll for them).
 SWEEP_EVERY_S = 30.0
+RESPONSE_TTL_S = 600.0
 # serve status-record cadence (router.jsonl — the watch/why sample
 # stream; sub-second would just burn tail bytes).
 REPORT_EVERY_S = 1.0
+# Shard-worker idle schedule: a pass that moved nothing backs off the
+# next one (ring polls are mmap reads — free — but the front-spool
+# claim is a real scandir; the cap bounds idle scan rate at ~4/s).
+SHARD_IDLE_BACKOFF = Backoff(base_s=0.001, cap_s=0.25, factor=2.0,
+                             jitter=0.1)
 
 
 def serve_root_dir(state_dir) -> Path:
@@ -104,20 +135,30 @@ def replica_spool_dir(
     )
 
 
+def shard_of(token: str, n: int) -> int:
+    """The one owner of a request id (or replica stem) among ``n``
+    lanes — crc32, the same stable hash the PR-7 supervisor shards use,
+    so ownership survives restarts and is derivable by anyone."""
+    if n <= 1:
+        return 0
+    return zlib.crc32(token.encode()) % n
+
+
 class RouterIOCounters:
-    """Per-router work accounting, mirrored onto ``/metrics`` like the
+    """Per-lane work accounting, mirrored onto ``/metrics`` like the
     tailer's — the serve plane's zero-idle-overhead pin reads these
     (all zero when no serving jobs exist, because tick is never
-    called)."""
+    called). Sharded routers keep one per shard;
+    ``ServeRouter.io_snapshot`` sums them."""
 
-    __slots__ = ("ticks", "front_scans", "dispatches", "publishes", "sweeps")
+    __slots__ = (
+        "ticks", "front_scans", "dispatches", "publishes", "sweeps",
+        "ring_sends", "ring_recvs", "ring_spills", "shard_passes",
+    )
 
     def __init__(self) -> None:
-        self.ticks = 0
-        self.front_scans = 0
-        self.dispatches = 0
-        self.publishes = 0
-        self.sweeps = 0
+        for k in self.__slots__:
+            setattr(self, k, 0)
 
     def snapshot(self) -> dict:
         return {k: getattr(self, k) for k in self.__slots__}
@@ -134,24 +175,63 @@ class _Inflight:
     # undispatched (fresh admit, retry-pending, or no replica alive).
     replica: Optional[str] = None
     attempts: int = 0  # dispatches so far
-    retry_at: float = 0.0  # backoff gate for the next dispatch
+    retry_at: float = 0.0  # backoff gate (monotonic) for the next dispatch
     first_dispatch: Optional[float] = None  # queue-wait endpoint
     recovered: bool = False  # re-adopted after a router restart
+    via_ring: bool = False  # last dispatch rode the ring tier
 
 
 @dataclass
-class _JobState:
-    front: Spool
-    backoff: Backoff
+class _Lane:
+    """One exactly-once ownership domain: a hash shard in sharded
+    mode, the whole job in legacy mode. All mutable routing state
+    (inflight, counters) is lane-private — cross-lane traffic moves
+    through the inbox deques (thread-safe append/popleft), never by
+    touching another lane's dicts."""
+
+    index: int
     inflight: Dict[str, _Inflight] = field(default_factory=dict)
+    io: RouterIOCounters = field(default_factory=RouterIOCounters)
+    # Records claimed (or ring-collected) by another lane, owned here.
+    inbox: Deque[dict] = field(default_factory=deque)
+    resp_inbox: Deque[Tuple[str, dict]] = field(default_factory=deque)
+    outstanding: Dict[str, int] = field(default_factory=dict)
     routed: int = 0
     shed: int = 0
     ok: int = 0
     errors: int = 0
     rerouted: int = 0
     dup_avoided: int = 0
+
+
+@dataclass
+class _JobState:
+    front: Spool
+    backoff: Backoff
+    lanes: List[_Lane]
+    transport: str = "spool"
+    # Snapshots the tick swaps wholesale (atomic reference assignment);
+    # shard workers read them without locks.
+    alive: Dict[str, Spool] = field(default_factory=dict)
+    by_replica: dict = field(default_factory=dict)
+    slo: Optional[SLO] = None
+    # Ring ports by replica stem. Mutated only under ``lock``; pushes
+    # are serialized per stem by ``ring_locks`` (the ring is SPSC
+    # across processes; in-process producers take the lock).
+    rings: Dict[str, RouterRingPort] = field(default_factory=dict)
+    ring_locks: Dict[str, threading.Lock] = field(default_factory=dict)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    # Guards every front-spool call (claim/respond/release bookkeeping
+    # is per-Spool-instance state; the instance is shared by lanes).
+    front_lock: threading.RLock = field(default_factory=threading.RLock)
+    stop: threading.Event = field(default_factory=threading.Event)
+    workers: List[threading.Thread] = field(default_factory=list)
     last_sweep: float = 0.0
     last_report: float = 0.0
+
+    @property
+    def inflight_total(self) -> int:
+        return sum(len(lane.inflight) for lane in self.lanes)
 
 
 class ServeRouter:
@@ -162,20 +242,35 @@ class ServeRouter:
         self._jobs: Dict[str, _JobState] = {}
         self.io = RouterIOCounters()
 
+    def io_snapshot(self) -> dict:
+        """Totals across the router's own counters and every lane's —
+        the ``/metrics`` fold and the bench read this one number set
+        regardless of shard count."""
+        tot = self.io.snapshot()
+        for st in self._jobs.values():
+            for lane in st.lanes:
+                for k, v in lane.io.snapshot().items():
+                    tot[k] += v
+        return tot
+
     # ---- lifecycle ----
 
     def _state(self, key: str, job) -> _JobState:
         st = self._jobs.get(key)
         if st is None:
+            serving = job.spec.serving
+            n_lanes = max(1, int(getattr(serving, "router_shards", 0) or 0))
             st = _JobState(
                 front=Spool(
-                    front_spool_dir(self.serve_root, key, job.spec.serving)
+                    front_spool_dir(self.serve_root, key, serving)
                 ),
                 # Deterministic per-job jitter seed: a replayed chaos
                 # run re-routes on the identical schedule.
                 backoff=Backoff(
                     base_s=0.05, cap_s=2.0, seed=zlib.crc32(key.encode())
                 ),
+                lanes=[_Lane(i) for i in range(n_lanes)],
+                transport=str(getattr(serving, "transport", "") or "spool"),
             )
             self._jobs[key] = st
             self._recover(st)
@@ -184,14 +279,24 @@ class ServeRouter:
     def _recover(self, st: _JobState) -> None:
         """Router-restart adoption: a front claim without a front
         response is a request a previous router life was answering —
-        it is ours again now. Dispatch state is re-derived against the
-        live replica spools on the next tick (``recovered`` flag)."""
+        it is ours again now, assigned to its hash-owner lane (a
+        restart with a different shard count is just a handoff: the
+        hash map decides, so no two lanes ever adopt the same rid).
+        Dispatch state is re-derived against the live replica spools
+        on the next pass (``recovered`` flag)."""
         try:
             claims = sorted(st.front.claimed.iterdir())
         except FileNotFoundError:
             return
+        n = len(st.lanes)
         for p in claims:
-            if p.suffix != ".json":
+            if p.suffix not in (".json", ".jsonb"):
+                continue
+            if p.suffix == ".jsonb":
+                # A batch the previous life claimed: push it back to
+                # requests/ (recovered-marked); the normal claim path
+                # re-admits each record with response dedup.
+                st.front.recover_claimed()
                 continue
             rid = p.stem
             if st.front.has_response(rid):
@@ -202,15 +307,46 @@ class ServeRouter:
             except (OSError, json.JSONDecodeError):
                 st.front.respond(rid, {"id": rid, "error": "torn request"})
                 continue
-            st.inflight[rid] = _Inflight(
+            st.lanes[shard_of(rid, n)].inflight[rid] = _Inflight(
                 rec=rec,
                 rid=rid,
                 submit_time=float(rec.get("submit_time", 0.0)),
                 recovered=True,
             )
 
+    def _stop_workers(self, st: _JobState) -> None:
+        if not st.workers:
+            return
+        st.stop.set()
+        for t in st.workers:
+            t.join(timeout=5.0)
+        st.workers = []
+
+    def _close_rings(self, st: _JobState) -> None:
+        with st.lock:
+            ports, st.rings = dict(st.rings), {}
+            st.ring_locks = {}
+        for port in ports.values():
+            port.close()
+
     def retire_job(self, key: str) -> None:
-        self._jobs.pop(key, None)
+        st = self._jobs.pop(key, None)
+        if st is not None:
+            self._stop_workers(st)
+            self._close_rings(st)
+            # Keep the totals monotonic: the retired job's lane work
+            # folds into the router-level counters.
+            for lane in st.lanes:
+                for k, v in lane.io.snapshot().items():
+                    setattr(self.io, k, getattr(self.io, k) + v)
+
+    def close(self) -> None:
+        """Supervisor shutdown: quiesce every job's shard workers and
+        unmap the rings (the ring FILES stay — a successor router
+        re-attaches and in-flight records survive)."""
+        for st in self._jobs.values():
+            self._stop_workers(st)
+            self._close_rings(st)
 
     def finalize(self, key: str, job, reason: str = "job finished") -> None:
         """End-of-life drain: every outstanding request — in flight or
@@ -222,26 +358,48 @@ class ServeRouter:
             if job is None or job.spec.serving is None:
                 return
             st = self._state(key, job)
-        for f in list(st.inflight.values()):
-            resp = self._replica_response(key, f)
-            if resp is not None:
-                self._publish(key, st, f, resp)
-                continue
-            if st.front.respond_once(
-                f.rid, {"id": f.rid, "error": reason, "attempts": f.attempts}
-            ):
-                st.errors += 1
-            st.inflight.pop(f.rid, None)
+        self._stop_workers(st)
+        for lane in st.lanes:
+            # Ring responses that beat the shutdown still count.
+            self._drain_resp_inbox(key, st, lane)
+            for f in list(lane.inflight.values()):
+                resp = self._replica_response(key, f)
+                if resp is not None:
+                    self._publish(key, st, lane, f, resp)
+                    continue
+                with st.front_lock:
+                    won = st.front.respond_once(
+                        f.rid,
+                        {"id": f.rid, "error": reason,
+                         "attempts": f.attempts},
+                    )
+                if won:
+                    lane.errors += 1
+                lane.inflight.pop(f.rid, None)
+            for rec in list(lane.inbox):
+                rid = rec.get("id")
+                if rid:
+                    with st.front_lock:
+                        if st.front.respond_once(
+                            rid, {"id": rid, "error": reason}
+                        ):
+                            lane.errors += 1
+            lane.inbox.clear()
+        lane0 = st.lanes[0]
         while True:
-            recs = st.front.claim(CLAIM_BATCH)
+            with st.front_lock:
+                recs = st.front.claim(CLAIM_BATCH)
             if not recs:
                 break
             for rec in recs:
                 rid = rec.get("id")
-                if rid and st.front.respond_once(
-                    rid, {"id": rid, "error": reason}
-                ):
-                    st.errors += 1
+                if rid:
+                    with st.front_lock:
+                        if st.front.respond_once(
+                            rid, {"id": rid, "error": reason}
+                        ):
+                            lane0.errors += 1
+        self._close_rings(st)
 
     # ---- the per-pass tick ----
 
@@ -255,11 +413,15 @@ class ServeRouter:
         now: Optional[float] = None,
     ) -> dict:
         """One routing pass for one serving job; returns the pass
-        summary (also folded into gauges when a registry is wired)."""
+        summary (also folded into gauges when a registry is wired).
+
+        Legacy mode (``router_shards == 0``) runs the whole data plane
+        inline. Sharded mode refreshes the snapshots the workers read
+        and leaves the data plane to them."""
         now = time.time() if now is None else now
         self.io.ticks += 1
         st = self._state(key, job)
-        slo = SLO.from_policy(job.spec.serving)
+        st.slo = SLO.from_policy(job.spec.serving)
 
         # Alive replica set, stem -> spool (the handle index is the
         # same truth reconcile acts on; no second discovery mechanism).
@@ -273,45 +435,62 @@ class ServeRouter:
                     self.serve_root, key, h.replica_type.value, h.index
                 )
             )
+        st.alive = alive
+        st.by_replica = by_replica
+
+        if st.transport == "shmring":
+            self._reconcile_rings(st, alive)
 
         if now - st.last_sweep > SWEEP_EVERY_S:
             st.last_sweep = now
             self.io.sweeps += 1
-            st.front.sweep_stale(SWEEP_EVERY_S)
+            with st.front_lock:
+                st.front.sweep_stale(
+                    SWEEP_EVERY_S, response_ttl_s=RESPONSE_TTL_S
+                )
             for sp in alive.values():
                 sp.sweep_stale(SWEEP_EVERY_S)
 
-        self._collect_responses(key, st, now)
-        self._handle_deaths(key, st, slo, alive, now)
-        self._admit(key, st, slo, now)
-        self._dispatch(key, st, slo, alive, by_replica, now)
+        sharded = len(st.workers) > 0 or self._wants_shards(job)
+        if sharded:
+            self._ensure_workers(key, st, job)
+        else:
+            lane = st.lanes[0]
+            self._lane_pass(key, st, lane, now=now)
 
         # ---- surface ----
-        self.io.front_scans += 1
-        queue_depth = st.front.pending_count() + sum(
-            1 for f in st.inflight.values() if f.replica is None
+        with st.front_lock:
+            pending = st.front.pending_count()
+        queue_depth = pending + sum(
+            1
+            for lane in st.lanes
+            for f in lane.inflight.values()
+            if f.replica is None
         )
         slots_free = 0.0
         for stem in alive:
             tele = (by_replica.get(stem) or {}).get("serve")
             if tele and tele.get("slots_free") is not None:
                 slots_free += float(tele["slots_free"])
+        inflight_total = st.inflight_total
         summary = {
             "queue_depth": queue_depth,
-            "inflight": len(st.inflight),
+            "inflight": inflight_total,
             "replicas": len(alive),
             "slots_free": slots_free,
-            "routed": st.routed,
-            "shed": st.shed,
-            "ok": st.ok,
-            "errors": st.errors,
-            "rerouted": st.rerouted,
-            "dup_avoided": st.dup_avoided,
+            "shards": len(st.workers),
+            "transport": st.transport,
+            "routed": sum(l.routed for l in st.lanes),
+            "shed": sum(l.shed for l in st.lanes),
+            "ok": sum(l.ok for l in st.lanes),
+            "errors": sum(l.errors for l in st.lanes),
+            "rerouted": sum(l.rerouted for l in st.lanes),
+            "dup_avoided": sum(l.dup_avoided for l in st.lanes),
         }
         m = self.metrics
         if m is not None:
             m.job_serve_queue_depth.set(queue_depth, job=key)
-            m.job_serve_inflight.set(len(st.inflight), job=key)
+            m.job_serve_inflight.set(inflight_total, job=key)
             m.job_serve_replicas.set(len(alive), job=key)
             m.job_serve_slots_free.set(slots_free, job=key)
         if now - st.last_report > REPORT_EVERY_S:
@@ -319,26 +498,126 @@ class ServeRouter:
             self._report(status_dir, now, summary)
         return summary
 
-    # ---- tick phases ----
+    # ---- sharded data plane ----
 
-    def _replica_response(self, key: str, f: _Inflight) -> Optional[dict]:
-        """The replica-side response for an in-flight request, if the
-        engine has published one (dead replicas included — a response
-        written just before the kill still counts)."""
-        if f.replica is None:
-            return None
-        rt, _, idx = f.replica.rpartition("-")
+    def _wants_shards(self, job) -> bool:
+        return int(
+            getattr(job.spec.serving, "router_shards", 0) or 0
+        ) >= 1
+
+    def _ensure_workers(self, key: str, st: _JobState, job) -> None:
+        if st.workers or st.stop.is_set():
+            return
+        for lane in st.lanes:
+            t = threading.Thread(
+                target=self._worker_loop,
+                args=(key, st, lane),
+                name=f"serve-router-{lane.index}",
+                daemon=True,
+            )
+            st.workers.append(t)
+            t.start()
+
+    def _worker_loop(self, key: str, st: _JobState, lane: _Lane) -> None:
+        idle = 0
+        while not st.stop.is_set():
+            try:
+                moved = self._lane_pass(key, st, lane)
+            except Exception as e:  # noqa: BLE001 — a lane must never die
+                # A failed pass is survivable (the next one runs against
+                # fresh snapshots) but never silent: the supervisor log
+                # carries it, and the idle backoff bounds the spam.
+                moved = 0
+                print(
+                    f"[router] {key} lane {lane.index} pass failed: {e!r}",
+                    file=sys.stderr,
+                )
+            lane.io.shard_passes += 1
+            if moved:
+                idle = 0
+                continue
+            idle += 1
+            st.stop.wait(SHARD_IDLE_BACKOFF.delay(idle - 1))
+
+    def _lane_pass(
+        self, key: str, st: _JobState, lane: _Lane,
+        now: Optional[float] = None,
+    ) -> int:
+        """One full data-plane pass for one lane; returns how much it
+        moved (the shard idle-backoff signal). Wall clock is used ONLY
+        for the SLO axis (client submit_time crosses process
+        boundaries); every router-internal gate is monotonic."""
+        now = time.time() if now is None else now
+        moved = 0
+        moved += self._collect_responses(key, st, lane)
+        moved += self._handle_deaths(key, st, lane)
+        moved += self._admit(key, st, lane, now)
+        moved += self._dispatch(key, st, lane, now)
+        return moved
+
+    # ---- transport plumbing ----
+
+    def _reconcile_rings(self, st: _JobState, alive: Dict[str, Spool]) -> None:
+        """Ring ports follow the alive set: a new replica gets a ring
+        pair created in its spool dir (the engine attaches when the
+        files appear); a dead replica's response ring is drained one
+        final time (a response pushed just before the kill still
+        counts) and the port unmapped. Ring files persist on disk, so
+        a restarted replica — or router — re-attaches to the same
+        cursors and nothing in flight is lost."""
+        n = len(st.lanes)
+        for stem, sp in alive.items():
+            if stem in st.rings:
+                continue
+            try:
+                port = RouterRingPort(sp.root)
+            except (OSError, ValueError):
+                continue
+            with st.lock:
+                if stem in st.rings:
+                    port.close()
+                else:
+                    st.rings[stem] = port
+                    st.ring_locks[stem] = threading.Lock()
+        for stem in list(st.rings):
+            if stem in alive:
+                continue
+            with st.lock:
+                port = st.rings.pop(stem, None)
+                st.ring_locks.pop(stem, None)
+            if port is None:
+                continue
+            for resp in port.recv():
+                rid = resp.get("id")
+                if rid:
+                    st.lanes[shard_of(rid, n)].resp_inbox.append(
+                        (stem, resp)
+                    )
+            port.close()
+
+    def _stem_spool(self, key: str, stem: str) -> Optional[Spool]:
+        rt, _, idx = stem.rpartition("-")
         try:
-            sp = Spool(
+            return Spool(
                 replica_spool_dir(self.serve_root, key, rt, int(idx)),
                 create=False,
             )
         except (ValueError, OSError):
             return None
-        return sp.read_response(f.rid)
+
+    def _replica_response(self, key: str, f: _Inflight) -> Optional[dict]:
+        """The replica-side FILE response for an in-flight request, if
+        the engine has published one (dead replicas included — a
+        response written just before the kill still counts)."""
+        if f.replica is None:
+            return None
+        sp = self._stem_spool(key, f.replica)
+        return sp.read_response(f.rid) if sp is not None else None
+
+    # ---- tick phases (per lane) ----
 
     def _publish(
-        self, key: str, st: _JobState, f: _Inflight, resp: dict
+        self, key: str, st: _JobState, lane: _Lane, f: _Inflight, resp: dict
     ) -> None:
         """Move one response replica → front, exactly once, with the
         router's accounting stamped on."""
@@ -349,14 +628,15 @@ class ServeRouter:
         resp["queue_wait_ms"] = round(
             1000 * max(0.0, wait_end - f.submit_time), 3
         )
-        won = st.front.respond_once(f.rid, resp)
-        self.io.publishes += 1
+        with st.front_lock:
+            won = st.front.respond_once(f.rid, resp)
+        lane.io.publishes += 1
         if won:
             outcome = "error" if resp.get("error") is not None else "ok"
             if outcome == "ok":
-                st.ok += 1
+                lane.ok += 1
             else:
-                st.errors += 1
+                lane.errors += 1
             m = self.metrics
             if m is not None:
                 m.serve_requests.inc(job=key, outcome=outcome)
@@ -378,9 +658,9 @@ class ServeRouter:
                     job=key,
                 )
         else:
-            st.dup_avoided += 1
-        # Consume the replica-side copy either way; the front record is
-        # the durable one.
+            lane.dup_avoided += 1
+        # Consume the replica-side file copy either way; the front
+        # record is the durable one. Ring-borne responses have no file.
         if f.replica is not None:
             rt, _, idx = f.replica.rpartition("-")
             try:
@@ -391,154 +671,343 @@ class ServeRouter:
                 ).unlink(missing_ok=True)
             except (ValueError, OSError):
                 pass
-        st.inflight.pop(f.rid, None)
+        if f.replica is not None:
+            cur = lane.outstanding.get(f.replica)
+            if cur:
+                lane.outstanding[f.replica] = cur - 1
+        lane.inflight.pop(f.rid, None)
 
     def _shed(
-        self, key: str, st: _JobState, rid: str, decision: str,
+        self, key: str, st: _JobState, lane: _Lane, rid: str, decision: str,
         submit_time: float, now: float,
     ) -> None:
-        if st.front.respond_once(
-            rid, overload_response(rid, decision, submit_time=submit_time,
-                                   now=now)
-        ):
-            st.shed += 1
+        with st.front_lock:
+            won = st.front.respond_once(
+                rid, overload_response(rid, decision,
+                                       submit_time=submit_time, now=now)
+            )
+        if won:
+            lane.shed += 1
             if self.metrics is not None:
                 self.metrics.serve_requests.inc(job=key, outcome="shed")
         else:
-            st.dup_avoided += 1
+            lane.dup_avoided += 1
 
-    def _collect_responses(self, key: str, st: _JobState, now: float) -> None:
-        for f in list(st.inflight.values()):
+    def _handle_response(
+        self, key: str, st: _JobState, lane: _Lane, stem: str, resp: dict
+    ) -> None:
+        rid = resp.get("id")
+        if not rid:
+            return
+        f = lane.inflight.get(rid)
+        if f is not None:
+            if f.replica is None:
+                f.replica = stem
+            self._publish(key, st, lane, f, resp)
+            return
+        # A response for a request this lane no longer tracks: a
+        # re-served ring record after an engine restart, or a late
+        # answer the retry path already errored. respond_once is the
+        # dedup point either way; the replica-side copy (if any) goes.
+        with st.front_lock:
+            won = st.front.respond_once(rid, resp)
+        if won:
+            lane.ok += 1
+        else:
+            lane.dup_avoided += 1
+        sp = self._stem_spool(key, stem)
+        if sp is not None:
+            (sp.responses / f"{rid}.json").unlink(missing_ok=True)
+
+    def _drain_resp_inbox(
+        self, key: str, st: _JobState, lane: _Lane
+    ) -> int:
+        n = 0
+        while lane.resp_inbox:
+            try:
+                stem, resp = lane.resp_inbox.popleft()
+            except IndexError:
+                break
+            self._handle_response(key, st, lane, stem, resp)
+            n += 1
+        return n
+
+    def _collect_responses(
+        self, key: str, st: _JobState, lane: _Lane
+    ) -> int:
+        """Batched collection, both tiers: drain the response rings of
+        the replicas this lane owns (mmap pops — no syscalls), then ONE
+        directory scan per owned replica that has this job's traffic —
+        instead of the old one-stat-per-inflight-per-pass probe.
+        Records owned by another lane ride its resp inbox."""
+        n_lanes = len(st.lanes)
+        moved = self._drain_resp_inbox(key, st, lane)
+        rings = st.rings
+        for stem in list(rings):
+            if shard_of(stem, n_lanes) != lane.index:
+                continue
+            port = rings.get(stem)
+            if port is None:
+                continue
+            recs = port.recv()
+            lane.io.ring_recvs += len(recs)
+            for resp in recs:
+                rid = resp.get("id")
+                owner = shard_of(rid or "", n_lanes)
+                if owner == lane.index:
+                    self._handle_response(key, st, lane, stem, resp)
+                else:
+                    st.lanes[owner].resp_inbox.append((stem, resp))
+                moved += 1
+        # File tier: scan each replica currently holding in-flight
+        # requests of this lane (dead ones included — a response
+        # written just before the kill still counts).
+        stems = {
+            f.replica
+            for f in list(lane.inflight.values())
+            if f.replica is not None and not f.via_ring
+        }
+        for stem in stems:
+            sp = self._stem_spool(key, stem)
+            if sp is None:
+                continue
+            lane.io.front_scans += 1
+            for resp in sp.drain_responses():
+                rid = resp.get("id")
+                owner = shard_of(rid or "", n_lanes)
+                if owner == lane.index:
+                    self._handle_response(key, st, lane, stem, resp)
+                else:
+                    st.lanes[owner].resp_inbox.append((stem, resp))
+                moved += 1
+        # Ring-dispatched requests can still answer through the file
+        # path (engine spilled a full resp ring): probe those directly.
+        for f in list(lane.inflight.values()):
+            if not f.via_ring or f.replica is None:
+                continue
             resp = self._replica_response(key, f)
             if resp is not None:
-                self._publish(key, st, f, resp)
+                self._publish(key, st, lane, f, resp)
+                moved += 1
+        return moved
 
-    def _handle_deaths(
-        self, key: str, st: _JobState, slo: SLO, alive: Dict[str, Spool],
-        now: float,
-    ) -> None:
-        for f in list(st.inflight.values()):
+    def _handle_deaths(self, key: str, st: _JobState, lane: _Lane) -> int:
+        alive = st.alive
+        slo = st.slo
+        if slo is None:
+            return 0
+        moved = 0
+        for f in list(lane.inflight.values()):
             if f.replica is None or f.replica in alive:
                 continue
             # The replica died with this request on board (its response
             # — if any — was already collected above). Pull the copy
             # back and decide: re-route or give up.
-            rt, _, idx = f.replica.rpartition("-")
-            try:
-                Spool(
-                    replica_spool_dir(self.serve_root, key, rt, int(idx)),
-                    create=False,
-                ).cancel(f.rid)
-            except (ValueError, OSError):
-                pass
+            sp = self._stem_spool(key, f.replica)
+            if sp is not None:
+                sp.cancel(f.rid)
+            moved += 1
+            cur = lane.outstanding.get(f.replica)
+            if cur:
+                lane.outstanding[f.replica] = cur - 1
             if f.attempts > slo.retry_limit:
-                if st.front.respond_once(
-                    f.rid,
-                    {
-                        "id": f.rid,
-                        "error": (
-                            f"replica {f.replica} died; "
-                            f"{slo.retry_limit} re-route(s) exhausted"
-                        ),
-                        "attempts": f.attempts,
-                    },
-                ):
-                    st.errors += 1
+                with st.front_lock:
+                    won = st.front.respond_once(
+                        f.rid,
+                        {
+                            "id": f.rid,
+                            "error": (
+                                f"replica {f.replica} died; "
+                                f"{slo.retry_limit} re-route(s) exhausted"
+                            ),
+                            "attempts": f.attempts,
+                        },
+                    )
+                if won:
+                    lane.errors += 1
                     if self.metrics is not None:
                         self.metrics.serve_requests.inc(
                             job=key, outcome="error"
                         )
-                st.inflight.pop(f.rid, None)
+                lane.inflight.pop(f.rid, None)
                 continue
             f.replica = None
-            f.retry_at = now + st.backoff.delay(f.attempts - 1)
-            st.rerouted += 1
+            f.via_ring = False
+            # invariant: clock-discipline — retry gates are router-
+            # internal deadlines, so they live on the monotonic axis.
+            f.retry_at = time.monotonic() + st.backoff.delay(f.attempts - 1)
+            lane.rerouted += 1
             if self.metrics is not None:
                 self.metrics.serve_rerouted.inc(job=key)
+        return moved
 
     def _admit(
-        self, key: str, st: _JobState, slo: SLO, now: float
-    ) -> None:
-        recs = st.front.claim(CLAIM_BATCH)
+        self, key: str, st: _JobState, lane: _Lane, now: float
+    ) -> int:
+        slo = st.slo
+        if slo is None:
+            return 0
+        n_lanes = len(st.lanes)
+        recs: List[dict] = []
+        while lane.inbox:
+            try:
+                recs.append(lane.inbox.popleft())
+            except IndexError:
+                break
+        with st.front_lock:
+            claimed = st.front.claim(CLAIM_BATCH)
+        if claimed:
+            lane.io.front_scans += 1
+        for rec in claimed:
+            rid = rec.get("id")
+            owner = shard_of(rid or "", n_lanes)
+            if rid and owner != lane.index:
+                # Claimed across the hash boundary: hand to the owner
+                # lane (exactly-once holds — claim-by-rename made this
+                # lane the only holder, and it relinquishes to exactly
+                # one inbox).
+                st.lanes[owner].inbox.append(rec)
+            else:
+                recs.append(rec)
+        moved = 0
+        inflight_total = st.inflight_total
         for rec in recs:
             rid = rec.get("id")
             if not rid:
                 continue  # claim() already answered torn files
-            if rid in st.inflight or st.front.has_response(rid):
+            if rid in lane.inflight:
                 continue  # duplicate submit of a known id
+            with st.front_lock:
+                dup = st.front.has_response(rid)
+            if dup:
+                continue
+            moved += 1
             submit_time = float(rec.get("submit_time", now))
             decision = slo.admit(
                 submit_time=submit_time,
-                in_flight=len(st.inflight),
+                in_flight=inflight_total,
                 now=now,
             )
             if decision != ADMIT:
-                self._shed(key, st, rid, decision, submit_time, now)
+                self._shed(key, st, lane, rid, decision, submit_time, now)
                 continue
-            st.inflight[rid] = _Inflight(
+            lane.inflight[rid] = _Inflight(
                 rec=rec, rid=rid, submit_time=submit_time
             )
+            inflight_total += 1
+        return moved
 
     def _dispatch(
-        self, key: str, st: _JobState, slo: SLO, alive: Dict[str, Spool],
-        by_replica: dict, now: float,
-    ) -> None:
+        self, key: str, st: _JobState, lane: _Lane, now: float
+    ) -> int:
+        slo = st.slo
+        alive = st.alive
+        if slo is None:
+            return 0
         undispatched = [
-            f for f in st.inflight.values() if f.replica is None
+            f for f in lane.inflight.values() if f.replica is None
         ]
         if not undispatched:
-            return
-        # Router-side outstanding per replica — exact, because every
-        # dispatch goes through here.
-        outstanding: Dict[str, int] = {stem: 0 for stem in alive}
-        for f in st.inflight.values():
-            if f.replica in outstanding:
-                outstanding[f.replica] += 1
+            return 0
+        by_replica = st.by_replica
+        outstanding = lane.outstanding
+        for stem in alive:
+            outstanding.setdefault(stem, 0)
 
         def score(stem: str):
+            """Continuous-batching-aware: FILL a replica's batch before
+            opening another — smallest positive slot headroom wins, so
+            dispatch converges on nearly-full batches instead of
+            spraying round-robin. Headroom folds the engine's own slot
+            count and queue depth (heartbeat telemetry) with this
+            lane's not-yet-acknowledged dispatches. Replicas with no
+            headroom sort behind all that have some, least-loaded
+            first; decode-block phase (``block_ms`` — how long until
+            the engine's current decode block frees a slot) breaks
+            ties toward the replica that can start soonest."""
             tele = (by_replica.get(stem) or {}).get("serve") or {}
-            # Primary: what the router knows it put there and the
-            # engine hasn't answered. Tie-break: the engine's own live
-            # occupancy (free slots first, then shorter queue, then the
-            # p99 it is currently delivering).
-            return (
-                outstanding[stem],
-                -float(tele.get("slots_free", 0.0)),
-                float(tele.get("queued", 0.0)),
-                float(tele.get("tpot_ms_p99", 0.0)),
-                stem,
-            )
+            out = outstanding.get(stem, 0)
+            slots = float(tele.get("slots", 0.0))
+            queued = float(tele.get("queued", 0.0))
+            block = float(tele.get("block_ms", 0.0))
+            if slots > 0:
+                headroom = slots - queued - out
+            else:
+                # No telemetry yet (replica just came up): router-side
+                # accounting is all there is.
+                headroom = -float(out)
+            if headroom > 0:
+                return (0, headroom, block, out, stem)
+            return (1, out, block, -headroom, stem)
 
+        moved = 0
+        mono = time.monotonic()
+        # Per-replica file batches: every spilled dispatch of this pass
+        # rides ONE batch file per replica (one fsync), not N renames.
+        spill: Dict[str, List[dict]] = {}
         for f in sorted(undispatched, key=lambda f: f.submit_time):
-            if f.retry_at > now:
+            if f.retry_at > mono:
                 continue
             if slo.expired(f.submit_time, now):
                 # Aged out before a replica could take it (death-retry
                 # storms land here) — deadline-shed bounds the tail.
-                self._shed(key, st, f.rid, SHED_DEADLINE, f.submit_time, now)
-                st.inflight.pop(f.rid, None)
+                self._shed(
+                    key, st, lane, f.rid, SHED_DEADLINE, f.submit_time, now
+                )
+                lane.inflight.pop(f.rid, None)
+                moved += 1
                 continue
             if f.recovered:
                 f.recovered = False
-                if self._readopt(key, st, f, alive, now):
+                if self._readopt(key, st, lane, f, alive, now):
+                    moved += 1
                     continue
             if not alive:
-                continue  # keep; next tick may have replicas again
+                continue  # keep; next pass may have replicas again
             stem = min(alive, key=score)
             rec = dict(f.rec)
             rec["attempts"] = f.attempts + 1
-            alive[stem].enqueue(rec)
-            self.io.dispatches += 1
+            f.via_ring = self._ring_send(st, lane, stem, rec)
+            if not f.via_ring:
+                spill.setdefault(stem, []).append(rec)
+            lane.io.dispatches += 1
             f.replica = stem
             f.attempts += 1
             if f.first_dispatch is None:
                 f.first_dispatch = now
             if f.attempts == 1:
-                st.routed += 1
-            outstanding[stem] += 1
+                lane.routed += 1
+            outstanding[stem] = outstanding.get(stem, 0) + 1
+            moved += 1
+        for stem, recs in spill.items():
+            sp = alive.get(stem)
+            if sp is None:
+                continue
+            if len(recs) == 1:
+                sp.enqueue(recs[0])
+            else:
+                sp.enqueue_batch(recs)
+        return moved
+
+    def _ring_send(
+        self, st: _JobState, lane: _Lane, stem: str, rec: dict
+    ) -> bool:
+        port = st.rings.get(stem)
+        if port is None:
+            return False
+        rlock = st.ring_locks.get(stem)
+        if rlock is None:
+            return False
+        with rlock:
+            ok = port.send(rec)
+        if ok:
+            lane.io.ring_sends += 1
+        else:
+            lane.io.ring_spills += 1
+        return ok
 
     def _readopt(
-        self, key: str, st: _JobState, f: _Inflight,
+        self, key: str, st: _JobState, lane: _Lane, f: _Inflight,
         alive: Dict[str, Spool], now: float,
     ) -> bool:
         """Post-restart dedup: before re-dispatching a recovered
@@ -550,7 +1019,7 @@ class ServeRouter:
             if resp is not None:
                 f.replica = stem
                 f.attempts = max(1, f.attempts)
-                self._publish(key, st, f, resp)
+                self._publish(key, st, lane, f, resp)
                 return True
             if (sp.requests / f"{f.rid}.json").exists() or (
                 sp.claimed / f"{f.rid}.json"
@@ -581,6 +1050,8 @@ class ServeRouter:
             "inflight": summary["inflight"],
             "replicas": summary["replicas"],
             "slots_free": summary["slots_free"],
+            "shards": summary["shards"],
+            "transport": summary["transport"],
             "routed": summary["routed"],
             "shed": summary["shed"],
         }

@@ -49,6 +49,7 @@ def run(
     idle_timeout: float = 0.0,
     poll_interval: float = 0.05,
     report_every: float = 5.0,
+    transport: str = "spool",
     seed: int = 0,
     log=print,
 ) -> dict:
@@ -59,7 +60,8 @@ def run(
     import numpy as np
 
     from ..models import llama as llama_lib
-    from ..serving import Request, ServingEngine, Spool
+    from ..serving import Request, ServingEngine
+    from ..serving.shmring import EngineTransport
     from .generate import load_params
     from .llama_train import CONFIGS
 
@@ -83,8 +85,11 @@ def run(
         temperature=temperature, top_k=top_k, top_p=top_p,
         eos_token=eos_token, seed=seed,
     )
-    spool = Spool(spool_dir)
-    recovered = spool.recover_claimed()
+    # The transport wraps the durable file spool and — when the job's
+    # ``spec.serving.transport`` is shmring — attaches the router's
+    # shared-memory ring pair once it appears (serving/shmring.py).
+    spool = EngineTransport(spool_dir, transport)
+    recovered = spool.recover()
     if recovered:
         # A previous life of this job (the supervisor's restart policy)
         # died with claims in flight; they're requests again now.
@@ -142,8 +147,9 @@ def run(
 
     while True:
         # Admission feed: claim enough to keep the slots fed one
-        # iteration ahead.
-        for rec in spool.claim(2 * slots - engine.queued):
+        # iteration ahead (ring tier first, then the file spool).
+        polled, _ = spool.poll_requests(2 * slots - engine.queued)
+        for rec in polled:
             try:
                 engine.submit(to_request(rec))
                 last_activity = time.time()
@@ -199,6 +205,14 @@ def run(
                 ttft_ms_p99=s["ttft_ms_p99"],
                 tpot_ms_p50=s["tpot_ms_p50"],
                 tpot_ms_p99=s["tpot_ms_p99"],
+                # Decode-block phase for the router's batch-fill
+                # tie-break: a busy engine frees its next slot one
+                # block's worth of per-token time away.
+                block_ms=(
+                    (s["tpot_ms_p50"] or 0.0) * block
+                    if engine.busy
+                    else 0.0
+                ),
             )
             # The LIVE operator surface (`tpujob describe` Training
             # block + per-job gauges) folds only progress records —
@@ -225,7 +239,11 @@ def run(
         rejected=rejected,
         params_m=round(n_params / 1e6, 1),
         config=config,
+        transport=transport,
+        ring_recvs=spool.ring_recvs,
+        ring_sends=spool.ring_sends,
     )
+    spool.close()
     if weight_bytes is not None:
         stats["weight_mb"] = round(weight_bytes / 1e6, 2)
     if restored_step is not None:
@@ -287,6 +305,13 @@ def main(argv=None) -> int:
         help="seconds between progress/metrics reports to the "
         "supervisor surface",
     )
+    p.add_argument(
+        "--transport",
+        choices=("spool", "shmring"),
+        default=os.environ.get("TPUJOB_SERVE_TRANSPORT") or "spool",
+        help="router transport tier; defaults to the supervisor-"
+        "injected TPUJOB_SERVE_TRANSPORT (spec.serving.transport)",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
@@ -314,6 +339,7 @@ def main(argv=None) -> int:
         max_requests=args.max_requests,
         idle_timeout=args.idle_timeout,
         report_every=args.report_every,
+        transport=args.transport,
         seed=args.seed,
         log=lambda msg: print(msg, flush=True),
     )

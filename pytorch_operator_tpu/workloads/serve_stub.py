@@ -28,8 +28,14 @@ import time
 from typing import List, Optional
 
 from .. import faults
+from ..backoff import Backoff
 from ..runtime import rendezvous
-from ..serving import Spool
+from ..serving.shmring import EngineTransport
+
+# Idle-poll schedule when a ring is attached: ring polls are mmap
+# reads, so the floor is tight (sub-ms admission), but a long-idle
+# engine still decays toward the file-era poll interval.
+_IDLE_BACKOFF = Backoff(base_s=0.0005, cap_s=0.05, factor=2.0, jitter=0.1)
 
 
 def _pct(vals: List[float], q: float) -> Optional[float]:
@@ -48,13 +54,14 @@ def run(
     idle_timeout: float = 0.0,
     poll_interval: float = 0.01,
     report_every: float = 0.25,
+    transport: str = "spool",
     log=print,
 ) -> dict:
     """The stub serving loop. Same lifecycle bounds as serve.py:
     ``max_requests`` / ``idle_timeout`` end the run for benches; both 0
     serves forever (the supervisor owns the lifecycle)."""
-    spool = Spool(spool_dir)
-    recovered = spool.recover_claimed()
+    spool = EngineTransport(spool_dir, transport)
+    recovered = spool.recover()
     if recovered:
         log(f"[serve-stub] recovered {recovered} claimed request(s) "
             "from a previous life")
@@ -68,9 +75,13 @@ def run(
     step_s = max(tpot_ms, 0.0) / 1000.0
     last_activity = time.time()
     last_report = 0.0
+    idle_polls = 0
 
     while True:
-        for rec in spool.claim(slots - len(active)):
+        polled, _ = spool.poll_requests(slots - len(active))
+        if polled:
+            idle_polls = 0
+        for rec in polled:
             rid = rec.get("id")
             if not rid:
                 continue
@@ -132,6 +143,13 @@ def run(
                 ttfts.append(a["ttft_ms"])
                 last_activity = now
             active = still
+        elif spool.ring_attached:
+            # Memory-speed tier: ring polls cost no syscalls, so idle
+            # waits start sub-ms and decay on the shared backoff.
+            idle_polls += 1
+            time.sleep(
+                min(poll_interval, _IDLE_BACKOFF.delay(idle_polls - 1))
+            )
         else:
             time.sleep(poll_interval)
         now = time.time()
@@ -149,6 +167,9 @@ def run(
                 ttft_ms_p99=_pct(ttfts, 0.99),
                 tpot_ms_p50=tpot_ms,
                 tpot_ms_p99=tpot_ms,
+                # Decode-block phase: mid-batch the next slot opens a
+                # full block away; idle it opens immediately.
+                block_ms=tpot_ms if active else 0.0,
             )
             rendezvous.report_progress(
                 served,
@@ -174,7 +195,12 @@ def run(
         "tpot_ms": tpot_ms,
         "ttft_ms_p50": _pct(ttfts, 0.50),
         "ttft_ms_p99": _pct(ttfts, 0.99),
+        "transport": transport,
+        "ring_recvs": spool.ring_recvs,
+        "ring_sends": spool.ring_sends,
+        "ring_send_spills": spool.ring_send_spills,
     }
+    spool.close()
     log(f"[serve-stub] done: {json.dumps(stats)}")
     return stats
 
@@ -199,6 +225,15 @@ def main(argv=None) -> int:
     p.add_argument("--poll-interval", type=float, default=0.01)
     p.add_argument("--report-every", type=float, default=0.25,
                    help="seconds between serve-telemetry beats")
+    p.add_argument(
+        "--transport",
+        choices=("spool", "shmring"),
+        default=os.environ.get("TPUJOB_SERVE_TRANSPORT") or "spool",
+        help="router transport tier; defaults to the supervisor-"
+        "injected TPUJOB_SERVE_TRANSPORT (spec.serving.transport). "
+        "shmring attaches the router-created shared-memory ring pair "
+        "and keeps the file spool as the spill path",
+    )
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
     if not args.spool:
@@ -218,6 +253,7 @@ def main(argv=None) -> int:
         idle_timeout=args.idle_timeout,
         poll_interval=args.poll_interval,
         report_every=args.report_every,
+        transport=args.transport,
         log=lambda msg: print(msg, flush=True),
     )
     if args.json and world.process_id == 0:

@@ -71,6 +71,8 @@ def _make_serve_job(
     max_queue_depth: int,
     deadline_s: float,
     retry_limit: int,
+    transport: str = "spool",
+    router_shards: int = 0,
 ):
     """A serving job of ``replicas`` engine replicas: Master(1) +
     Worker(replicas-1) — validation pins Master at exactly one, and the
@@ -118,7 +120,9 @@ def _make_serve_job(
                     max_queue_depth=max_queue_depth,
                     deadline_s=deadline_s,
                     retry_limit=retry_limit,
-                )
+                ),
+                transport=transport,
+                router_shards=router_shards,
             ),
         ),
     )
@@ -138,6 +142,9 @@ def bench_cell(
     retry_limit: int,
     idle_timeout: float,
     state_dir: Path,
+    transport: str = "spool",
+    router_shards: int = 0,
+    label: Optional[str] = None,
     seed: int = 7,
     log=print,
 ) -> dict:
@@ -145,7 +152,7 @@ def bench_cell(
     from .. import faults
     from ..controller.store import key_to_fs
     from ..controller.supervisor import Supervisor
-    from ..serving import Spool
+    from ..serving import Spool, make_request
     from ..serving.router import front_spool_dir, serve_root_dir
     from ..serving.slo import SLOStats
 
@@ -179,8 +186,9 @@ def bench_cell(
 
     pump_thread = threading.Thread(target=pump, daemon=True)
     try:
+        cell_name = label or f"{scenario}x{replicas}"
         job = _make_serve_job(
-            f"serve-bench-{scenario.replace('_', '-')}-{replicas}",
+            f"serve-bench-{cell_name.replace('_', '-')}",
             replicas,
             slots=slots,
             tpot_ms=tpot_ms,
@@ -188,6 +196,8 @@ def bench_cell(
             max_queue_depth=max_queue_depth,
             deadline_s=deadline_s,
             retry_limit=retry_limit,
+            transport=transport,
+            router_shards=router_shards,
         )
         key = sup.submit(job)
         pump_thread.start()
@@ -239,6 +249,11 @@ def bench_cell(
         )
 
         # ---- open-loop Poisson arrivals at the FIXED offered rate ----
+        # Arrivals due at a wake ride ONE batch frame (enqueue_batch:
+        # one tmp write + fsync + rename for the whole burst) — the
+        # client-side half of the batched-framing syscall collapse; a
+        # lone arrival still goes through the classic single-file
+        # submit path so both framings stay exercised.
         rng = random.Random(seed * 7919 + replicas)
         stats = SLOStats()
         start = time.time()
@@ -252,17 +267,38 @@ def bench_cell(
             if now < t_next:
                 time.sleep(min(0.002, t_next - now))
                 continue
-            rids.append(front.submit(prompt_len=4,
-                                     max_new_tokens=max_new_tokens))
-            t_next += rng.expovariate(rate)
+            due: List[dict] = []
+            while t_next <= now:
+                due.append(
+                    make_request(prompt_len=4,
+                                 max_new_tokens=max_new_tokens)
+                )
+                t_next += rng.expovariate(rate)
+            if len(due) == 1:
+                front.enqueue(due[0])
+                rids.append(due[0]["id"])
+            elif due:
+                rids.extend(front.enqueue_batch(due))
         stats.offered = len(rids)
 
         # ---- collect: EVERY submit gets exactly one response ----
+        # ONE responses/ scan per poll (not one stat per pending id):
+        # the collection loop stays O(responses) however large the
+        # saturation cell's in-flight population gets.
         pending = set(rids)
         collect_deadline = time.monotonic() + deadline_s + max(30.0, 4 * duration)
         while pending and time.monotonic() < collect_deadline:
             done = []
-            for rid in pending:
+            try:
+                arrived = [
+                    p.stem for p in front.responses.iterdir()
+                    if p.suffix == ".json"
+                ]
+            except FileNotFoundError:
+                arrived = []
+            for rid in arrived:
+                if rid not in pending:
+                    continue
                 resp = front.read_response(rid)
                 if resp is not None:
                     stats.account(resp)
@@ -307,9 +343,11 @@ def bench_cell(
         )
         summary = stats.summary()
         cell = {
-            "cell": f"{scenario}x{replicas}",
+            "cell": cell_name,
             "scenario": scenario,
             "replicas": replicas,
+            "transport": transport,
+            "router_shards": router_shards,
             "offered_rate_rps": rate,
             "duration_s": duration,
             "slots": slots,
@@ -326,7 +364,7 @@ def bench_cell(
             **summary,
             "lost": lost,
             "job_finished": finished,
-            "router_io": sup.router.io.snapshot(),
+            "router_io": sup.router.io_snapshot(),
             "pump_errors": len(pump_errors),
             "ttft_p99_bound_ms": round(bound_ms, 1),
             "ttft_p99_bounded": (
@@ -335,7 +373,7 @@ def bench_cell(
             ),
         }
         log(
-            f"[serveplane] {scenario:>16s} x{replicas} "
+            f"[serveplane] {cell_name:>20s} "
             f"offered={cell['offered']:4d} ok={cell['ok']:4d} "
             f"shed={cell['shed']:4d} errors={cell['errors']:3d} "
             f"rerouted={cell['rerouted']:2d} lost={lost} "
@@ -402,7 +440,7 @@ def bench_idle_overhead(
             t0 = time.perf_counter()
             sup.sync_once()
             lat_ms.append(1000 * (time.perf_counter() - t0))
-        io = sup.router.io.snapshot()
+        io = sup.router.io_snapshot()
         cell = {
             "cell": "idle_overhead",
             "jobs": n_jobs,
@@ -424,6 +462,26 @@ def bench_idle_overhead(
         sup.shutdown()
 
 
+# Router-saturation profile defaults: per-replica capacity is cranked
+# far past the offered rate (slots/(max_new_tokens*tpot_ms) = 2000
+# rps/replica), so the cell measures the ROUTING path — sharded
+# workers + shm rings + batched framing — not the stubs' clock. The
+# kill variant runs the same profile with a mid-window replica kill:
+# exactly-once under chaos on the ring path.
+SATURATION = {
+    "replicas": 4,
+    "scenarios": ("healthy", "kill_replica"),
+    "rate": 420.0,
+    "slots": 16,
+    "tpot_ms": 2.0,
+    "max_new_tokens": 4,
+    "max_queue_depth": 512,
+    "deadline_s": 5.0,
+    "transport": "shmring",
+    "router_shards": 4,
+}
+
+
 def run(
     replica_cells=(1, 2, 4),
     scenarios=SCENARIOS,
@@ -438,6 +496,7 @@ def run(
     idle_timeout: float = 4.0,
     idle_jobs: int = 20,
     idle_passes: int = 30,
+    saturation: Optional[dict] = None,
     out: Optional[str] = None,
     work_dir: Optional[str] = None,
     seed: int = 7,
@@ -467,6 +526,40 @@ def run(
                         log=log,
                     )
                 )
+    sat_cells: List[dict] = []
+    if saturation is not None:
+        sat = dict(SATURATION, **saturation)
+        for scenario in sat["scenarios"]:
+            label = (
+                f"saturationx{sat['replicas']}"
+                if scenario == "healthy"
+                else f"saturation_{scenario}x{sat['replicas']}"
+            )
+            with tempfile.TemporaryDirectory(
+                prefix=f"serveplane-{label}-", dir=work_dir
+            ) as td:
+                cell = bench_cell(
+                    sat["replicas"],
+                    scenario,
+                    rate=sat["rate"],
+                    duration=duration,
+                    slots=sat["slots"],
+                    tpot_ms=sat["tpot_ms"],
+                    max_new_tokens=sat["max_new_tokens"],
+                    max_queue_depth=sat["max_queue_depth"],
+                    deadline_s=sat["deadline_s"],
+                    retry_limit=retry_limit,
+                    idle_timeout=idle_timeout,
+                    state_dir=Path(td),
+                    transport=sat["transport"],
+                    router_shards=sat["router_shards"],
+                    label=label,
+                    seed=seed,
+                    log=log,
+                )
+                cell["profile"] = "saturation"
+                sat_cells.append(cell)
+        cells.extend(sat_cells)
     with tempfile.TemporaryDirectory(
         prefix="serveplane-idle-", dir=work_dir
     ) as td:
@@ -522,11 +615,29 @@ def run(
                 "bound_ms": kill["ttft_p99_bound_ms"],
                 "pass": kill["ttft_p99_bounded"],
             }
+        # Router-saturation bar: the sharded + shm-ring + batched path
+        # must push the 4-replica saturation cell to >= 10x the
+        # single-replica goodput of the standard (file-spool, single-
+        # lane) healthy cell — the "memory-speed serve plane" claim.
+        sat_ok = [c for c in sat_cells if c["scenario"] == "healthy"]
+        if sat_ok and lo["goodput_rps"] > 0:
+            sat_ratio = sat_ok[0]["goodput_rps"] / lo["goodput_rps"]
+            comparisons["router_saturation"] = {
+                "baseline_cell": lo["cell"],
+                "baseline_goodput_rps": lo["goodput_rps"],
+                "saturation_cell": sat_ok[0]["cell"],
+                "saturation_goodput_rps": sat_ok[0]["goodput_rps"],
+                "ratio": round(sat_ratio, 2),
+            }
+            acceptance["router_saturation_ratio"] = round(sat_ratio, 2)
+            acceptance["router_saturation_target"] = 10.0
+            acceptance["router_saturation_pass"] = sat_ratio >= 10.0
         acceptance["pass"] = (
             acceptance["scaling_pass"]
             and acceptance["duplicates_pass"]
             and acceptance["lost_pass"]
             and (kill is None or kill["ttft_p99_bounded"])
+            and acceptance.get("router_saturation_pass", True)
         )
 
     result = {
@@ -588,6 +699,12 @@ def main(argv=None) -> int:
     p.add_argument("--idle-jobs", type=int, default=20,
                    help="non-serving jobs in the zero-overhead cell")
     p.add_argument("--idle-passes", type=int, default=30)
+    p.add_argument(
+        "--no-saturation",
+        action="store_true",
+        help="skip the router-saturation cells (shmring + sharded "
+        "router at memory-speed offered load)",
+    )
     p.add_argument("--seed", type=int, default=7)
     p.add_argument(
         "--smoke",
@@ -624,6 +741,7 @@ def main(argv=None) -> int:
         retry_limit=args.retry_limit,
         idle_jobs=args.idle_jobs,
         idle_passes=args.idle_passes,
+        saturation=None if args.no_saturation else {},
         seed=args.seed,
         out=args.out,
         work_dir=args.work_dir,
@@ -641,6 +759,14 @@ def main(argv=None) -> int:
             idle_timeout=2.5,
             idle_jobs=8,
             idle_passes=10,
+            # The smoke saturation shape: 2 replicas, 2 shards, ring
+            # path, mid-capacity rate — seconds, not minutes.
+            saturation=None if args.no_saturation else {
+                "replicas": 2,
+                "scenarios": ("healthy", "kill_replica"),
+                "rate": 120.0,
+                "router_shards": 2,
+            },
         )
     result = run(**kwargs)
     print(

@@ -33,6 +33,16 @@ class TestBackoffSchedule:
         b = Backoff(seed=5)
         assert b.delay(3) == b.delay(3)
 
+    def test_huge_attempt_caps_instead_of_overflowing(self):
+        # An unbounded attempt counter (an idle poll loop running for
+        # hours) must land on the cap, not OverflowError float pow.
+        b = Backoff(base_s=0.0005, cap_s=0.05, factor=2.0, jitter=0.0)
+        assert b.delay(1024) == 0.05
+        assert b.delay(10**9) == 0.05
+        # Capped delays keep per-attempt jitter decorrelation.
+        j = Backoff(base_s=0.0005, cap_s=0.05, factor=2.0, jitter=0.1)
+        assert j.delay(2000) != j.delay(2001)
+
 
 class TestRetryCall:
     def test_retries_then_succeeds(self):
