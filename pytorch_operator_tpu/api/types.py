@@ -601,6 +601,16 @@ class ServingSLOPolicy:
     # Re-route attempts after a replica death before the router answers
     # the request with an error response itself.
     retry_limit: int = 2
+    # Availability target for error-budget burn accounting
+    # (serving/slo.py:BurnAccount): the fraction of published outcomes
+    # expected to be good (not shed / errored / past deadline). 0 =
+    # default (0.99). Feeds the tpujob_slo_burn_rate{job,window}
+    # gauges and the slo_burn rule, never the admission decision.
+    target: float = 0.0
+    # Width of the FAST burn window in seconds (the one the BURN
+    # column, the serve-record burn field and the slo_burn rule read).
+    # 0 = default (30s); the 5m slow window is fixed.
+    burn_window_s: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -610,6 +620,10 @@ class ServingSLOPolicy:
             d["deadline_s"] = self.deadline_s
         if self.retry_limit != 2:
             d["retry_limit"] = self.retry_limit
+        if self.target:
+            d["target"] = self.target
+        if self.burn_window_s:
+            d["burn_window_s"] = self.burn_window_s
         return d
 
     @classmethod
@@ -623,6 +637,10 @@ class ServingSLOPolicy:
             ),
             retry_limit=_parse_int(
                 d.get("retry_limit", 2), "serving.slo.retry_limit"
+            ),
+            target=_parse_float(d.get("target", 0.0), "serving.slo.target"),
+            burn_window_s=_parse_float(
+                d.get("burn_window_s", 0.0), "serving.slo.burn_window_s"
             ),
         )
 

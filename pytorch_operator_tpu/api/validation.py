@@ -171,6 +171,13 @@ def validate_spec(spec: TPUJobSpec) -> List[str]:
                 errs.append("spec.serving.slo.deadline_s: must be >= 0")
             if slo.retry_limit < 0:
                 errs.append("spec.serving.slo.retry_limit: must be >= 0")
+            if slo.target and not 0.0 < slo.target < 1.0:
+                errs.append(
+                    "spec.serving.slo.target: must be in (0, 1) — an "
+                    "availability fraction, e.g. 0.99 (0 = default)"
+                )
+            if slo.burn_window_s < 0:
+                errs.append("spec.serving.slo.burn_window_s: must be >= 0")
 
     if spec.observability is not None:
         ob = spec.observability

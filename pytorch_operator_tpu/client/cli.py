@@ -567,6 +567,59 @@ def _get_once(args, missing_ok: bool = False, store=None) -> int:
     return 0
 
 
+def _render_request_waterfall(doc: dict, rid: str) -> Optional[str]:
+    """Clock-aligned text waterfall for ONE request: every serve-path
+    span whose args carry this rid (enqueue → claim → dispatch →
+    ring/spool transit → slot wait → decode → respond → publish),
+    offsets relative to the first hop, a proportional bar per hop, and
+    the emitting process named from the trace metadata. None when the
+    merged doc has no spans for the rid."""
+    pid_names = {
+        e.get("pid"): (e.get("args") or {}).get("name", "")
+        for e in doc.get("traceEvents", [])
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    hops = [
+        e
+        for e in doc.get("traceEvents", [])
+        if e.get("ph") == "X" and (e.get("args") or {}).get("rid") == rid
+    ]
+    if not hops:
+        return None
+    hops.sort(key=lambda e: (e.get("ts", 0), e.get("name", "")))
+    t0 = hops[0].get("ts", 0)
+    t_end = max(e.get("ts", 0) + e.get("dur", 0) for e in hops)
+    total_us = max(t_end - t0, 1)
+    width = 32
+    corrected = any(
+        e.get("ph") == "M" and e.get("name") == "clock_sync_correction"
+        for e in doc.get("traceEvents", [])
+    )
+    lines = [
+        f"request {rid} — {len(hops)} hop(s), "
+        f"{total_us / 1e3:.3f}ms end to end"
+        + (", clock-synced" if corrected else "")
+    ]
+    for e in hops:
+        off = e.get("ts", 0) - t0
+        dur = e.get("dur", 0)
+        lead = min(int(width * off / total_us), width - 1)
+        blen = max(1, min(int(round(width * dur / total_us)), width - lead))
+        bar = " " * lead + "#" * blen
+        extras = " ".join(
+            f"{k}={v}"
+            for k, v in sorted((e.get("args") or {}).items())
+            if k != "rid"
+        )
+        who = pid_names.get(e.get("pid"), "") or "?"
+        lines.append(
+            f"  {off / 1e3:9.3f}ms  {e.get('name', '?'):<13} "
+            f"{dur / 1e3:9.3f}ms  |{bar:<{width}}|  {who}"
+            + (f"  {extras}" if extras else "")
+        )
+    return "\n".join(lines)
+
+
 def cmd_trace(args) -> int:
     """Merge the supervisor's and every replica's span files into one
     Chrome-trace/Perfetto JSON for this job (obs/trace.py), with
@@ -608,6 +661,25 @@ def cmd_trace(args) -> int:
             )
     doc = merge_trace_files(paths, clock_offsets=offsets or None)
     n_spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    rid = getattr(args, "request", None)
+    if rid:
+        # Per-request waterfall: the serve-path hop spans for one rid,
+        # already on the aligned clock, rendered as text (the full
+        # Perfetto doc still lands in --out when asked).
+        text = _render_request_waterfall(doc, rid)
+        if text is None:
+            print(
+                f"error: no spans carry request id {rid!r} "
+                f"({n_spans} spans searched) — was the request served "
+                "with tracing on?",
+                file=sys.stderr,
+            )
+            return 1
+        print(text)
+        if args.out:
+            Path(args.out).write_text(json.dumps(doc) + "\n")
+            print(f"\nwrote {args.out}")
+        return 0
     if args.out:
         Path(args.out).write_text(json.dumps(doc) + "\n")
         print(
@@ -1669,6 +1741,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-clock-sync", action="store_true", dest="no_clock_sync",
         help="skip the heartbeat-matched per-replica clock corrections "
         "(keep each host's raw timestamps)",
+    )
+    sp.add_argument(
+        "--request", default=None, metavar="RID",
+        help="render a clock-aligned text waterfall for one serve "
+        "request (enqueue → claim → dispatch → transit → slot wait → "
+        "decode → respond) instead of the full trace JSON",
     )
     add_ns(sp)
     sp.set_defaults(func=cmd_trace)

@@ -374,6 +374,12 @@ class MetricsRegistry:
             "Front-queue wait per request (submit -> dispatch to a "
             "replica spool)",
         )
+        self.slo_burn_rate = self.gauge(
+            "tpujob_slo_burn_rate",
+            "Error-budget burn rate per serving job and rolling window "
+            "(serving/slo.py BurnAccount: bad fraction / (1 - target); "
+            "1.0 = spending budget exactly as fast as the SLO earns it)",
+        )
         # Live mirrors of the bench-only I/O instrumentation: idle-I/O
         # regressions become visible in production, not just in
         # BENCH_ctrlplane.json (store deltas folded once per pass).
@@ -403,6 +409,20 @@ class MetricsRegistry:
             )
             for k in ("ticks", "front_scans", "dispatches", "publishes",
                       "sweeps", "ring_sends", "ring_recvs", "ring_spills",
+                      "shard_passes")
+        }
+        # Per-LANE router counters (labeled lane=<index>): the job-sum
+        # family above answers "how much"; these answer "which lane" —
+        # a single hot lane or a lane stuck spilling ring→file is
+        # invisible in the sums.
+        self.router_lane_io = {
+            k: self.counter(
+                f"tpujob_router_{k}_total",
+                f"Serve-plane router {k.replace('_', ' ')} per lane "
+                "(ServeRouter.lane_io_snapshot deltas, folded per sync "
+                "pass; lane label is the shard index)",
+            )
+            for k in ("ring_sends", "ring_recvs", "ring_spills",
                       "shard_passes")
         }
 

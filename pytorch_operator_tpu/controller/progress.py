@@ -89,6 +89,7 @@ TAILED_KINDS: dict = {
         "ts", "slots", "slots_free", "queued", "pending", "requests",
         "ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50", "tpot_ms_p99",
         "queue_depth", "inflight", "replicas", "routed", "shed",
+        "burn", "spills",
     ),
 }
 

@@ -906,6 +906,20 @@ class Reconciler:
                         )
                         sd.mkdir(parents=True, exist_ok=True)
                         spool_dir = str(sd)
+                        if job.spec.serving.transport == "shmring":
+                            # Pre-arm the ring pair at SPAWN instead of
+                            # the router's first dispatch: the engine
+                            # attaches the moment it starts, so the
+                            # first request rides the memory tier (the
+                            # ~1.1s first-second TTFT p99 warm-up spike
+                            # was requests spilling to the file path
+                            # while the rings armed).
+                            from ..serving.shmring import prearm_rings
+
+                            try:
+                                prearm_rings(sd)
+                            except OSError:
+                                pass  # router creates them on dispatch
                     rank = None
                     coord_port = None
                     resize_gen = None

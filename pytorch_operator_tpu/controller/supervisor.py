@@ -239,6 +239,7 @@ class Supervisor:
 
         self.router = ServeRouter(self.state_dir, metrics=self.metrics)
         self._router_io_seen = self.router.io_snapshot()
+        self._router_lane_seen: dict = {}
         # Serving jobs whose end-of-life drain already ran (the drain
         # scans the front spool — once, not every pass).
         self._serve_finalized: set = set()
@@ -992,6 +993,17 @@ class Supervisor:
             if delta:
                 counter.inc(delta)
         self._router_io_seen = cur
+        # Per-lane deltas (tpujob_router_*_total{lane}): the snapshot
+        # is monotonic across job retire by construction, so a plain
+        # delta fold is safe here too.
+        lane_cur = self.router.lane_io_snapshot()
+        for idx, vals in lane_cur.items():
+            seen = self._router_lane_seen.get(idx, {})
+            for k, counter in m.router_lane_io.items():
+                delta = vals.get(k, 0) - seen.get(k, 0)
+                if delta:
+                    counter.inc(delta, lane=str(idx))
+        self._router_lane_seen = lane_cur
 
     def _update_progress_gauges(self, jobs) -> None:
         """Fold each unfinished job's newest workload heartbeat

@@ -33,12 +33,14 @@ from .metrics import (
     parse_prometheus_text,
 )
 from .trace import (
+    SERVE_CAT,
     SpanRecorder,
     instant,
     load_span_file,
     merge_trace_files,
     records_emitted,
     reset_tracer,
+    serve_span,
     span,
     trace_enabled,
     tracer,
@@ -47,6 +49,7 @@ from .trace import (
 __all__ = [
     "DEFAULT_BUCKETS",
     "Histogram",
+    "SERVE_CAT",
     "SpanRecorder",
     "histogram_quantile",
     "instant",
@@ -56,6 +59,7 @@ __all__ = [
     "parse_prometheus_text",
     "records_emitted",
     "reset_tracer",
+    "serve_span",
     "span",
     "trace_enabled",
     "tracer",

@@ -321,6 +321,53 @@ def shard_events_for_job(state_dir, key: str) -> List[dict]:
 
 # ---- the engine ----
 
+#: The five stages a traced request crosses between enqueue and its
+#: decode — each maps the serve-path span names that account for it.
+_TTFT_HOPS = (
+    ("queue_wait", ("claim",)),
+    ("lane_handoff", ("dispatch",)),
+    ("transit", ("ring_transit", "spool_transit")),
+    ("slot_wait", ("slot_wait",)),
+    ("decode", ("decode",)),
+)
+
+
+def ttft_attribution(spans: List[dict]) -> Optional[dict]:
+    """Where time-to-first-token went, pooled over every traced request
+    in the window: the serve-path hop spans (cat ``serve``) bucketed
+    into the stages a request crosses between client enqueue and its
+    decode blocks. ``dominant`` names the hop with the largest mean —
+    the one sentence the report leads with. None when the job recorded
+    no serve spans (tracing off, or a training job)."""
+    from .rules import _quantile
+
+    by_name: Dict[str, List[float]] = {}
+    rids = set()
+    for s in spans:
+        if s.get("cat") != "serve":
+            continue
+        by_name.setdefault(str(s.get("name", "?")), []).append(
+            s.get("dur", 0) / 1e3
+        )
+        rid = (s.get("args") or {}).get("rid")
+        if rid:
+            rids.add(rid)
+    hops: Dict[str, dict] = {}
+    for hop, names in _TTFT_HOPS:
+        vals = [v for n in names for v in by_name.get(n, [])]
+        if not vals:
+            continue
+        hops[hop] = {
+            "n": len(vals),
+            "total_ms": round(sum(vals), 3),
+            "mean_ms": round(sum(vals) / len(vals), 3),
+            "p99_ms": round(_quantile(vals, 0.99), 3),
+        }
+    if not hops:
+        return None
+    dominant = max(hops, key=lambda h: hops[h]["mean_ms"])
+    return {"requests": len(rids), "hops": hops, "dominant": dominant}
+
 
 def job_thresholds(job) -> Thresholds:
     """The detector thresholds for one job: defaults overridden by its
@@ -448,6 +495,7 @@ def analyze(
         "events": len(tl.events),
         "spans": len(tl.spans),
         "exemplars": exemplars,
+        "ttft_attribution": ttft_attribution(tl.spans),
         "alerts": alerts,
         "shard_handoffs": shard_handoffs,
         "resize_history": resize_history,
@@ -514,9 +562,11 @@ def render_report(report: dict) -> str:
         lines.append("clock:    " + "; ".join(parts))
     alerts = report.get("alerts", [])
     findings = report.get("findings", [])
+    ttft = report.get("ttft_attribution")
     if (
         not findings
         and not alerts
+        and not ttft
         and not report.get("shard_handoffs")
         and not report.get("resize_history")
     ):
@@ -535,6 +585,23 @@ def render_report(report: dict) -> str:
     else:
         lines.append("")
         lines.append("no findings — the recorded window looks healthy.")
+    if ttft:
+        # Serve-path hop breakdown (only when request tracing recorded
+        # serve spans): which hop is eating time-to-first-token.
+        lines.append("")
+        lines.append(
+            f"TTFT ATTRIBUTION ({ttft.get('requests', 0)} traced "
+            f"request(s)) — dominant hop: {ttft.get('dominant', '?')}"
+        )
+        for hop, _names in _TTFT_HOPS:
+            st = ttft.get("hops", {}).get(hop)
+            if st is None:
+                continue
+            lines.append(
+                f"  {hop:<12} mean {st['mean_ms']:8.2f}ms  "
+                f"p99 {st['p99_ms']:8.2f}ms  "
+                f"total {st['total_ms']:9.1f}ms  (n={st['n']})"
+            )
     if alerts:
         # What the live engine already said, while the job was running:
         # every firing/resolved transition, oldest first.

@@ -100,6 +100,14 @@ class Thresholds:
     resize_thrash_count: int = 3
     resize_thrash_window_s: float = 120.0
 
+    # slo_burn (serve plane): the fast-window error-budget burn rate
+    # (bad fraction / allowed fraction; 1.0 = spending budget exactly
+    # at the rate that exhausts it when sustained) at or above this
+    # for the last N serve beats. Tail semantics so the live engine
+    # resolves the alert once the burn decays.
+    slo_burn_rate: float = 1.0
+    slo_burn_samples: int = 3
+
 
 DEFAULT_THRESHOLDS = Thresholds()
 
@@ -196,7 +204,7 @@ def ev_status(rec: dict, kind: str) -> dict:
     }
     for f in ("step", "step_time_ms", "feed_stall_ms", "queue_depth",
               "commit_ms", "slots", "slots_free", "inflight",
-              "ttft_ms_p99", "shed"):
+              "ttft_ms_p99", "shed", "burn", "spills"):
         if rec.get(f) is not None:
             out[f] = rec[f]
     return out
@@ -738,6 +746,64 @@ def detect_batch_size_collapse(
     ]
 
 
+def detect_slo_burn(
+    tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
+) -> List[Finding]:
+    """Error-budget burn sustained at/above the threshold: the last
+    ``slo_burn_samples`` in-window serve beats all carry a fast-window
+    ``burn`` >= ``slo_burn_rate``. Burn 1.0 means the job is spending
+    its (1 - target) budget exactly as fast as it accrues; anything
+    above it, sustained, exhausts the budget. Tail semantics (not
+    episode-anywhere) so the live engine resolves the alert the moment
+    the burn decays below threshold — the offline report still surfaces
+    past episodes through the alert log."""
+    recs = [
+        r
+        for r in tl.records.get("serve", [])
+        if r.get("burn") is not None
+        and tl.in_window(float(r.get("aligned_ts", r.get("ts", 0.0))))
+    ]
+    if len(recs) < th.slo_burn_samples:
+        return []
+    recs.sort(key=lambda r: float(r.get("aligned_ts", r.get("ts", 0.0))))
+    tail = recs[-th.slo_burn_samples:]
+    burns = [float(r["burn"]) for r in tail]
+    if any(b < th.slo_burn_rate for b in burns):
+        return []
+    peak = max(burns)
+    shed = sum(float(r.get("shed", 0) or 0) for r in tail)
+    evidence = [ev_status(tail[0], "serve"), ev_status(tail[-1], "serve")]
+    death = tl.find_event(*_DEATH_REASONS)
+    cause = ""
+    if death is not None:
+        evidence.append(ev_event(death))
+        cause = (
+            f"; coincides with {death.get('reason')} — lost decode "
+            "capacity is spending the budget, not extra load"
+        )
+    return [
+        Finding(
+            rule="slo_burn",
+            severity="critical" if peak >= 2 * th.slo_burn_rate else "warning",
+            summary=(
+                f"SLO error budget burning at {burns[-1]:.2f}x the "
+                f"sustainable rate (peak {peak:.2f}x over the last "
+                f"{len(tail)} beats, threshold {th.slo_burn_rate:g}) — "
+                f"sheds and deadline misses are eating the "
+                f"availability budget{cause}"
+            ),
+            evidence=evidence,
+            metrics={
+                "burn_last": burns[-1],
+                "burn_peak": peak,
+                "shed_in_tail": shed,
+                "n": len(tail),
+                "threshold": th.slo_burn_rate,
+            },
+        )
+    ]
+
+
 def detect_world_resize_thrash(
     tl: TimelineView, th: Thresholds = DEFAULT_THRESHOLDS
 ) -> List[Finding]:
@@ -819,6 +885,7 @@ DETECTORS: Tuple[Callable[..., List[Finding]], ...] = (
     detect_straggler,
     detect_queue_growth,
     detect_batch_size_collapse,
+    detect_slo_burn,
     detect_world_resize_thrash,
 )
 
@@ -831,6 +898,7 @@ RULES = (
     "straggler",
     "queue_growth",
     "batch_size_collapse",
+    "slo_burn",
     "world_resize_thrash",
     "noisy_neighbor",
 )

@@ -29,6 +29,7 @@ from .watch import fold_alert_log, load_alert_log
 STEP_HIST = "tpujob_step_time_seconds"
 SERVE_TTFT_HIST = "tpujob_serve_ttft_seconds"
 SERVE_QUEUE_GAUGE = "tpujob_job_serve_queue_depth"
+SLO_BURN_GAUGE = "tpujob_slo_burn_rate"
 
 # The table's columns: (header, row key) in display order — one list so
 # the renderer, the sort-key cycling (`tpujob top` 's' key), and tests
@@ -45,6 +46,7 @@ COLUMNS = (
     ("FEED(ms)", "feed_stall_ms"),
     ("SRV Q", "serve_q"),
     ("TTFT99", "ttft_p99_ms"),
+    ("BURN", "burn"),
     ("HB AGE", "age_s"),
     ("ALERTS", "alerts"),
     ("RESTARTS", "restarts"),
@@ -175,6 +177,12 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
             serve_q = sv.get("queue_depth")
         tq = _hist_quantiles(metrics, SERVE_TTFT_HIST, key)
         ttft_p99 = 1000 * tq[1] if tq else sv.get("ttft_ms_p99")
+        # Error-budget burn: the router's fast-window burn gauge
+        # (window label != the slow "5m" one), falling back to the
+        # newest ``serve`` status record for daemon-less snapshots.
+        burn = _burn_gauge(metrics, key)
+        if burn is None:
+            burn = sv.get("burn")
         step = hb.get("step")
         ck_step = ck.get("step")
         # Live health engine state (obs/watch.py alert log): the rules
@@ -207,6 +215,8 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
                 "feed_stall_ms": hb.get("feed_stall_ms"),
                 "serve_q": serve_q,
                 "ttft_p99_ms": ttft_p99,
+                "burn": burn,
+                "spills": sv.get("spills"),
                 "age_s": (now - hb["ts"]) if hb.get("ts") else None,
                 "alerts": len(firing) or None,
                 "alert_rules": sorted(firing),
@@ -224,6 +234,26 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
         key=lambda r: (r["age_s"] is None, r["age_s"] or 0.0, r["job"])
     )
     return rows
+
+
+def _burn_gauge(metrics: Dict, job: str) -> Optional[float]:
+    """The job's fast-window burn rate from the multi-window
+    ``tpujob_slo_burn_rate{job,window}`` gauge: prefer the fast window
+    (whatever width the spec chose — anything but the fixed slow
+    \"5m\"), fall back to any window present."""
+    fast = slow = None
+    for labels, v in metrics.get(SLO_BURN_GAUGE, ()):
+        if labels.get("job") != job:
+            continue
+        try:
+            val = float(v)
+        except (TypeError, ValueError):
+            continue
+        if labels.get("window") == "5m":
+            slow = val
+        else:
+            fast = val
+    return fast if fast is not None else slow
 
 
 def _tail_exemplar(exemplars: Dict, name: str, job: str) -> Optional[str]:
@@ -305,6 +335,7 @@ def _cells(r: dict) -> tuple:
         _fmt(r["feed_stall_ms"], ".2f"),
         _fmt(None if r.get("serve_q") is None else int(r["serve_q"])),
         _fmt(r.get("ttft_p99_ms"), ".1f"),
+        _fmt(r.get("burn"), ".2f"),
         _fmt(None if r["age_s"] is None else f"{r['age_s']:.0f}s"),
         (
             f"{r['alerts']}:{','.join(r.get('alert_rules', []))}"
@@ -383,6 +414,18 @@ def diff_rows(prev: List[dict], rows: List[dict]) -> List[str]:
         if pw is not None and cw is not None and pw != cw:
             direction = "shrunk" if cw < pw else "grew"
             changes.append(f"world {pw}→{cw} ({direction})")
+        # Serve plane: ring spills are the lane falling back to the
+        # file spool (backpressure) — any growth is worth a line; a
+        # burn rate crossing 1.0 means the error budget started
+        # draining faster than it accrues.
+        psp, csp = p.get("spills"), c.get("spills")
+        if csp is not None and psp is not None and csp > psp:
+            changes.append(f"spills {_fmt(psp)}→{_fmt(csp)} (ring backpressure)")
+        pb, cb = p.get("burn"), c.get("burn")
+        if cb is not None and (pb or 0.0) < 1.0 <= cb:
+            changes.append(f"SLO burn {pb if pb is not None else 0:.2f}→{cb:.2f} (budget draining)")
+        elif pb is not None and cb is not None and pb >= 1.0 > cb:
+            changes.append(f"SLO burn {pb:.2f}→{cb:.2f} (recovered)")
         pa, ca = p.get("age_s"), c.get("age_s")
         if pa is not None and ca is not None and ca > max(3 * pa, pa + 2.0):
             changes.append(f"hb age {pa:.0f}s→{ca:.0f}s (going silent?)")

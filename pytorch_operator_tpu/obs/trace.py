@@ -147,6 +147,29 @@ def records_emitted() -> int:
     return _RECORDS
 
 
+# Category for every serve-path request hop (enqueue → claim →
+# dispatch → ring/spool transit → slot wait → decode → respond →
+# publish). One cat so `tpujob trace --request` and the why TTFT
+# attribution can select the request waterfall without a name list.
+SERVE_CAT = "serve"
+
+
+def serve_span(name: str, ts: float, dur_s: float, **args) -> None:
+    """One serve-path hop span with EXPLICIT endpoints.
+
+    The request path measures hops with its own clocks (a queue wait
+    starts at the client's submit wall time, a ring transit at the
+    sender's stamp), so the context-manager form can't express them.
+    Disabled: one cached-None check, nothing else — the serve-path
+    zero-overhead pin counts on call sites computing their args only
+    after checking :func:`tracer` themselves, or tolerating the cost
+    of a few float subtractions.
+    """
+    rec = tracer()
+    if rec is not None:
+        rec.emit(name, SERVE_CAT, ts, dur_s, **args)
+
+
 class SpanRecorder:
     """Appends span records to one per-process JSONL ring file.
 

@@ -77,7 +77,8 @@ from pathlib import Path
 from typing import Deque, Dict, List, Optional, Tuple
 
 from ..backoff import Backoff
-from .slo import ADMIT, SHED_DEADLINE, SLO, overload_response
+from ..obs.trace import serve_span, tracer as _span_tracer
+from .slo import ADMIT, SHED_DEADLINE, SLO, BurnAccount, overload_response
 from .shmring import RouterRingPort
 from .spool import Spool
 
@@ -98,6 +99,11 @@ REPORT_EVERY_S = 1.0
 # claim is a real scandir; the cap bounds idle scan rate at ~4/s).
 SHARD_IDLE_BACKOFF = Backoff(base_s=0.001, cap_s=0.25, factor=2.0,
                              jitter=0.1)
+
+# The lane-attributable subset of RouterIOCounters surfaced as
+# ``tpujob_router_*_total{lane}`` on /metrics (satellite: ring→file
+# fallback visible live, not only in io_snapshot()).
+PER_LANE_KEYS = ("ring_sends", "ring_recvs", "ring_spills", "shard_passes")
 
 
 def serve_root_dir(state_dir) -> Path:
@@ -228,6 +234,9 @@ class _JobState:
     workers: List[threading.Thread] = field(default_factory=list)
     last_sweep: float = 0.0
     last_report: float = 0.0
+    # Error-budget burn (serving/slo.py): every published outcome is a
+    # budget event. Rebuilt by tick when the SLO target/window changes.
+    burn: Optional[BurnAccount] = None
 
     @property
     def inflight_total(self) -> int:
@@ -241,6 +250,10 @@ class ServeRouter:
         self.metrics = metrics
         self._jobs: Dict[str, _JobState] = {}
         self.io = RouterIOCounters()
+        # Retired jobs' per-lane totals (lane index -> PER_LANE_KEYS
+        # dict): keeps lane_io_snapshot monotonic across job retire,
+        # which the supervisor's counter fold depends on.
+        self._lane_retired: Dict[int, Dict[str, int]] = {}
 
     def io_snapshot(self) -> dict:
         """Totals across the router's own counters and every lane's —
@@ -252,6 +265,23 @@ class ServeRouter:
                 for k, v in lane.io.snapshot().items():
                     tot[k] += v
         return tot
+
+    def lane_io_snapshot(self) -> Dict[int, Dict[str, int]]:
+        """Per-lane totals of :data:`PER_LANE_KEYS`, summed across jobs
+        (lane index is the identity — the supervisor folds deltas into
+        ``tpujob_router_*_total{lane}`` counters). Monotonic: retired
+        jobs' lane work is folded into ``_lane_retired``, never lost."""
+        out: Dict[int, Dict[str, int]] = {
+            idx: dict(tot) for idx, tot in self._lane_retired.items()
+        }
+        for st in self._jobs.values():
+            for lane in st.lanes:
+                d = out.setdefault(
+                    lane.index, {k: 0 for k in PER_LANE_KEYS}
+                )
+                for k in PER_LANE_KEYS:
+                    d[k] += getattr(lane.io, k)
+        return out
 
     # ---- lifecycle ----
 
@@ -335,10 +365,16 @@ class ServeRouter:
             self._stop_workers(st)
             self._close_rings(st)
             # Keep the totals monotonic: the retired job's lane work
-            # folds into the router-level counters.
+            # folds into the router-level counters (and the per-lane
+            # retired totals the lane snapshot serves from).
             for lane in st.lanes:
                 for k, v in lane.io.snapshot().items():
                     setattr(self.io, k, getattr(self.io, k) + v)
+                d = self._lane_retired.setdefault(
+                    lane.index, {k: 0 for k in PER_LANE_KEYS}
+                )
+                for k in PER_LANE_KEYS:
+                    d[k] += getattr(lane.io, k)
 
     def close(self) -> None:
         """Supervisor shutdown: quiesce every job's shard workers and
@@ -422,6 +458,12 @@ class ServeRouter:
         self.io.ticks += 1
         st = self._state(key, job)
         st.slo = SLO.from_policy(job.spec.serving)
+        if (
+            st.burn is None
+            or st.burn.target != st.slo.target
+            or st.burn.windows[0][1] != st.slo.burn_window_s
+        ):
+            st.burn = BurnAccount(st.slo.target, st.slo.burn_window_s)
 
         # Alive replica set, stem -> spool (the handle index is the
         # same truth reconcile acts on; no second discovery mechanism).
@@ -473,6 +515,10 @@ class ServeRouter:
             if tele and tele.get("slots_free") is not None:
                 slots_free += float(tele["slots_free"])
         inflight_total = st.inflight_total
+        # Error-budget burn over the rolling windows; the FAST window
+        # is the one the serve record / BURN column / slo_burn rule
+        # read, the full per-window map feeds the gauges.
+        burn_by_window = st.burn.burn(now)
         summary = {
             "queue_depth": queue_depth,
             "inflight": inflight_total,
@@ -486,6 +532,9 @@ class ServeRouter:
             "errors": sum(l.errors for l in st.lanes),
             "rerouted": sum(l.rerouted for l in st.lanes),
             "dup_avoided": sum(l.dup_avoided for l in st.lanes),
+            "burn": burn_by_window.get(st.burn.fast_label, 0.0),
+            "burn_by_window": burn_by_window,
+            "spills": sum(l.io.ring_spills for l in st.lanes),
         }
         m = self.metrics
         if m is not None:
@@ -493,6 +542,8 @@ class ServeRouter:
             m.job_serve_inflight.set(inflight_total, job=key)
             m.job_serve_replicas.set(len(alive), job=key)
             m.job_serve_slots_free.set(slots_free, job=key)
+            for w, v in burn_by_window.items():
+                m.slo_burn_rate.set(v, job=key, window=w)
         if now - st.last_report > REPORT_EVERY_S:
             st.last_report = now
             self._report(status_dir, now, summary)
@@ -628,6 +679,7 @@ class ServeRouter:
         resp["queue_wait_ms"] = round(
             1000 * max(0.0, wait_end - f.submit_time), 3
         )
+        t_pub = time.time()
         with st.front_lock:
             won = st.front.respond_once(f.rid, resp)
         lane.io.publishes += 1
@@ -637,6 +689,28 @@ class ServeRouter:
                 lane.ok += 1
             else:
                 lane.errors += 1
+            if st.burn is not None:
+                # Budget event: an error, or a completion past the
+                # deadline, burns budget even though it was answered.
+                st.burn.record(
+                    t_pub,
+                    outcome == "error"
+                    or (
+                        st.slo is not None
+                        and st.slo.deadline_s > 0
+                        and t_pub - f.submit_time > st.slo.deadline_s
+                    ),
+                )
+            if _span_tracer() is not None:
+                # Terminal hop — emitted ONLY on the won branch, so a
+                # re-routed or replayed request gets exactly one
+                # publish span (respond_once is the dedup point for
+                # spans exactly as it is for responses).
+                serve_span(
+                    "publish", t_pub, time.time() - t_pub,
+                    rid=f.rid, outcome=outcome,
+                    replica=f.replica or "?", attempts=resp["attempts"],
+                )
             m = self.metrics
             if m is not None:
                 m.serve_requests.inc(job=key, outcome=outcome)
@@ -688,6 +762,13 @@ class ServeRouter:
             )
         if won:
             lane.shed += 1
+            if st.burn is not None:
+                st.burn.record(now, True)
+            if _span_tracer() is not None:
+                serve_span(
+                    "publish", now, 0.0,
+                    rid=rid, outcome="shed", decision=decision,
+                )
             if self.metrics is not None:
                 self.metrics.serve_requests.inc(job=key, outcome="shed")
         else:
@@ -713,6 +794,10 @@ class ServeRouter:
             won = st.front.respond_once(rid, resp)
         if won:
             lane.ok += 1
+            if st.burn is not None:
+                st.burn.record(
+                    time.time(), resp.get("error") is not None
+                )
         else:
             lane.dup_avoided += 1
         sp = self._stem_spool(key, stem)
@@ -825,18 +910,32 @@ class ServeRouter:
                     )
                 if won:
                     lane.errors += 1
+                    if st.burn is not None:
+                        st.burn.record(time.time(), True)
+                    if _span_tracer() is not None:
+                        serve_span(
+                            "publish", time.time(), 0.0,
+                            rid=f.rid, outcome="error",
+                            replica=f.replica, attempts=f.attempts,
+                        )
                     if self.metrics is not None:
                         self.metrics.serve_requests.inc(
                             job=key, outcome="error"
                         )
                 lane.inflight.pop(f.rid, None)
                 continue
+            dead_stem = f.replica
             f.replica = None
             f.via_ring = False
             # invariant: clock-discipline — retry gates are router-
             # internal deadlines, so they live on the monotonic axis.
             f.retry_at = time.monotonic() + st.backoff.delay(f.attempts - 1)
             lane.rerouted += 1
+            if _span_tracer() is not None:
+                serve_span(
+                    "reroute", time.time(), 0.0,
+                    rid=f.rid, from_replica=dead_stem, attempts=f.attempts,
+                )
             if self.metrics is not None:
                 self.metrics.serve_rerouted.inc(job=key)
         return moved
@@ -888,6 +987,15 @@ class ServeRouter:
                 in_flight=inflight_total,
                 now=now,
             )
+            if _span_tracer() is not None:
+                # Claim hop = front-queue wait (client submit → this
+                # lane's claim) plus the SLO verdict. The dup checks
+                # above run BEFORE this point, so a torn-batch replay
+                # or a cross-restart re-claim never re-emits it.
+                serve_span(
+                    "claim", submit_time, max(0.0, now - submit_time),
+                    rid=rid, decision=decision, lane=lane.index,
+                )
             if decision != ADMIT:
                 self._shed(key, st, lane, rid, decision, submit_time, now)
                 continue
@@ -964,9 +1072,17 @@ class ServeRouter:
                     continue
             if not alive:
                 continue  # keep; next pass may have replicas again
+            t_d = time.time()
             stem = min(alive, key=score)
             rec = dict(f.rec)
             rec["attempts"] = f.attempts + 1
+            tctx = rec.get("tctx")
+            if tctx is not None:
+                # invariant: clock-discipline — the transit stamp is
+                # read by the ENGINE process, so it must ride the only
+                # axis both sides share: the wall clock. Fresh dict —
+                # f.rec's tctx is aliased by the shallow copy above.
+                rec["tctx"] = dict(tctx, tx=time.time())
             f.via_ring = self._ring_send(st, lane, stem, rec)
             if not f.via_ring:
                 spill.setdefault(stem, []).append(rec)
@@ -979,6 +1095,18 @@ class ServeRouter:
                 lane.routed += 1
             outstanding[stem] = outstanding.get(stem, 0) + 1
             moved += 1
+            if _span_tracer() is not None:
+                # Lane-handoff hop: headroom scoring + the ring
+                # attempt. ``path`` says which tier carried it (the
+                # spill file itself is written after the loop, one
+                # batch per replica — its transit shows up as the
+                # engine-side spool_transit span).
+                serve_span(
+                    "dispatch", t_d, time.time() - t_d,
+                    rid=f.rid, replica=stem, lane=lane.index,
+                    path="ring" if f.via_ring else "spill",
+                    attempts=f.attempts,
+                )
         for stem, recs in spill.items():
             sp = alive.get(stem)
             if sp is None:
@@ -1054,6 +1182,8 @@ class ServeRouter:
             "transport": summary["transport"],
             "routed": summary["routed"],
             "shed": summary["shed"],
+            "burn": summary.get("burn", 0.0),
+            "spills": summary.get("spills", 0),
         }
         try:
             with open(d / "router.jsonl", "a") as fh:

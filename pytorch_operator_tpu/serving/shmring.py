@@ -57,6 +57,7 @@ from pathlib import Path
 from typing import List, Optional, Tuple
 
 from ..backoff import Backoff
+from ..obs.trace import serve_span, tracer as _span_tracer
 
 MAGIC = b"TPUJRING"
 VERSION = 1
@@ -279,6 +280,26 @@ class ShmRing:
         return out
 
 
+def prearm_rings(spool_root: Path | str, capacity: int = RING_BYTES) -> bool:
+    """Create the ring pair at replica SPAWN time (called by the
+    reconciler when it lays out a shmring replica's spool directory)
+    instead of at the router's first dispatch. The engine's idle loop
+    attaches the moment it starts, so the first request rides the
+    memory tier — this is what kills the first-second TTFT p99 warm-up
+    spike the ROADMAP carried. Idempotent: an existing pair is left
+    untouched (the router's later :class:`RouterRingPort` attach finds
+    it compatible). Returns True when either ring was created."""
+    root = Path(spool_root)
+    root.mkdir(parents=True, exist_ok=True)
+    created = False
+    for name in (REQ_RING, RESP_RING):
+        path = root / name
+        if not path.exists():
+            ShmRing.create(path, capacity).close()
+            created = True
+    return created
+
+
 def _encode(rec: dict) -> bytes:
     return json.dumps(rec, separators=(",", ":")).encode()
 
@@ -445,6 +466,22 @@ class EngineTransport:
                     + SPOOL_SCAN_BACKOFF.delay(self._spool_misses - 1)
                 )
             out.extend(recs)
+        if out and _span_tracer() is not None:
+            # Transit hop: the router stamped tctx["tx"] (wall clock —
+            # the only axis two processes share) just before handing
+            # the record to the ring or the spill file; receive time
+            # minus that stamp is the transit latency of whichever
+            # tier carried it.
+            now = time.time()
+            for i, rec in enumerate(out):
+                tx = (rec.get("tctx") or {}).get("tx")
+                if tx is not None:
+                    serve_span(
+                        "ring_transit" if i < from_ring else "spool_transit",
+                        float(tx),
+                        max(0.0, now - float(tx)),
+                        rid=rec.get("id", "?"),
+                    )
         return out, from_ring
 
     def respond(self, rid: str, record: dict) -> None:

@@ -22,13 +22,24 @@ Decisions:
 A shed request still gets a RESPONSE — an explicit overload record
 (``overload: true`` + the decision) published to the front spool, so
 exactly-once holds for shed traffic too.
+
+Error-budget accounting (:class:`BurnAccount`): every published
+outcome is also a budget event — shed, deadline-miss and error burn
+budget; a clean on-time response earns it. The burn RATE over a
+rolling window is ``bad_fraction / (1 - target)``: 1.0 means the job
+is spending its error budget exactly as fast as the SLO target earns
+it, 10.0 means ten times faster. The router exposes it as
+``tpujob_slo_burn_rate{job,window}`` gauges and a ``burn`` field on
+its serve records, which the shared ``slo_burn`` rule (obs/rules.py)
+judges on both the live (watch) and offline (why) surfaces.
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 ADMIT = "admit"
 SHED_DEPTH = "shed_depth"
@@ -36,15 +47,31 @@ SHED_DEADLINE = "shed_deadline"
 
 SHED_DECISIONS = (SHED_DEPTH, SHED_DEADLINE)
 
+# Availability target a serving spec gets when it asks for SLO
+# enforcement without naming one: 99% of published outcomes good.
+DEFAULT_SLO_TARGET = 0.99
+
+# Rolling burn horizons, (gauge window label, seconds). The FAST
+# window drives the serve-record ``burn`` field, the `tpujob top`
+# BURN column and the slo_burn rule — it is the reactive horizon, and
+# ``spec.serving.slo.burn_window_s`` overrides its width (a smoke
+# test wants ~1s; production wants the default). The slow window is
+# the long paging-style horizon, fixed.
+BURN_FAST_S = 30.0
+BURN_SLOW = ("5m", 300.0)
+
 
 @dataclass(frozen=True)
 class SLO:
     """Resolved admission bar (api.types.ServingSLOPolicy with the
-    Nones flattened). 0 disables the respective check."""
+    Nones flattened). 0 disables the respective check (``target``/
+    ``burn_window_s`` 0 mean "default" — burn is always accounted)."""
 
     max_queue_depth: int = 0
     deadline_s: float = 0.0
     retry_limit: int = 2
+    target: float = DEFAULT_SLO_TARGET
+    burn_window_s: float = BURN_FAST_S
 
     @classmethod
     def from_policy(cls, serving) -> "SLO":
@@ -52,10 +79,14 @@ class SLO:
         if serving is None or serving.slo is None:
             return cls()
         s = serving.slo
+        target = float(getattr(s, "target", 0.0) or 0.0)
+        window = float(getattr(s, "burn_window_s", 0.0) or 0.0)
         return cls(
             max_queue_depth=max(0, int(s.max_queue_depth)),
             deadline_s=max(0.0, float(s.deadline_s)),
             retry_limit=max(0, int(s.retry_limit)),
+            target=target if 0.0 < target < 1.0 else DEFAULT_SLO_TARGET,
+            burn_window_s=window if window > 0.0 else BURN_FAST_S,
         )
 
     def deadline_of(self, submit_time: float) -> Optional[float]:
@@ -86,6 +117,73 @@ def overload_response(
         "shed": decision,
         "queue_wait_ms": round(1000 * max(0.0, now - submit_time), 3),
     }
+
+
+class BurnAccount:
+    """Rolling error-budget burn for ONE job.
+
+    Events are (wall ts, bad) pairs: bad=1 for a shed, an error or a
+    deadline-missed completion; bad=0 for a clean on-time response.
+    ``burn(now)`` reports, per window, how fast the job is spending
+    its error budget relative to how fast the target earns it::
+
+        burn = (bad / total) / (1 - target)
+
+    so burn >= 1.0 over a sustained window means the budget is being
+    spent faster than the SLO allows — the firing bar of the shared
+    ``slo_burn`` rule. Empty windows burn 0 (no traffic spends no
+    budget).
+
+    Threading contract (matches the router's split): ``record`` is
+    called from lane worker threads (deque.append is atomic under the
+    GIL); pruning and ``burn`` run only on the tick thread.
+    """
+
+    __slots__ = ("target", "windows", "_events")
+
+    def __init__(
+        self,
+        target: float = DEFAULT_SLO_TARGET,
+        fast_window_s: float = BURN_FAST_S,
+    ):
+        self.target = target
+        fast_label = (
+            f"{fast_window_s:g}s"
+            if fast_window_s < 60
+            else f"{fast_window_s / 60:g}m"
+        )
+        self.windows: Tuple[Tuple[str, float], ...] = (
+            (fast_label, fast_window_s),
+            BURN_SLOW,
+        )
+        self._events: Deque[Tuple[float, int]] = deque()
+
+    def record(self, ts: float, bad: bool) -> None:
+        """Fold one published outcome (wall-clock ``ts``: outcomes come
+        from many processes, only the wall clock is shared)."""
+        self._events.append((ts, 1 if bad else 0))
+
+    def burn(self, now: float) -> Dict[str, float]:
+        """Per-window burn rates; prunes events past the slow horizon."""
+        horizon = now - max(s for _, s in self.windows)
+        ev = self._events
+        while ev and ev[0][0] < horizon:
+            ev.popleft()
+        budget = max(1e-9, 1.0 - self.target)
+        out: Dict[str, float] = {}
+        for label, width in self.windows:
+            cut = now - width
+            total = bad = 0
+            for ts, b in ev:
+                if ts >= cut:
+                    total += 1
+                    bad += b
+            out[label] = round((bad / total) / budget, 4) if total else 0.0
+        return out
+
+    @property
+    def fast_label(self) -> str:
+        return self.windows[0][0]
 
 
 def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:

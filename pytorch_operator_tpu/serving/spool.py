@@ -43,6 +43,7 @@ from pathlib import Path
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..backoff import Backoff
+from ..obs.trace import serve_span, tracer as _span_tracer
 
 # Batch files: many frames per spool file. ``.recovered.jsonb`` marks a
 # batch a crashed engine left in claimed/ and recover_claimed() moved
@@ -129,15 +130,31 @@ def make_request(
 
     ``prompt`` is an explicit token-id list; ``prompt_len`` asks the
     engine to synthesize a deterministic prompt of that length (no
-    tokenizer ships in this environment). Exactly one must be set."""
+    tokenizer ships in this environment). Exactly one must be set.
+
+    Every request carries a trace context frame field ``tctx`` —
+    ``{"o": origin wall ts, "p": parent span id}`` — threaded verbatim
+    through every hop (front spool → router lane → ring/spill →
+    engine) so each process can emit its hop span against the SAME
+    request identity. The parent span id is derived from the rid
+    (crc32, 8 hex) rather than drawn fresh: a replayed record after a
+    torn-batch recovery re-derives the identical id, so replay cannot
+    fork a request's waterfall. With tracing disabled the field is a
+    few bytes of dead weight per frame and nothing reads it."""
     if (prompt is None) == (prompt_len is None):
         raise ValueError("exactly one of prompt / prompt_len required")
+    rid = request_id or uuid.uuid4().hex[:12]
+    submit = time.time()
     return {
-        "id": request_id or uuid.uuid4().hex[:12],
+        "id": rid,
         "prompt": list(map(int, prompt)) if prompt is not None else None,
         "prompt_len": prompt_len,
         "max_new_tokens": int(max_new_tokens),
-        "submit_time": time.time(),
+        "submit_time": submit,
+        "tctx": {
+            "o": round(submit, 6),
+            "p": "%08x" % (zlib.crc32(rid.encode()) & 0xFFFFFFFF),
+        },
     }
 
 
@@ -206,11 +223,17 @@ class Spool:
         original ``submit_time``, which the engine's TTFT accounting is
         measured from)."""
         rid = rec["id"]
+        t0 = time.time()
         tmp = self.requests / f".{rid}.tmp"
         tmp.write_text(json.dumps(rec))
         self.io.creates += 1
         os.rename(tmp, self.requests / f"{rid}.json")
         self.io.renames += 1
+        # Client-enqueue hop span. Dispatch copies the router spills to
+        # a REPLICA spool carry "attempts" — those get a dispatch span
+        # at the router instead, never a second enqueue.
+        if _span_tracer() is not None and "tctx" in rec and "attempts" not in rec:
+            serve_span("enqueue", t0, time.time() - t0, rid=rid)
         return rid
 
     def enqueue_batch(self, recs: List[dict], fsync: bool = True) -> List[str]:
@@ -221,6 +244,7 @@ class Spool:
         if not recs:
             return []
         rids = [rec["id"] for rec in recs]
+        t0 = time.time()
         bid = uuid.uuid4().hex[:12]
         tmp = self.requests / f".b-{bid}.tmp"
         with open(tmp, "wb") as fh:
@@ -232,6 +256,11 @@ class Spool:
         self.io.creates += 1
         os.rename(tmp, self.requests / f"b-{bid}{BATCH_SUFFIX}")
         self.io.renames += 1
+        if _span_tracer() is not None:
+            dur = time.time() - t0
+            for rec in recs:
+                if "tctx" in rec and "attempts" not in rec:
+                    serve_span("enqueue", t0, dur, rid=rec["id"], batch=len(recs))
         return rids
 
     def wait_response(self, request_id: str, timeout: float = 60.0) -> dict:

@@ -26,6 +26,7 @@ import sys
 import time
 
 from .. import faults
+from ..obs.trace import serve_span, tracer as _span_tracer
 from ..runtime import rendezvous
 
 
@@ -102,6 +103,10 @@ def run(
     last_activity = time.time()
     last_report = 0.0
     synth_rng = np.random.default_rng(seed)
+    # Engine-claim wall times by rid, for the slot_wait/decode hop
+    # spans (populated only while tracing is enabled — with it off the
+    # dict stays empty and the serve path allocates nothing extra).
+    claims: dict = {}
 
     def to_request(rec: dict) -> Request:
         if rec.get("prompt") is not None:
@@ -127,6 +132,8 @@ def run(
 
     def finish(res) -> None:
         nonlocal served, last_activity
+        traced = _span_tracer() is not None
+        t_resp = time.time() if traced else 0.0
         spool.respond(
             res.id,
             {
@@ -142,6 +149,25 @@ def run(
                 ),
             },
         )
+        if traced:
+            info = claims.pop(res.id, None)
+            if info is not None:
+                claim_ts, submit = info
+                # The engine's own latency record anchors the hops:
+                # admit_wait_s / ttft_s are measured from the client's
+                # submit_time, which is wall clock — same axis.
+                admit_t = submit + res.admit_wait_s
+                serve_span(
+                    "slot_wait", claim_ts,
+                    max(0.0, admit_t - claim_ts), rid=res.id,
+                )
+                serve_span(
+                    "decode", admit_t,
+                    max(0.0, res.finish_time - admit_t),
+                    rid=res.id, tokens=len(res.tokens),
+                )
+                serve_span("respond", t_resp, time.time() - t_resp,
+                           rid=res.id)
         served += 1
         last_activity = time.time()
 
@@ -151,10 +177,14 @@ def run(
         polled, _ = spool.poll_requests(2 * slots - engine.queued)
         for rec in polled:
             try:
-                engine.submit(to_request(rec))
+                req = to_request(rec)
+                if _span_tracer() is not None:
+                    claims[req.id] = (time.time(), req.submit_time)
+                engine.submit(req)
                 last_activity = time.time()
             except (ValueError, KeyError, TypeError) as e:
                 rejected += 1
+                claims.pop(rec.get("id"), None)
                 spool.respond(rec.get("id", "unknown"), {"error": str(e)})
         if engine.busy:
             try:
@@ -168,6 +198,7 @@ def run(
                 # untouched, the engine keeps serving.
                 aborted = engine.abort_in_flight()
                 for rid in aborted:
+                    claims.pop(rid, None)
                     spool.respond(rid, {"id": rid, "error": f"engine fault: {e}"})
                 rejected += len(aborted)
                 log(

@@ -29,6 +29,7 @@ from typing import List, Optional
 
 from .. import faults
 from ..backoff import Backoff
+from ..obs.trace import serve_span, tracer as _span_tracer
 from ..runtime import rendezvous
 from ..serving.shmring import EngineTransport
 
@@ -78,6 +79,9 @@ def run(
     idle_polls = 0
 
     while True:
+        # One cached-None check per loop; with tracing disabled every
+        # span site below is skipped and no per-request fields change.
+        traced = _span_tracer() is not None
         polled, _ = spool.poll_requests(slots - len(active))
         if polled:
             idle_polls = 0
@@ -93,6 +97,10 @@ def run(
                     "tokens": [],
                     "submit_time": float(rec.get("submit_time", now)),
                     "ttft_ms": None,
+                    # Engine-claim time: the slot_wait hop runs from
+                    # here to the first decode block this request rides.
+                    "claim_ts": now,
+                    "decode_start": None,
                 }
             )
             last_activity = now
@@ -115,6 +123,15 @@ def run(
                 )
                 active = []
                 continue
+            if traced:
+                t_blk = time.time()
+                for a in active:
+                    if a["decode_start"] is None:
+                        a["decode_start"] = t_blk
+                        serve_span(
+                            "slot_wait", a["claim_ts"],
+                            max(0.0, t_blk - a["claim_ts"]), rid=a["id"],
+                        )
             time.sleep(step_s)  # one decode block across the whole batch
             now = time.time()
             still: List[dict] = []
@@ -130,6 +147,7 @@ def run(
                 if a["remaining"] > 0:
                     still.append(a)
                     continue
+                t_resp = time.time() if traced else 0.0
                 spool.respond(
                     a["id"],
                     {
@@ -139,6 +157,16 @@ def run(
                         "tpot_ms": round(tpot_ms, 3),
                     },
                 )
+                if traced:
+                    ds = a["decode_start"] or a["claim_ts"]
+                    serve_span(
+                        "decode", ds, max(0.0, t_resp - ds),
+                        rid=a["id"], tokens=len(a["tokens"]),
+                    )
+                    serve_span(
+                        "respond", t_resp, time.time() - t_resp,
+                        rid=a["id"],
+                    )
                 served += 1
                 ttfts.append(a["ttft_ms"])
                 last_activity = now

@@ -73,12 +73,17 @@ def _make_serve_job(
     retry_limit: int,
     transport: str = "spool",
     router_shards: int = 0,
+    slo_target: float = 0.0,
+    burn_window_s: float = 0.0,
+    alerts: Optional[dict] = None,
 ):
     """A serving job of ``replicas`` engine replicas: Master(1) +
     Worker(replicas-1) — validation pins Master at exactly one, and the
     router treats every active handle as an engine regardless of type."""
     from ..api.types import (
+        AlertPolicy,
         ObjectMeta,
+        ObservabilityPolicy,
         ProcessTemplate,
         ReplicaSpec,
         ReplicaType,
@@ -120,9 +125,16 @@ def _make_serve_job(
                     max_queue_depth=max_queue_depth,
                     deadline_s=deadline_s,
                     retry_limit=retry_limit,
+                    target=slo_target,
+                    burn_window_s=burn_window_s,
                 ),
                 transport=transport,
                 router_shards=router_shards,
+            ),
+            observability=(
+                ObservabilityPolicy(alerts=AlertPolicy(**alerts))
+                if alerts
+                else None
             ),
         ),
     )
@@ -146,16 +158,24 @@ def bench_cell(
     router_shards: int = 0,
     label: Optional[str] = None,
     seed: int = 7,
+    slo_target: float = 0.0,
+    burn_window_s: float = 0.0,
+    alerts: Optional[dict] = None,
     log=print,
 ) -> dict:
     """One (replicas, scenario) cell through the full serve plane."""
     from .. import faults
     from ..controller.store import key_to_fs
     from ..controller.supervisor import Supervisor
+    from ..obs.trace import records_emitted
     from ..serving import Spool, make_request
     from ..serving.router import front_spool_dir, serve_root_dir
     from ..serving.slo import SLOStats
 
+    # The serve-path zero-overhead pin: tracing is off in the bench
+    # (no TPUJOB_TRACE_DIR), so this process — client enqueues plus the
+    # supervisor-hosted router — must emit exactly zero span records.
+    span_records0 = records_emitted()
     sup = Supervisor(state_dir=state_dir, poll_interval=0.02)
     stop = threading.Event()
     pump_errors: List[str] = []
@@ -198,6 +218,9 @@ def bench_cell(
             retry_limit=retry_limit,
             transport=transport,
             router_shards=router_shards,
+            slo_target=slo_target,
+            burn_window_s=burn_window_s,
+            alerts=alerts,
         )
         key = sup.submit(job)
         pump_thread.start()
@@ -260,6 +283,10 @@ def bench_cell(
         end = start + duration
         t_next = start
         rids: List[str] = []
+        # Warm-up tracking: the rids submitted inside the FIRST second
+        # of the window — their TTFT tail is where a cold transport
+        # (ring files created at first dispatch) used to spike.
+        early_rids: set = set()
         while True:
             now = time.time()
             if now >= end:
@@ -274,6 +301,8 @@ def bench_cell(
                                  max_new_tokens=max_new_tokens)
                 )
                 t_next += rng.expovariate(rate)
+            if now - start <= 1.0:
+                early_rids.update(r["id"] for r in due)
             if len(due) == 1:
                 front.enqueue(due[0])
                 rids.append(due[0]["id"])
@@ -286,6 +315,7 @@ def bench_cell(
         # the collection loop stays O(responses) however large the
         # saturation cell's in-flight population gets.
         pending = set(rids)
+        early_ttfts: List[float] = []
         collect_deadline = time.monotonic() + deadline_s + max(30.0, 4 * duration)
         while pending and time.monotonic() < collect_deadline:
             done = []
@@ -303,6 +333,8 @@ def bench_cell(
                 if resp is not None:
                     stats.account(resp)
                     done.append(rid)
+                    if rid in early_rids and resp.get("ttft_ms") is not None:
+                        early_ttfts.append(float(resp["ttft_ms"]))
             pending.difference_update(done)
             if pending:
                 time.sleep(0.02)
@@ -365,6 +397,14 @@ def bench_cell(
             "lost": lost,
             "job_finished": finished,
             "router_io": sup.router.io_snapshot(),
+            "span_records": records_emitted() - span_records0,
+            "first_second_ttft_p99_ms": (
+                round(_percentile(early_ttfts, 0.99), 1)
+                if early_ttfts
+                else None
+            ),
+            "first_second_n": len(early_ttfts),
+            "job_key": key,
             "pump_errors": len(pump_errors),
             "ttft_p99_bound_ms": round(bound_ms, 1),
             "ttft_p99_bounded": (
@@ -372,6 +412,17 @@ def bench_cell(
                 or summary["ttft_ms_p99"] <= bound_ms
             ),
         }
+        if alerts:
+            # The live watch's verdicts for this cell, straight from
+            # the on-disk transition log — the burn-smoke lifecycle
+            # (pending -> firing -> resolved) reads off this list.
+            from ..obs.watch import load_alert_log
+
+            cell["slo_burn_transitions"] = [
+                r.get("state")
+                for r in load_alert_log(state_dir, key)
+                if r.get("rule") == "slo_burn"
+            ]
         log(
             f"[serveplane] {cell_name:>20s} "
             f"offered={cell['offered']:4d} ok={cell['ok']:4d} "
@@ -462,6 +513,57 @@ def bench_idle_overhead(
         sup.shutdown()
 
 
+def bench_burn_smoke(state_dir: Path, log=print) -> dict:
+    """Sustained overload against a tight SLO: offered rate ~2.6x one
+    replica's capacity with a 150 ms deadline, so deadline/depth sheds
+    burn the error budget hard. Pins the burn-rate alert lifecycle:
+    ``slo_burn`` FIRES while the budget drains (for_s hysteresis), then
+    RESOLVES once the load stops and the 1 s fast window decays — both
+    transitions land in the on-disk alert log that ``tpujob alerts``
+    and ``tpujob why`` read."""
+    cell = bench_cell(
+        1,
+        "healthy",
+        rate=260.0,
+        duration=1.5,
+        slots=4,
+        tpot_ms=10.0,
+        max_new_tokens=4,
+        max_queue_depth=32,
+        deadline_s=0.15,
+        retry_limit=1,
+        idle_timeout=4.0,
+        state_dir=state_dir,
+        label="burn_smoke",
+        slo_target=0.99,
+        # A 1 s fast window (vs the 30 s default) so the burn decays —
+        # and the alert resolves — inside the cell's own teardown.
+        burn_window_s=1.0,
+        alerts={
+            "for_s": 0.5,
+            "clear_s": 0.6,
+            "thresholds": {"slo_burn_samples": 2},
+        },
+        log=log,
+    )
+    states = cell.get("slo_burn_transitions", [])
+    cell["burn_alert_fired"] = "firing" in states
+    cell["burn_alert_resolved"] = "resolved" in states
+    # Offline parity: the postmortem reads the SAME alert log, so
+    # `tpujob why` tells the story after the job is gone.
+    from ..obs import analyze as obs_analyze
+
+    report = obs_analyze.analyze(state_dir, cell["job_key"])
+    cell["why_cites_slo_burn"] = any(
+        a.get("rule") == "slo_burn" for a in report.get("alerts", [])
+    )
+    log(
+        f"[serveplane] burn smoke: shed={cell['shed']} "
+        f"transitions={states} why_cites={cell['why_cites_slo_burn']}"
+    )
+    return cell
+
+
 # Router-saturation profile defaults: per-replica capacity is cranked
 # far past the offered rate (slots/(max_new_tokens*tpot_ms) = 2000
 # rps/replica), so the cell measures the ROUTING path — sharded
@@ -497,6 +599,7 @@ def run(
     idle_jobs: int = 20,
     idle_passes: int = 30,
     saturation: Optional[dict] = None,
+    burn_smoke: bool = False,
     out: Optional[str] = None,
     work_dir: Optional[str] = None,
     seed: int = 7,
@@ -560,6 +663,12 @@ def run(
                 cell["profile"] = "saturation"
                 sat_cells.append(cell)
         cells.extend(sat_cells)
+    burn_cell: Optional[dict] = None
+    if burn_smoke:
+        with tempfile.TemporaryDirectory(
+            prefix="serveplane-burn-", dir=work_dir
+        ) as td:
+            burn_cell = bench_burn_smoke(Path(td) / "state", log=log)
     with tempfile.TemporaryDirectory(
         prefix="serveplane-idle-", dir=work_dir
     ) as td:
@@ -580,7 +689,32 @@ def run(
         "idle_router_io_zero": (
             idle["router_io_total"] == 0 and not idle["serve_dir_exists"]
         ),
+        # The serve-path extension of the zero-overhead pin: with
+        # tracing disabled (the bench never sets TPUJOB_TRACE_DIR),
+        # client enqueues + the router emit ZERO span records.
+        "span_records_total": sum(c.get("span_records", 0) for c in cells),
+        "tracing_disabled_zero_span_records": all(
+            c.get("span_records", 0) == 0 for c in cells
+        ),
     }
+    # Warm-up: rings are pre-armed at replica SPAWN (reconciler), so
+    # the first second of a shmring cell must not pay ring creation
+    # in its TTFT tail.
+    warm_cells = [
+        c
+        for c in cells
+        if c["transport"] == "shmring"
+        and c["scenario"] == "healthy"
+        and c.get("first_second_ttft_p99_ms") is not None
+    ]
+    if warm_cells:
+        w = warm_cells[0]
+        comparisons["warmup"] = {
+            "cell": w["cell"],
+            "first_second_ttft_p99_ms": w["first_second_ttft_p99_ms"],
+            "first_second_n": w["first_second_n"],
+            "rings_prearmed_at_spawn": True,
+        }
     acceptance: Optional[dict] = None
     if len(healthy) >= 2:
         lo_n, hi_n = min(healthy), max(healthy)
@@ -663,6 +797,13 @@ def run(
         "comparisons": comparisons,
         "acceptance": acceptance,
     }
+    if burn_cell is not None:
+        result["burn_smoke"] = burn_cell
+        comparisons["slo_burn_lifecycle"] = {
+            "fired": burn_cell["burn_alert_fired"],
+            "resolved": burn_cell["burn_alert_resolved"],
+            "why_cites_slo_burn": burn_cell["why_cites_slo_burn"],
+        }
     if out:
         Path(out).write_text(json.dumps(result, indent=2) + "\n")
         log(f"[serveplane] wrote {out}")
@@ -705,6 +846,12 @@ def main(argv=None) -> int:
         help="skip the router-saturation cells (shmring + sharded "
         "router at memory-speed offered load)",
     )
+    p.add_argument(
+        "--no-burn",
+        action="store_true",
+        help="skip the SLO burn-rate smoke cell (sustained overload "
+        "driving the slo_burn alert through fire -> resolve)",
+    )
     p.add_argument("--seed", type=int, default=7)
     p.add_argument(
         "--smoke",
@@ -742,6 +889,7 @@ def main(argv=None) -> int:
         idle_jobs=args.idle_jobs,
         idle_passes=args.idle_passes,
         saturation=None if args.no_saturation else {},
+        burn_smoke=not args.no_burn,
         seed=args.seed,
         out=args.out,
         work_dir=args.work_dir,

@@ -1,0 +1,605 @@
+"""Request-level serve-plane tracing + SLO burn-rate accounting.
+
+The observability PR's tier-1 pins:
+
+- a trace context rides every request frame (rid + origin ts + a
+  DETERMINISTIC parent span id, so replay cannot fork a waterfall) and
+  is a few bytes of dead weight when tracing is off;
+- the serve path emits one span per hop — enqueue, claim, dispatch,
+  ring/spool transit, slot wait, decode, respond, publish — and
+  ``tpujob trace --request`` renders them as one causal waterfall;
+- chaos keeps the waterfall coherent: a replica killed mid-request
+  re-routes with a visible ``reroute`` hop and exactly ONE terminal
+  ``publish`` span; a recovered batch replay does not duplicate
+  request spans — on the file spool and the shm-ring tier both;
+- zero overhead when disabled: the serve path emits exactly zero span
+  records without ``TPUJOB_TRACE_DIR`` (the bench_smoke pin extended
+  from the step path);
+- ``BurnAccount`` error-budget math, the ``slo_burn`` detector (tail
+  semantics), and the live pending -> firing -> resolved lifecycle
+  with offline ``tpujob why`` parity;
+- per-lane RouterIOCounters stay monotonic across job retirement (the
+  Prometheus counter fold reads them as totals);
+- ``prearm_rings`` creates the ring pair at replica spawn so first
+  dispatch never pays ring creation.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from pytorch_operator_tpu import obs
+from pytorch_operator_tpu.api.types import ReplicaType
+from pytorch_operator_tpu.obs import trace as obs_trace
+from pytorch_operator_tpu.obs.rules import (
+    DEFAULT_THRESHOLDS,
+    Thresholds,
+    detect_slo_burn,
+)
+from pytorch_operator_tpu.serving import Spool, make_request
+from pytorch_operator_tpu.serving.router import (
+    PER_LANE_KEYS,
+    ServeRouter,
+    front_spool_dir,
+    replica_spool_dir,
+    serve_root_dir,
+)
+from pytorch_operator_tpu.serving.shmring import (
+    EngineRingPort,
+    EngineTransport,
+    prearm_rings,
+)
+from pytorch_operator_tpu.serving.slo import SLO, BurnAccount
+from pytorch_operator_tpu.workloads import serveplane_bench
+
+pytestmark = pytest.mark.bench_smoke
+
+
+@pytest.fixture
+def traced_dir(tmp_path, monkeypatch):
+    """Arm the process tracer at a tmp dir; disarm + re-cache on exit."""
+    d = tmp_path / "trace"
+    monkeypatch.setenv(obs_trace.ENV_VAR, str(d))
+    obs_trace.reset_tracer()
+    yield d
+    monkeypatch.delenv(obs_trace.ENV_VAR, raising=False)
+    obs_trace.reset_tracer()
+
+
+class _Handle:
+    def __init__(self, rtype=ReplicaType.MASTER, index=0, active=True):
+        self.replica_type = rtype
+        self.index = index
+        self._active = active
+
+    def is_active(self):
+        return self._active
+
+
+def _handles(n):
+    out = [_Handle(ReplicaType.MASTER, 0)]
+    out += [_Handle(ReplicaType.WORKER, i) for i in range(n - 1)]
+    return out
+
+
+def _job(replicas=1, transport="spool", **kw):
+    return serveplane_bench._make_serve_job(
+        "svc", replicas, slots=4, tpot_ms=10.0, idle_timeout=0.0,
+        max_queue_depth=kw.get("max_queue_depth", 0),
+        deadline_s=kw.get("deadline_s", 0.0),
+        retry_limit=kw.get("retry_limit", 3),
+        transport=transport,
+        slo_target=kw.get("slo_target", 0.0),
+        burn_window_s=kw.get("burn_window_s", 0.0),
+    )
+
+
+def _flush_spans():
+    rec = obs_trace.tracer()
+    if rec is not None:
+        rec.flush()
+
+
+def _spans(trace_dir, name=None, rid=None):
+    out = []
+    for p in obs_trace.span_files(trace_dir):
+        for e in obs_trace.load_span_file(p):
+            if e.get("ph") != "X":
+                continue
+            if name is not None and e.get("name") != name:
+                continue
+            if rid is not None and (e.get("args") or {}).get("rid") != rid:
+                continue
+            out.append(e)
+    return out
+
+
+# ---- trace context on the frame ----
+
+
+class TestTraceContext:
+    def test_request_carries_deterministic_context(self):
+        rec = make_request(prompt_len=2, max_new_tokens=4)
+        tctx = rec["tctx"]
+        assert abs(tctx["o"] - rec["submit_time"]) < 1e-5
+        # Deterministic parent span id: the same rid always derives the
+        # same id, so a replayed frame cannot fork the waterfall.
+        import zlib
+
+        assert tctx["p"] == "%08x" % (
+            zlib.crc32(rec["id"].encode()) & 0xFFFFFFFF
+        )
+
+    def test_dispatch_stamps_transit_time(self, tmp_path):
+        """The router stamps ``tx`` (wall clock — the engine lives in
+        another process) on a FRESH dict, leaving the claimed frame's
+        own context unmodified."""
+        state = tmp_path / "state"
+        key = "default/svc"
+        job = _job(transport="shmring")
+        router = ServeRouter(state)
+        front = Spool(front_spool_dir(serve_root_dir(state), key, job.spec.serving))
+        front.submit(prompt_len=2, max_new_tokens=4)
+        t0 = time.time()
+        router.tick(key, job, _handles(1), {})
+        eng = EngineRingPort.attach(
+            replica_spool_dir(serve_root_dir(state), key, "Master", 0)
+        )
+        (req,) = eng.recv()
+        assert req["tctx"]["tx"] >= t0 - 0.001
+        assert "o" in req["tctx"] and "p" in req["tctx"]
+        eng.close()
+        router.close()
+
+
+# ---- zero overhead when disabled ----
+
+
+class TestZeroOverheadServePath:
+    def test_serve_path_emits_no_spans_without_trace_dir(self, tmp_path):
+        """The bench_smoke zero-overhead pin, serve-path edition: a
+        full request lifecycle — enqueue, claim, dispatch, engine poll,
+        respond, publish — emits exactly ZERO span records when tracing
+        is disabled."""
+        assert obs_trace.tracer() is None
+        before = obs_trace.records_emitted()
+        state = tmp_path / "state"
+        key = "default/svc"
+        job = _job(transport="shmring")
+        router = ServeRouter(state)
+        front = Spool(front_spool_dir(serve_root_dir(state), key, job.spec.serving))
+        rid = front.submit(prompt_len=2, max_new_tokens=4)
+        front.enqueue_batch(
+            [make_request(prompt_len=2, max_new_tokens=4) for _ in range(3)]
+        )
+        router.tick(key, job, _handles(1), {})
+        et = EngineTransport(
+            replica_spool_dir(serve_root_dir(state), key, "Master", 0),
+            "shmring",
+        )
+        recs, _ = et.poll_requests(8)
+        assert recs
+        for r in recs:
+            et.respond(r["id"], {"id": r["id"], "tokens": [1], "ttft_ms": 1.0})
+        time.sleep(0.02)
+        router.tick(key, job, _handles(1), {})
+        assert front.has_response(rid)
+        assert obs_trace.records_emitted() == before
+        et.close()
+        router.close()
+
+
+# ---- the waterfall, both transports ----
+
+
+class TestWaterfall:
+    @pytest.mark.parametrize("transport", ["spool", "shmring"])
+    def test_full_hop_chain_one_publish(self, tmp_path, traced_dir, transport):
+        """One traced request crosses >= 5 distinct hops, every span
+        carries the rid, and the terminal ``publish`` span exists
+        exactly once."""
+        state = tmp_path / "state"
+        key = "default/svc"
+        job = _job(transport=transport)
+        router = ServeRouter(state)
+        front = Spool(front_spool_dir(serve_root_dir(state), key, job.spec.serving))
+        rid = front.enqueue(make_request(prompt_len=2, max_new_tokens=4))
+        router.tick(key, job, _handles(1), {})
+        et = EngineTransport(
+            replica_spool_dir(serve_root_dir(state), key, "Master", 0),
+            transport,
+        )
+        (req,), _ = et.poll_requests(8)
+        assert req["id"] == rid
+        et.respond(rid, {"id": rid, "tokens": [1], "ttft_ms": 1.0})
+        time.sleep(0.02)
+        router.tick(key, job, _handles(1), {})
+        assert front.has_response(rid)
+        et.close()
+        router.close()
+        _flush_spans()
+
+        spans = _spans(traced_dir, rid=rid)
+        names = [s["name"] for s in spans]
+        transit = "ring_transit" if transport == "shmring" else "spool_transit"
+        for hop in ("enqueue", "claim", "dispatch", transit, "publish"):
+            assert hop in names, (hop, names)
+        assert len(set(names)) >= 5
+        assert names.count("publish") == 1
+        assert names.count("enqueue") == 1
+        (pub,) = [s for s in spans if s["name"] == "publish"]
+        assert pub["args"]["outcome"] == "ok"
+
+    def test_cli_waterfall_renders_hops_in_clock_order(self, tmp_path, traced_dir):
+        from pytorch_operator_tpu.client.cli import _render_request_waterfall
+        from pytorch_operator_tpu.obs.trace import merge_trace_files, span_files
+
+        state = tmp_path / "state"
+        key = "default/svc"
+        job = _job(transport="shmring")
+        router = ServeRouter(state)
+        front = Spool(front_spool_dir(serve_root_dir(state), key, job.spec.serving))
+        rid = front.enqueue(make_request(prompt_len=2, max_new_tokens=4))
+        router.tick(key, job, _handles(1), {})
+        et = EngineTransport(
+            replica_spool_dir(serve_root_dir(state), key, "Master", 0),
+            "shmring",
+        )
+        (req,), _ = et.poll_requests(8)
+        et.respond(rid, {"id": rid, "tokens": [1], "ttft_ms": 1.0})
+        time.sleep(0.02)
+        router.tick(key, job, _handles(1), {})
+        et.close()
+        router.close()
+        _flush_spans()
+
+        doc = merge_trace_files(span_files(traced_dir))
+        text = _render_request_waterfall(doc, rid)
+        assert text is not None
+        lines = text.splitlines()
+        assert rid in lines[0]
+        hop_lines = lines[1:]
+        assert len(hop_lines) >= 5
+        # Offsets are monotonic: the waterfall reads top-to-bottom in
+        # causal order on one clock axis.
+        offs = [float(ln.split("ms")[0]) for ln in hop_lines]
+        assert offs == sorted(offs)
+        assert offs[0] == 0.0
+        assert _render_request_waterfall(doc, "no-such-rid") is None
+
+
+# ---- chaos keeps the waterfall coherent ----
+
+
+class TestChaosPropagation:
+    @pytest.mark.parametrize("transport", ["spool", "shmring"])
+    def test_kill_reroute_one_coherent_waterfall(
+        self, tmp_path, traced_dir, transport
+    ):
+        """A replica dies after consuming the request: the re-route to
+        the survivor appears as a ``reroute`` hop and the waterfall
+        still ends in exactly ONE terminal publish span."""
+        state = tmp_path / "state"
+        key = "default/svc"
+        job = _job(replicas=2, transport=transport)
+        router = ServeRouter(state)
+        front = Spool(front_spool_dir(serve_root_dir(state), key, job.spec.serving))
+        rid = front.enqueue(make_request(prompt_len=2, max_new_tokens=4))
+        handles = _handles(2)
+        router.tick(key, job, handles, {})
+
+        # Find the replica that got it, consume there, then kill it.
+        serve_root = serve_root_dir(state)
+        victim = None
+        ports = []
+        for h in handles:
+            et = EngineTransport(
+                replica_spool_dir(serve_root, key, h.replica_type.value, h.index),
+                transport,
+            )
+            ports.append((h, et))
+            recs, _ = et.poll_requests(8)
+            if recs:
+                victim = h
+        assert victim is not None
+        victim._active = False
+
+        survivor = next(h for h in handles if h is not victim)
+        surv_et = next(et for h, et in ports if h is survivor)
+        redelivered = None
+        deadline = time.monotonic() + 5.0
+        while redelivered is None and time.monotonic() < deadline:
+            router.tick(key, job, handles, {})
+            recs, _ = surv_et.poll_requests(8)
+            for r in recs:
+                if r["id"] == rid:
+                    redelivered = r
+            time.sleep(0.02)
+        assert redelivered is not None
+        surv_et.respond(rid, {"id": rid, "tokens": [5], "ttft_ms": 2.0})
+        deadline = time.monotonic() + 5.0
+        while not front.has_response(rid) and time.monotonic() < deadline:
+            router.tick(key, job, handles, {})
+            time.sleep(0.02)
+        assert front.has_response(rid)
+        for _, et in ports:
+            et.close()
+        router.close()
+        _flush_spans()
+
+        spans = _spans(traced_dir, rid=rid)
+        names = [s["name"] for s in spans]
+        assert names.count("publish") == 1, names
+        assert names.count("reroute") == 1, names
+        assert names.count("enqueue") == 1, names
+        assert names.count("dispatch") == 2, names  # original + re-drive
+        (rr,) = [s for s in spans if s["name"] == "reroute"]
+        assert rr["args"]["attempts"] >= 1
+
+    def test_recovered_batch_replay_no_duplicate_spans(self, tmp_path, traced_dir):
+        """Engine-restart replay: recover_claimed() re-queues a claimed
+        batch; the re-claim must not re-emit client enqueue spans, and
+        the already-answered record keeps its single span set."""
+        sp = Spool(tmp_path / "spool")
+        recs = [make_request(prompt_len=2, max_new_tokens=2) for _ in range(3)]
+        rids = sp.enqueue_batch(recs)
+        got = sp.claim(8)
+        assert len(got) == 3
+        sp.respond(rids[0], {"id": rids[0], "tokens": [1]})
+        assert sp.recover_claimed() >= 1
+        again = sp.claim(8)
+        assert sorted(r["id"] for r in again) == sorted(rids[1:])
+        _flush_spans()
+        enq = _spans(traced_dir, name="enqueue")
+        assert sorted((e["args"] or {})["rid"] for e in enq) == sorted(rids)
+        assert len(enq) == 3  # one per client write, replay added none
+
+    def test_router_spill_copy_does_not_reemit_enqueue(self, tmp_path, traced_dir):
+        """The router's file-spill dispatch reuses Spool.enqueue for
+        the replica spool; those frames carry ``attempts`` and must not
+        masquerade as client enqueues."""
+        state = tmp_path / "state"
+        key = "default/svc"
+        job = _job(transport="spool")
+        router = ServeRouter(state)
+        front = Spool(front_spool_dir(serve_root_dir(state), key, job.spec.serving))
+        rid = front.enqueue(make_request(prompt_len=2, max_new_tokens=4))
+        router.tick(key, job, _handles(1), {})  # dispatch = spool spill
+        router.close()
+        _flush_spans()
+        enq = _spans(traced_dir, name="enqueue", rid=rid)
+        assert len(enq) == 1
+
+
+# ---- burn accounting ----
+
+
+class TestBurnAccount:
+    def test_burn_math_and_window_decay(self):
+        acc = BurnAccount(target=0.99, fast_window_s=1.0)
+        assert acc.fast_label == "1s"
+        assert [w for w, _ in acc.windows] == ["1s", "5m"]
+        t = 1000.0
+        for i in range(10):
+            acc.record(t + i * 0.1, bad=(i % 2 == 0))  # 5 bad / 10
+        burn = acc.burn(t + 1.0)
+        assert burn["1s"] == pytest.approx(50.0, rel=0.01)
+        # After the fast window passes the events, its burn decays to 0
+        # while the 5m window still sees them.
+        later = acc.burn(t + 3.0)
+        assert later["1s"] == 0.0
+        assert later["5m"] > 0.0
+
+    def test_all_good_is_zero_and_empty_is_zero(self):
+        acc = BurnAccount(target=0.99, fast_window_s=30.0)
+        assert acc.fast_label == "30s"
+        assert acc.burn(100.0) == {"30s": 0.0, "5m": 0.0}
+        acc.record(100.0, bad=False)
+        assert acc.burn(100.5)["30s"] == 0.0
+
+    def test_slo_from_policy_resolves_target_and_window(self):
+        job = _job(slo_target=0.999, burn_window_s=5.0, deadline_s=1.0)
+        slo = SLO.from_policy(job.spec.serving)
+        assert slo.target == 0.999
+        assert slo.burn_window_s == 5.0
+        # Unset (0.0) falls back to the defaults.
+        slo2 = SLO.from_policy(_job().spec.serving)
+        assert slo2.target == 0.99
+        assert slo2.burn_window_s == 30.0
+
+    def test_router_tick_surfaces_burn_and_spills(self, tmp_path):
+        """Overload against a depth-1 bar: sheds burn the budget and
+        the tick summary carries burn + per-window breakdown."""
+        state = tmp_path / "state"
+        key = "default/svc"
+        job = _job(max_queue_depth=1, slo_target=0.99, burn_window_s=30.0)
+        router = ServeRouter(state)
+        front = Spool(front_spool_dir(serve_root_dir(state), key, job.spec.serving))
+        for _ in range(6):
+            front.submit(prompt_len=2, max_new_tokens=4)
+        summary = router.tick(key, job, _handles(1), {})
+        assert summary["shed"] == 5
+        assert summary["burn"] > 1.0
+        assert set(summary["burn_by_window"]) == {"30s", "5m"}
+        assert summary["spills"] == 0
+        router.close()
+
+
+# ---- the slo_burn rule: offline detector + live lifecycle ----
+
+
+def _serve_rec(ts, burn, shed=0):
+    return {
+        "replica": "router", "ts": ts, "aligned_ts": ts,
+        "burn": burn, "shed": shed, "queue_depth": 0.0,
+    }
+
+
+class _View:
+    window_s = None
+
+    def __init__(self, recs):
+        self.records = {"serve": recs}
+
+    def in_window(self, ts):
+        return True
+
+    def find_event(self, *reasons):
+        return None
+
+
+class TestSloBurnRule:
+    def test_fires_on_sustained_tail_only(self):
+        hot = [_serve_rec(float(i), 3.0, shed=2) for i in range(4)]
+        (f,) = detect_slo_burn(_View(hot), DEFAULT_THRESHOLDS)
+        assert f.rule == "slo_burn"
+        assert f.severity == "critical"  # 3.0 >= 2x threshold
+        assert f.metrics["burn_peak"] == 3.0
+        # Tail semantics: a past episode followed by recovery is NOT a
+        # live finding (the alert log owns history).
+        cooled = hot + [_serve_rec(10.0 + i, 0.0) for i in range(3)]
+        assert detect_slo_burn(_View(cooled), DEFAULT_THRESHOLDS) == []
+        # Below threshold never fires.
+        mild = [_serve_rec(float(i), 0.4) for i in range(4)]
+        assert detect_slo_burn(_View(mild), DEFAULT_THRESHOLDS) == []
+
+    def test_threshold_overrides(self):
+        th = Thresholds(slo_burn_rate=5.0, slo_burn_samples=2)
+        recs = [_serve_rec(0.0, 6.0), _serve_rec(1.0, 5.5)]
+        (f,) = detect_slo_burn(_View(recs), th)
+        assert f.metrics["threshold"] == 5.0
+        assert detect_slo_burn(_View(recs), Thresholds(slo_burn_rate=7.0)) == []
+
+    def test_live_lifecycle_and_offline_parity(self, tmp_path):
+        """pending -> firing -> resolved through the real WatchEngine,
+        transitions on disk; replaying the same records offline
+        (ingest_record is the parity contract) reproduces the story."""
+        from pytorch_operator_tpu.obs.watch import WatchEngine, load_alert_log
+
+        state = tmp_path / "state"
+        state.mkdir()
+        key = "default/svc"
+        job = _job()
+        # Configure hysteresis via the spec block the engine resolves.
+        from pytorch_operator_tpu.api.types import AlertPolicy, ObservabilityPolicy
+
+        job.spec.observability = ObservabilityPolicy(
+            alerts=AlertPolicy(for_s=1.0, clear_s=1.0)
+        )
+        eng = WatchEngine(state, host="h")
+        t0 = 1000.0
+        for i in range(4):
+            eng.ingest_record(key, "router", "serve", _serve_rec(t0 + i, 4.0, shed=3))
+        alerts = eng.evaluate(key, job, now=t0 + 3.0)
+        assert [a.state for a in alerts if a.rule == "slo_burn"] == ["pending"]
+        # Still hot past for_s: fires.
+        eng.ingest_record(key, "router", "serve", _serve_rec(t0 + 4.5, 4.0, shed=3))
+        alerts = eng.evaluate(key, job, now=t0 + 4.5)
+        assert [a.state for a in alerts if a.rule == "slo_burn"] == ["firing"]
+        # Burn decays: the tail goes quiet. Within clear_s the alert
+        # keeps firing (hysteresis); past it, it resolves (logged).
+        for i in range(3):
+            eng.ingest_record(key, "router", "serve", _serve_rec(t0 + 5.0 + i, 0.0))
+        assert [
+            a for a in eng.evaluate(key, job, now=t0 + 5.2)
+            if a.rule == "slo_burn" and a.state == "firing"
+        ]
+        alerts = eng.evaluate(key, job, now=t0 + 9.0)
+        assert not [a for a in alerts if a.rule == "slo_burn"]
+        states = [
+            r["state"]
+            for r in load_alert_log(state, key)
+            if r["rule"] == "slo_burn"
+        ]
+        assert states == ["firing", "resolved"]
+
+
+# ---- TTFT attribution ----
+
+
+class TestTTFTAttribution:
+    def _span(self, name, dur_ms, rid="r1"):
+        return {
+            "ph": "X", "name": name, "cat": "serve",
+            "ts": 0, "dur": int(dur_ms * 1000), "args": {"rid": rid},
+        }
+
+    def test_dominant_hop_and_render(self):
+        from pytorch_operator_tpu.obs.analyze import (
+            render_report,
+            ttft_attribution,
+        )
+
+        spans = [
+            self._span("claim", 2.0),
+            self._span("dispatch", 1.0),
+            self._span("ring_transit", 0.5),
+            self._span("slot_wait", 40.0),
+            self._span("decode", 10.0),
+            self._span("claim", 3.0, rid="r2"),
+        ]
+        att = ttft_attribution(spans)
+        assert att["dominant"] == "slot_wait"
+        assert att["requests"] == 2
+        assert att["hops"]["queue_wait"]["n"] == 2
+        assert att["hops"]["transit"]["total_ms"] == 0.5
+        report = {
+            "job": "default/svc", "replicas": {}, "events": 0, "spans": 6,
+            "findings": [], "alerts": [], "ttft_attribution": att,
+        }
+        text = render_report(report)
+        assert "TTFT ATTRIBUTION" in text
+        assert "dominant hop: slot_wait" in text
+
+    def test_none_without_serve_spans(self):
+        from pytorch_operator_tpu.obs.analyze import ttft_attribution
+
+        assert ttft_attribution([]) is None
+        assert ttft_attribution(
+            [{"ph": "X", "name": "step", "cat": "train", "ts": 0, "dur": 5}]
+        ) is None
+
+
+# ---- per-lane counters + ring pre-arm ----
+
+
+class TestLaneCountersAndPrearm:
+    def test_lane_io_monotonic_across_retire(self, tmp_path):
+        state = tmp_path / "state"
+        key = "default/svc"
+        job = _job(transport="shmring")
+        router = ServeRouter(state)
+        front = Spool(front_spool_dir(serve_root_dir(state), key, job.spec.serving))
+        front.submit(prompt_len=2, max_new_tokens=4)
+        router.tick(key, job, _handles(1), {})
+        lanes = router.lane_io_snapshot()
+        assert lanes[0]["ring_sends"] == 1
+        assert set(lanes[0]) == set(PER_LANE_KEYS)
+        router.retire_job(key)
+        after = router.lane_io_snapshot()
+        assert after[0]["ring_sends"] == 1  # totals survive retirement
+        router.close()
+
+    def test_metrics_registry_has_router_lane_counters(self):
+        from pytorch_operator_tpu.controller.metrics import MetricsRegistry
+
+        m = MetricsRegistry()
+        assert set(m.router_lane_io) == set(PER_LANE_KEYS)
+        m.router_lane_io["ring_sends"].inc(3, lane="0")
+        text = m.render_text()
+        assert 'tpujob_router_ring_sends_total{lane="0"} 3' in text
+        m.slo_burn_rate.set(1.5, job="default/svc", window="30s")
+        assert "tpujob_slo_burn_rate" in m.render_text()
+
+    def test_prearm_creates_ring_pair_once(self, tmp_path):
+        root = tmp_path / "spool"
+        assert prearm_rings(root) is True
+        assert (root / "req.ring").exists()
+        assert (root / "resp.ring").exists()
+        assert prearm_rings(root) is False  # idempotent
+        # The engine can attach immediately — no first-dispatch stall.
+        port = EngineRingPort.attach(root)
+        assert port is not None
+        port.close()
