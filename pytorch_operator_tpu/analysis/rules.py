@@ -40,6 +40,12 @@ Project-wide rules (subclass :class:`ProjectRule`, see also
                            lease-fenced JobStore API, and any cross-
                            module call of JobStore persistence
                            internals.
+- ``remediation-discipline`` actuator writes reachable from the
+                           remediation engine that bypass the fenced
+                           commit: store mutations outside the commit/
+                           adopt pair, fleet actuations outside the
+                           post-commit effectors, and cross-module
+                           calls of engine-private decision internals.
 """
 
 from __future__ import annotations
@@ -507,6 +513,126 @@ class FencedStoreWrite(ProjectRule):
                     )
 
 
+# ---------------------------------------------------------------------------
+# remediation-discipline (project rule)
+
+# The only methods allowed to mutate persisted job state from the
+# remediation engine: _commit (the single fenced write an action rides)
+# and _adopt (failover healing, which must re-derive — never re-decide).
+_REMEDIATION_COMMITTERS = {"_commit", "_adopt"}
+# The only methods allowed to touch the fleet: the post-commit effectors.
+_REMEDIATION_EFFECTORS = {"_delete_excess_workers", "_deliver"}
+# Fleet-mutating calls on the runner/reconciler. list_for_job & friends
+# are read-only and deliberately absent.
+_FLEET_MUTATORS = {
+    "create",
+    "delete",
+    "delete_many",
+    "inject_preempt",
+    "inject_kill",
+    "restart_world",
+    "preempt_world",
+}
+# Engine-private decision/commit internals: calling these from outside
+# the engine would let another module actuate without the audit trail.
+_REMEDIATION_PRIVATE = {"_commit", "_append", "_act", "_apply", "_plan", "_adopt"}
+
+
+class RemediationDiscipline(ProjectRule):
+    id = "remediation-discipline"
+    summary = (
+        "remediation actions must commit through the single lease-"
+        "fenced store write before any fleet side effect; actuator "
+        "writes that bypass that path break exactly-once"
+    )
+
+    def run(self, mods) -> Iterator[tuple]:
+        rem = None
+        for mod in mods:
+            if mod.relpath.endswith("controller/remediation.py"):
+                rem = mod
+                continue
+            # (c) engine-private internals are remediation.py-private:
+            # a cross-module call of _commit/_act/... on a remediation
+            # receiver is an actuation without the engine's audit path.
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REMEDIATION_PRIVATE
+                    and "remediation" in _src(mod, node.func.value).lower()
+                ):
+                    yield mod, RawFinding(
+                        node.lineno,
+                        f"call of remediation-private {node.func.attr}() "
+                        "outside controller/remediation.py — remediation "
+                        "must act through evaluate() so every action "
+                        "rides the fenced commit + audit trail",
+                    )
+        if rem is None:
+            return
+        spans = sorted(
+            ((fn.lineno, fn.end_lineno or fn.lineno, qual) for qual, fn in iter_functions(rem.tree)),
+            key=lambda t: t[1] - t[0],
+        )
+
+        def owner(line: int) -> str:
+            # innermost enclosing def (spans sorted narrowest-first)
+            for a, b, qual in spans:
+                if a <= line <= b:
+                    return qual.rsplit(".", 1)[-1]
+            return ""
+
+        for node in ast.walk(rem.tree):
+            # (a) persisted-state mutations outside the commit/adopt pair
+            # — a second store write would give supervisor failover a
+            # window to replay the action (exactly-once broken).
+            mutation = None
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                name = _call_name(node)
+                attr = node.func.attr
+                if attr == "touch" or (".store." in f".{name}" and attr in ("update", "add", "delete")):
+                    mutation = f"{name}()"
+                # (b) fleet actuations outside the post-commit effectors
+                # — a pre-commit side effect is unfenced: a deposed
+                # supervisor could actuate after losing its lease.
+                elif attr in _FLEET_MUTATORS and (
+                    "runner" in name or "reconciler" in name
+                ):
+                    fn = owner(node.lineno)
+                    if fn in _REMEDIATION_EFFECTORS or fn.startswith("_effect_"):
+                        continue
+                    yield rem, RawFinding(
+                        node.lineno,
+                        f"fleet actuation {name}() outside a post-commit "
+                        "effector (_effect_*/_delete_excess_workers/"
+                        "_deliver) — side effects must run strictly "
+                        "after the fenced commit",
+                    )
+                    continue
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                if any(
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "remediation_generation"
+                    for t in targets
+                ):
+                    mutation = "remediation_generation write"
+            if mutation is None:
+                continue
+            fn = owner(node.lineno)
+            if fn in _REMEDIATION_COMMITTERS:
+                continue
+            yield rem, RawFinding(
+                node.lineno,
+                f"persisted-state mutation ({mutation}) outside "
+                "_commit/_adopt — every remediation must ride the one "
+                "lease-fenced store write that bumps the generation",
+            )
+
+
 def module_rules() -> List[Rule]:
     return [
         AtomicStateWrite(),
@@ -519,4 +645,4 @@ def module_rules() -> List[Rule]:
 def project_rules() -> List[ProjectRule]:
     from .locks import LockOrder
 
-    return [FencedStoreWrite(), LockOrder()]
+    return [FencedStoreWrite(), LockOrder(), RemediationDiscipline()]

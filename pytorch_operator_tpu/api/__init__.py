@@ -18,6 +18,8 @@ from .types import (  # noqa: F401
     ObjectMeta,
     ObservabilityPolicy,
     ProcessTemplate,
+    RemediationPolicy,
+    RemediationRoute,
     ReplicaPhase,
     ReplicaSpec,
     ReplicaStatus,

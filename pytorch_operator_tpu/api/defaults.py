@@ -47,6 +47,15 @@ ELASTIC_TARGET_ANNOTATION = "tpujob.dev/elastic-target-workers"
 # off ICI mid-allreduce hangs forever instead of crashing).
 HANG_DEADLINE_ANNOTATION = "tpujob.dev/hang-deadline-seconds"
 
+# Exactly-once remediation (controller/remediation.py): the LAST
+# committed action record, snapshotted as JSON in the SAME lease-fenced
+# store write that mutates the spec and bumps
+# status.remediation_generation. The audit-log append is derived state:
+# a supervisor that dies between commit and append leaves at most the
+# newest record missing, and the adopter re-materialises it from this
+# annotation instead of re-running the action.
+LAST_REMEDIATION_ANNOTATION = "tpujob.dev/last-remediation"
+
 
 def set_defaults(job: TPUJob) -> TPUJob:
     """Fill defaulted fields in place (idempotent); returns the job."""

@@ -703,6 +703,130 @@ class ServingPolicy:
 
 
 @dataclass
+class RemediationRoute:
+    """Generic alert→external-action route for rules with no built-in
+    actuator (controller/remediation.py). Exactly one of ``webhook``
+    (POST the committed audit record as JSON) or ``exec`` (argv; the
+    record rides stdin as JSON) must be set. Delivery is best-effort
+    and strictly post-commit: the fenced audit record is the durable
+    truth whether or not the external side ever hears about it."""
+
+    # Alert rule name (obs/rules.py) this route answers.
+    rule: str = ""
+    # URL to POST the audit record to.
+    webhook: str = ""
+    # Argv to spawn with the audit record on stdin.
+    exec: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"rule": self.rule}
+        if self.webhook:
+            d["webhook"] = self.webhook
+        if self.exec:
+            d["exec"] = list(self.exec)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RemediationRoute":
+        ex = d.get("exec") or []
+        if not isinstance(ex, list):
+            raise ValueError("remediation.routes[].exec: expected a list")
+        return cls(
+            rule=str(d.get("rule", "") or ""),
+            webhook=str(d.get("webhook", "") or ""),
+            exec=[str(a) for a in ex],
+        )
+
+
+@dataclass
+class RemediationPolicy:
+    """Arms alert-driven auto-remediation (controller/remediation.py):
+    the supervisor maps this job's FIRING alert transitions to actuator
+    actions — serving replica-set grow/shrink for ``slo_burn`` /
+    ``queue_growth`` / sustained idle, preempt-into-hot-spare for
+    ``straggler`` / ``heartbeat_silence``, async-checkpoint cadence
+    raise for ``checkpoint_lag``, migrate for ``noisy_neighbor``, and
+    generic webhook/exec ``routes`` for everything else. Presence of
+    this block arms the engine; like ``serving`` it round-trips even
+    when empty. The SAFE default is ``dry_run: true`` — decisions are
+    audited (``tpujob remediations``) but the fleet is never touched
+    until dry_run is explicitly turned off.
+    """
+
+    # Master off-switch without dropping the block (keeps the policy
+    # diffable while disarmed).
+    enabled: bool = True
+    # Log would-have-acted decisions to the audit log, never actuate.
+    # THE DEFAULT: flipping this to false is the operator's explicit
+    # "hands off the wheel" moment.
+    dry_run: bool = True
+    # Seconds between actions for the same (rule, action) pair; each
+    # consecutive action on the pair stretches it by ``backoff``×
+    # (grow-fast/shrink-slow hysteresis, controller/autoscale.py).
+    cooldown_s: float = 30.0
+    backoff: float = 2.0
+    # Lifetime action budget for the job: the remediation generation IS
+    # the counter, so the cap survives supervisor failover. 0 = none.
+    max_actions: int = 20
+    # Serving replica-set bounds for the scale actuator.
+    scale_min: int = 1
+    scale_max: int = 8
+    # Sustained-idle window before the shrink actuator considers the
+    # serve plane over-provisioned (front queue empty AND zero inflight
+    # the whole window).
+    idle_s: float = 60.0
+    # Generic routes for rules with no built-in actuator.
+    routes: List[RemediationRoute] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if not self.enabled:
+            d["enabled"] = False
+        if not self.dry_run:
+            d["dry_run"] = False
+        if self.cooldown_s != 30.0:
+            d["cooldown_s"] = self.cooldown_s
+        if self.backoff != 2.0:
+            d["backoff"] = self.backoff
+        if self.max_actions != 20:
+            d["max_actions"] = self.max_actions
+        if self.scale_min != 1:
+            d["scale_min"] = self.scale_min
+        if self.scale_max != 8:
+            d["scale_max"] = self.scale_max
+        if self.idle_s != 60.0:
+            d["idle_s"] = self.idle_s
+        if self.routes:
+            d["routes"] = [r.to_dict() for r in self.routes]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "RemediationPolicy":
+        routes = d.get("routes") or []
+        if not isinstance(routes, list):
+            raise ValueError("remediation.routes: expected a list")
+        return cls(
+            enabled=bool(d.get("enabled", True)),
+            dry_run=bool(d.get("dry_run", True)),
+            cooldown_s=_parse_float(
+                d.get("cooldown_s", 30.0), "remediation.cooldown_s"
+            ),
+            backoff=_parse_float(d.get("backoff", 2.0), "remediation.backoff"),
+            max_actions=_parse_int(
+                d.get("max_actions", 20), "remediation.max_actions"
+            ),
+            scale_min=_parse_int(
+                d.get("scale_min", 1), "remediation.scale_min"
+            ),
+            scale_max=_parse_int(
+                d.get("scale_max", 8), "remediation.scale_max"
+            ),
+            idle_s=_parse_float(d.get("idle_s", 60.0), "remediation.idle_s"),
+            routes=[RemediationRoute.from_dict(r) for r in routes],
+        )
+
+
+@dataclass
 class TPUJobSpec:
     """The TPUJob spec (reference: PyTorchJobSpec — RunPolicy + a map
     ReplicaType→ReplicaSpec with Master exactly-1)."""
@@ -714,6 +838,9 @@ class TPUJobSpec:
     observability: Optional[ObservabilityPolicy] = None
     # Serve plane (serving/router.py); presence arms the router.
     serving: Optional[ServingPolicy] = None
+    # Auto-remediation (controller/remediation.py); presence arms the
+    # engine (dry-run by default).
+    remediation: Optional[RemediationPolicy] = None
     # Coordinator (rendezvous) port — the pytorchjob-port analog.
     port: Optional[int] = None  # defaulted to DEFAULT_PORT
 
@@ -739,6 +866,9 @@ class TPUJobSpec:
             # Not sparse-elided: an empty serving block still arms the
             # router (see ServingPolicy).
             d["serving"] = self.serving.to_dict()
+        if self.remediation is not None:
+            # Same presence-arms semantics as serving.
+            d["remediation"] = self.remediation.to_dict()
         if self.port is not None:
             d["port"] = self.port
         return d
@@ -773,6 +903,11 @@ class TPUJobSpec:
             serving=(
                 ServingPolicy.from_dict(d["serving"])
                 if d.get("serving") is not None
+                else None
+            ),
+            remediation=(
+                RemediationPolicy.from_dict(d["remediation"])
+                if d.get("remediation") is not None
                 else None
             ),
             port=_parse_opt_int(d, "port", "spec.port"),
@@ -853,6 +988,13 @@ class TPUJobStatus:
     # exactly once instead of minting a second one. 0 = the world has
     # never resized.
     resize_generation: int = 0
+    # Remediation epoch (controller/remediation.py): bumped once per
+    # committed remediation action, through the same lease-fenced store
+    # write that mutates the spec — the PR-11 resize-fencing template.
+    # A supervisor failover mid-action adopts the SAME generation and
+    # heals derived state instead of acting twice; it doubles as the
+    # lifetime max_actions budget counter. 0 = never remediated.
+    remediation_generation: int = 0
     # Observability extras (north-star metric BASELINE.json:2): wall-clock
     # timestamps of submit-accepted and first training step, set by the
     # supervisor from workload status reports.
@@ -869,6 +1011,7 @@ class TPUJobStatus:
             "completion_time": self.completion_time,
             "restart_count": self.restart_count,
             "resize_generation": self.resize_generation,
+            "remediation_generation": self.remediation_generation,
             "submit_time": self.submit_time,
             "first_step_time": self.first_step_time,
         }
@@ -886,6 +1029,7 @@ class TPUJobStatus:
             completion_time=d.get("completion_time"),
             restart_count=int(d.get("restart_count", 0)),
             resize_generation=int(d.get("resize_generation", 0)),
+            remediation_generation=int(d.get("remediation_generation", 0)),
             submit_time=d.get("submit_time"),
             first_step_time=d.get("first_step_time"),
         )

@@ -179,6 +179,45 @@ def validate_spec(spec: TPUJobSpec) -> List[str]:
             if slo.burn_window_s < 0:
                 errs.append("spec.serving.slo.burn_window_s: must be >= 0")
 
+    if spec.remediation is not None:
+        rm = spec.remediation
+        if rm.cooldown_s < 0:
+            errs.append("spec.remediation.cooldown_s: must be >= 0")
+        if rm.backoff < 1.0:
+            errs.append(
+                "spec.remediation.backoff: must be >= 1.0 (a backoff "
+                "below 1 would ACCELERATE repeated actions)"
+            )
+        if rm.max_actions < 0:
+            errs.append("spec.remediation.max_actions: must be >= 0")
+        if rm.scale_min < 1:
+            errs.append("spec.remediation.scale_min: must be >= 1")
+        if rm.scale_max < rm.scale_min:
+            errs.append(
+                "spec.remediation.scale_max: must be >= scale_min "
+                f"({rm.scale_max} < {rm.scale_min})"
+            )
+        if rm.idle_s < 0:
+            errs.append("spec.remediation.idle_s: must be >= 0")
+        # Unknown rule names are near-certainly typos — the route would
+        # silently never fire (same stance as alert thresholds).
+        from ..obs.rules import RULES
+
+        rule_names = set(RULES)
+        for i, rt in enumerate(rm.routes):
+            at = f"spec.remediation.routes[{i}]"
+            if not rt.rule:
+                errs.append(f"{at}.rule: required")
+            elif rt.rule not in rule_names:
+                errs.append(
+                    f"{at}.rule: unknown alert rule {rt.rule!r} "
+                    f"(valid: {', '.join(sorted(rule_names))})"
+                )
+            if bool(rt.webhook) == bool(rt.exec):
+                errs.append(
+                    f"{at}: exactly one of webhook or exec is required"
+                )
+
     if spec.observability is not None:
         ob = spec.observability
         if ob.trace_ring_bytes < 0:

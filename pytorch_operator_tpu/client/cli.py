@@ -806,6 +806,92 @@ def cmd_alerts(args) -> int:
     return 0
 
 
+def _follow_remediations(args, state: Path, key: str) -> int:
+    """``remediations --follow``: live-tail one job's remediation audit
+    log (same discipline as ``alerts -f``): incremental offset reads,
+    each alert→decision→action record printed once, rotation-tolerant
+    (a shrunken file restarts from zero). Ends when the job record
+    finishes or disappears, after a final drain."""
+    from pytorch_operator_tpu.controller.remediation import (
+        format_remediation_record,
+        job_remediation_log,
+    )
+
+    path = job_remediation_log(state, key)
+    store = JobStore(persist_dir=state / "jobs")
+    offset = 0
+
+    def drain() -> None:
+        nonlocal offset
+        try:
+            size = path.stat().st_size
+        except OSError:
+            return
+        if size < offset:
+            offset = 0  # rotated under us: replay the fresh generation
+        if size == offset:
+            return
+        try:
+            with path.open("rb") as f:
+                f.seek(offset)
+                chunk = f.read()
+        except OSError:
+            return
+        last_nl = chunk.rfind(b"\n")
+        if last_nl < 0:
+            return  # torn line: wait for the writer to finish it
+        offset += last_nl + 1
+        for line in chunk[: last_nl + 1].splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "action" in rec:
+                print(format_remediation_record(rec), flush=True)
+
+    try:
+        while True:
+            job = store.reload(key)
+            finished = job is None or job.is_finished()
+            drain()  # after the finish check: the last pass drains fully
+            if finished:
+                return 0
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_remediations(args) -> int:
+    """The remediation engine's audit surface
+    (controller/remediation.py): every alert→decision→action→outcome
+    the closed loop recorded, folded from the per-job audit logs —
+    file-based, so it answers with or without a daemon. ``--follow``
+    live-tails one job's actions; ``--json`` emits the raw records."""
+    from pytorch_operator_tpu.controller import remediation as rem
+
+    state = _state_dir(args)
+    if getattr(args, "follow", False):
+        if not args.name:
+            print("error: --follow requires a job NAME", file=sys.stderr)
+            return 2
+        return _follow_remediations(args, state, _resolve_key(args))
+    key = _resolve_key(args) if args.name else None
+    keys = [key] if key else rem.list_remediation_jobs(state)
+    records = [r for k in keys for r in rem.load_remediation_log(state, k)]
+    records.sort(key=lambda r: float(r.get("ts", 0.0)))
+    if getattr(args, "json", False):
+        print(json.dumps(records, indent=2))
+        return 0
+    if not records:
+        print("no remediation actions recorded.")
+        return 0
+    for rec in records:
+        print(rem.format_remediation_record(rec))
+    return 0
+
+
 def cmd_top(args) -> int:
     """Live one-screen fleet table (obs/top.py): per-job step, steps/s,
     p50/p99 step time, checkpoint lag, feed stall — from the status-dir
@@ -1818,6 +1904,27 @@ def build_parser() -> argparse.ArgumentParser:
     )
     add_ns(sp)
     sp.set_defaults(func=cmd_alerts)
+
+    sp = sub.add_parser(
+        "remediations",
+        help="remediation audit trail: every alert→decision→action→"
+        "outcome the closed loop recorded, from the per-job audit logs",
+    )
+    sp.add_argument(
+        "name", nargs="?", default=None,
+        help="only this job's remediations (required with --follow)",
+    )
+    sp.add_argument(
+        "-f", "--follow", action="store_true",
+        help="live-tail the job's remediation actions until the job "
+        "finishes",
+    )
+    sp.add_argument(
+        "--json", action="store_true",
+        help="print the raw audit records as JSON",
+    )
+    add_ns(sp)
+    sp.set_defaults(func=cmd_remediations)
 
     sp = sub.add_parser(
         "apply", help="create or update a job from a spec file (kubectl apply)"

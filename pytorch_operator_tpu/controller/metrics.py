@@ -267,6 +267,26 @@ class MetricsRegistry:
             "Firing live-health alerts per job/rule/severity "
             "(obs/watch.py; pending/resolved states are not exported)",
         )
+        # Auto-remediation (controller/remediation.py): one counter
+        # bump per audit record (dry-run included — the outcome label
+        # separates them), plus last-action / generation gauges so a
+        # dashboard shows "what did the engine last do and when".
+        self.remediations_total = self.counter(
+            "tpujob_remediations_total",
+            "Remediation actions per job/rule/action/outcome "
+            "(controller/remediation.py; outcome=dry_run means audited "
+            "but not actuated)",
+        )
+        self.remediation_last = self.gauge(
+            "tpujob_remediation_last_action",
+            "Unix time of the last remediation action per "
+            "job/rule/action",
+        )
+        self.remediation_generation = self.gauge(
+            "tpujob_remediation_generation",
+            "Committed remediation generation per job (the lifetime "
+            "action count, lease-fenced through the store)",
+        )
         # ---- sharded control plane (controller/leases.py) ----
         self.shard_jobs = self.gauge(
             "tpujob_shard_jobs",

@@ -1,0 +1,720 @@
+"""Alert-driven auto-remediation — the loop-closer over the live watch.
+
+The health engine (obs/watch.py) NOTICES a dying job; this module acts
+on it. It runs in the supervisor pass right after WatchEngine, maps
+this pass's FIRING alerts to actuator actions per ``spec.remediation``
+(api/types.RemediationPolicy), and commits every action exactly-once
+through the lease-fenced store path — the PR-11 resize-fencing
+template, applied to remediation:
+
+1. **Commit point.** The spec mutations, the monotone
+   ``status.remediation_generation`` bump, and the
+   ``LAST_REMEDIATION_ANNOTATION`` snapshot of the audit record ride
+   ONE lease-fenced store write (:meth:`RemediationEngine._commit`).
+   A supervisor that dies before this write never acted; one that dies
+   after it has acted exactly once, whatever else it lost.
+2. **Derived state.** The append to the per-job audit log
+   (``<state>/remediations/<ns>_<job>/remediations.jsonl`` — an
+   ARTIFACT_ROOT with the alert-log rotation discipline) follows the
+   commit. Only the NEWEST record can be missing after a crash, and
+   adoption re-materialises it from the annotation
+   (:meth:`RemediationEngine._adopt`).
+3. **Side effects.** External actuation (preempt, excess-seat delete,
+   webhook/exec delivery) runs strictly post-commit, best-effort. The
+   one side effect whose loss would strand state — the scale-down
+   seat delete — is deterministic off the committed spec and re-run by
+   adoption.
+
+Built-in actuators:
+
+- ``slo_burn`` / ``queue_growth``  → grow the serving replica set
+  toward ``scale_max`` (grow-fast: doubling, the
+  controller/autoscale.py discipline);
+- sustained idle (synthetic rule ``sustained_idle``: empty front queue
+  AND zero inflight for ``idle_s``) → shrink by one seat toward
+  ``scale_min`` (shrink-slow);
+- ``straggler`` / ``heartbeat_silence`` → preempt the sick replica NOW
+  (SIGTERM-with-grace, exit 143 retryable) so the reconciler's
+  restart/hot-spare backfill replaces it without waiting out the
+  hang-deadline kill;
+- ``checkpoint_lag`` → arm the async checkpoint writer + raise its
+  cadence (takes effect at the next respawn via TPUJOB_* env);
+- ``noisy_neighbor`` → migrate: restart the world off the hot host
+  (the local analog of rescheduling elsewhere);
+- anything else routes through ``spec.remediation.routes`` (webhook /
+  exec), delivery best-effort post-commit.
+
+``dry_run: true`` (THE DEFAULT) walks the identical decision path —
+cooldowns, hysteresis, audit append — but never commits or actuates:
+the operator reads ``tpujob remediations`` to see what the engine
+WOULD have done before handing it the wheel.
+
+Per (rule, action) cooldown: ``cooldown_s * backoff**(streak-1)``,
+so repeated actions on the same signal back off geometrically; the
+lifetime ``max_actions`` budget is the remediation generation itself,
+so it survives failover for free. An idle healthy armed job costs
+pure compute and ZERO I/O per pass (the bench_smoke lane pins it).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.defaults import LAST_REMEDIATION_ANNOTATION
+from ..obs.rules import SEVERITY_ORDER
+
+# Subdirectory of the supervisor state dir holding per-job audit logs
+# (an ARTIFACT_ROOT — `delete --purge` sweeps it; plain delete leaves
+# it as the postmortem surface).
+REMEDIATIONS_DIR = "remediations"
+
+# Audit-log size cap, rotated once like the alert log: actions are
+# rare, but a flapping signal in dry-run must not fill a disk.
+LOG_MAX_BYTES = 1 << 20
+
+# Actions (the audit log's and metrics' ``action`` vocabulary).
+ACTION_SCALE_UP = "scale_up"
+ACTION_SCALE_DOWN = "scale_down"
+ACTION_PREEMPT = "preempt"
+ACTION_RAISE_CKPT = "raise_ckpt_cadence"
+ACTION_MIGRATE = "migrate"
+ACTION_ROUTE = "route"
+
+# Alert rule → built-in actuator.
+BUILTIN = {
+    "slo_burn": ACTION_SCALE_UP,
+    "queue_growth": ACTION_SCALE_UP,
+    "heartbeat_silence": ACTION_PREEMPT,
+    "straggler": ACTION_PREEMPT,
+    "checkpoint_lag": ACTION_RAISE_CKPT,
+    "noisy_neighbor": ACTION_MIGRATE,
+}
+
+# The synthetic shrink signal: not an obs/rules.py rule (nothing is
+# WRONG with an idle fleet) but it shares the rule column in the audit
+# log so one fold explains both directions of the autoscaler.
+IDLE_RULE = "sustained_idle"
+
+# Raised checkpoint cadence, threaded to workloads via env
+# (runtime/env.py): divide checkpoint_every by this factor.
+CKPT_CADENCE_ANNOTATION = "tpujob.dev/remediation-ckpt-cadence"
+CKPT_CADENCE_FACTOR = 2
+
+
+def job_remediation_log(state_dir, key: str) -> Path:
+    """THE per-job audit-log path (write and read side agree)."""
+    from .store import key_to_fs
+
+    return Path(state_dir) / REMEDIATIONS_DIR / key_to_fs(key) / (
+        "remediations.jsonl"
+    )
+
+
+def load_remediation_log(state_dir, key: str) -> List[dict]:
+    """Parse one job's audit log (rotated generation included), oldest
+    first. Torn/foreign lines skipped — appended by a live daemon, read
+    after kills, like every recorded artifact."""
+    p = job_remediation_log(state_dir, key)
+    out: List[dict] = []
+    for gen in (p.with_suffix(".jsonl.1"), p):
+        try:
+            data = gen.read_bytes()
+        except OSError:
+            continue
+        for line in data.splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                float(rec.get("ts", 0.0))
+            except (ValueError, TypeError, AttributeError):
+                continue
+            if not isinstance(rec, dict) or "action" not in rec:
+                continue
+            out.append(rec)
+    return out
+
+
+def fold_remediation_log(records) -> List[dict]:
+    """Collapse an audit log to the LATEST action per rule, newest
+    first — the "what did the engine last do about this" view."""
+    cur: Dict[str, dict] = {}
+    for rec in records:
+        cur[str(rec.get("rule"))] = rec
+    return sorted(cur.values(), key=lambda r: -float(r.get("ts", 0.0)))
+
+
+def list_remediation_jobs(state_dir) -> List[str]:
+    """Job keys with an audit log on disk (the fleet scan)."""
+    from .store import fs_to_key
+
+    root = Path(state_dir) / REMEDIATIONS_DIR
+    if not root.is_dir():
+        return []
+    return sorted(
+        fs_to_key(d.name)
+        for d in root.iterdir()
+        if d.is_dir()
+        and (
+            (d / "remediations.jsonl").exists()
+            or (d / "remediations.jsonl.1").exists()
+        )
+    )
+
+
+def format_remediation_record(rec: dict, now: Optional[float] = None) -> str:
+    """One audit record as a human line (`tpujob remediations [-f]`)."""
+    det = rec.get("detail") or {}
+    dd = " ".join(f"{k}={v}" for k, v in sorted(det.items()))
+    gen = rec.get("generation", 0)
+    return (
+        f"[{rec.get('outcome', '?')}] {rec.get('action', '?')} "
+        f"{rec.get('job', '?')} gen={gen} rule={rec.get('rule', '?')}"
+        + (f" {dd}" if dd else "")
+    )
+
+
+class RemediationIOCounters:
+    """Remediation-side I/O accounting, snapshot like WatchIOCounters —
+    the bench_smoke lane pins ``log_appends`` at zero across idle
+    healthy passes (an armed engine must stay write-free when nothing
+    fires)."""
+
+    __slots__ = ("log_appends", "evaluations", "actions")
+
+    def __init__(self) -> None:
+        self.log_appends = 0
+        self.evaluations = 0
+        self.actions = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "log_appends": self.log_appends,
+            "evaluations": self.evaluations,
+            "actions": self.actions,
+        }
+
+
+class _JobRem:
+    """Per-job engine state: per-(rule, action) cooldown clocks and
+    action streaks, the sustained-idle watermark, and the adoption
+    flag. Rebuilt from the audit log on first sight (failover)."""
+
+    __slots__ = ("clocks", "streaks", "idle_since", "adopted", "warned")
+
+    def __init__(self) -> None:
+        self.clocks: Dict[Tuple[str, str], float] = {}
+        self.streaks: Dict[Tuple[str, str], int] = {}
+        self.idle_since: Optional[float] = None
+        self.adopted = False
+        # One budget-exhausted warning per job, not one per pass.
+        self.warned = False
+
+
+class RemediationEngine:
+    """The supervisor-resident actuator. One instance per supervisor;
+    all methods run on the sync pass thread (single logical writer per
+    owned job — the shard lease is what makes the store write below a
+    FENCED write)."""
+
+    def __init__(self, state_dir, store, runner, reconciler, events, metrics):
+        self.state_dir = Path(state_dir)
+        self.store = store
+        self.runner = runner
+        self.reconciler = reconciler
+        self.events = events
+        self.metrics = metrics
+        self._jobs: Dict[str, _JobRem] = {}
+        self.io = RemediationIOCounters()
+        # Supervisor-installed: key -> {"shard": int, "token": int} for
+        # the owning shard lease, or None unsharded. Recorded in every
+        # audit record so the postmortem can line an action up against
+        # the lease-ownership history.
+        self.fence_for: Optional[Callable[[str], Optional[dict]]] = None
+
+    # ---- the per-pass entry point ----
+
+    def evaluate(
+        self,
+        key: str,
+        job,
+        alerts,
+        serve: Optional[dict] = None,
+        now: Optional[float] = None,
+    ) -> Optional[dict]:
+        """Map this pass's firing alerts (plus the synthetic idle
+        signal from the router's ``serve`` summary) to AT MOST ONE
+        action, most severe signal first. Returns the audit record of
+        the action taken (committed or dry-run), or None.
+
+        One action per pass on purpose: each actuation changes the very
+        state the next decision reads (a grow empties the queue, a
+        preempt clears the silence), so acting twice on one pass's
+        snapshot double-counts the signal."""
+        pol = job.spec.remediation
+        if pol is None or not pol.enabled:
+            return None
+        now = time.time() if now is None else now
+        jr = self._jobs.get(key)
+        if jr is None:
+            jr = self._jobs[key] = _JobRem()
+        if not jr.adopted:
+            self._adopt(key, job, jr)
+        self.io.evaluations += 1
+        for rule, action, alert, route in self._candidates(
+            key, job, pol, alerts, serve, jr, now
+        ):
+            rec = self._act(key, job, jr, pol, rule, action, alert, route, now)
+            if rec is not None:
+                return rec
+        return None
+
+    def _candidates(self, key, job, pol, alerts, serve, jr, now):
+        """Ordered action candidates: firing alerts most-severe-first
+        (built-in actuator, else a matching route; rules with neither
+        are skipped), then the sustained-idle shrink. An inapplicable
+        candidate costs nothing — the next one gets its turn."""
+        out: List[tuple] = []
+        firing = sorted(
+            (a for a in alerts if getattr(a, "state", None) == "firing"),
+            key=lambda a: (
+                SEVERITY_ORDER.get(a.severity, 9), a.rule, a.replica
+            ),
+        )
+        routes = {r.rule: r for r in pol.routes}
+        for a in firing:
+            builtin = BUILTIN.get(a.rule)
+            if builtin is not None:
+                out.append((a.rule, builtin, a, None))
+            elif a.rule in routes:
+                out.append((a.rule, ACTION_ROUTE, a, routes[a.rule]))
+        # The shrink signal: judged only for serving jobs (the router
+        # summary is the evidence) and only while NOTHING is firing —
+        # shrinking a fleet that is also alerting would fight the
+        # grow actuator.
+        if serve is not None and not firing:
+            if (
+                float(serve.get("queue_depth", 0) or 0) <= 0
+                and float(serve.get("inflight", 0) or 0) <= 0
+            ):
+                if jr.idle_since is None:
+                    jr.idle_since = now
+                elif now - jr.idle_since >= pol.idle_s:
+                    out.append((IDLE_RULE, ACTION_SCALE_DOWN, None, None))
+            else:
+                jr.idle_since = None
+        elif serve is not None:
+            jr.idle_since = None
+        return out
+
+    # ---- the act → commit → append → apply pipeline ----
+
+    def _act(self, key, job, jr, pol, rule, action, alert, route, now):
+        """Gate (cooldown + budget), plan, then run the exactly-once
+        pipeline. Returns None when gated or inapplicable — no commit,
+        no cooldown consumed."""
+        ck = (rule, action)
+        last = jr.clocks.get(ck)
+        streak = jr.streaks.get(ck, 0)
+        if last is not None and pol.cooldown_s > 0:
+            need = pol.cooldown_s * (pol.backoff ** max(streak - 1, 0))
+            if now - last < need:
+                return None
+        if (
+            not pol.dry_run
+            and pol.max_actions > 0
+            and job.status.remediation_generation >= pol.max_actions
+        ):
+            if not jr.warned:
+                jr.warned = True
+                self.events.warning(
+                    key, "RemediationBudgetExhausted",
+                    f"remediation budget spent ({pol.max_actions} "
+                    "actions); further firing alerts will not be acted "
+                    "on (raise spec.remediation.max_actions to re-arm).",
+                )
+            return None
+        plan = self._plan(key, job, pol, rule, action, alert, route)
+        if plan is None:
+            return None
+        detail, mutate, effect = plan
+        rec: dict = {
+            "ts": round(now, 6),
+            "job": key,
+            "rule": rule,
+            "action": action,
+            "outcome": "dry_run" if pol.dry_run else "applied",
+            "generation": job.status.remediation_generation,
+            "detail": detail,
+        }
+        fence = self.fence_for(key) if self.fence_for is not None else None
+        rec["fence"] = fence
+        if alert is not None:
+            rec["replica"] = alert.replica
+            rec["alert"] = {
+                "rule": alert.rule,
+                "severity": alert.severity,
+                "summary": alert.summary,
+                "since": round(alert.since, 6),
+                "fired_at": (
+                    round(alert.fired_at, 6)
+                    if alert.fired_at is not None
+                    else None
+                ),
+                "replica": alert.replica,
+            }
+        from .. import obs
+
+        with obs.span(
+            "remediate", cat="supervisor", job=key, rule=rule,
+            action=action, outcome=rec["outcome"],
+        ):
+            if pol.dry_run:
+                self._append(key, rec)
+            else:
+                self._commit(key, job, rec, mutate)
+                self._append(key, rec)
+                self._apply(key, rec, effect)
+        jr.clocks[ck] = now
+        jr.streaks[ck] = streak + 1
+        self.io.actions += 1
+        m = self.metrics
+        if m is not None:
+            m.remediations_total.inc(
+                1, job=key, rule=rule, action=action, outcome=rec["outcome"]
+            )
+            m.remediation_last.set(now, job=key, rule=rule, action=action)
+            m.remediation_generation.set(
+                job.status.remediation_generation, job=key
+            )
+        det = " ".join(f"{k}={v}" for k, v in sorted(detail.items()))
+        if pol.dry_run:
+            self.events.normal(
+                key, "RemediationDryRun",
+                f"would {action} for {rule}" + (f" ({det})" if det else "")
+                + " — dry_run policy, fleet untouched.",
+            )
+        else:
+            self.events.normal(
+                key, "RemediationApplied",
+                f"{action} for {rule} (generation "
+                f"{job.status.remediation_generation}"
+                + (f", {det}" if det else "") + ").",
+            )
+        return rec
+
+    def _commit(self, key: str, job, rec: dict, mutate) -> None:
+        """THE commit point — the resize-fencing template: the spec
+        mutations, the generation bump, and the annotation snapshot of
+        the audit record ride ONE lease-fenced store write. Everything
+        after this call is derived state or best-effort side effect;
+        everything before it never happened if we die here."""
+        if mutate is not None:
+            mutate()
+        job.status.remediation_generation += 1
+        rec["generation"] = job.status.remediation_generation
+        job.metadata.annotations[LAST_REMEDIATION_ANNOTATION] = json.dumps(
+            rec, sort_keys=True
+        )
+        job.touch()
+        self.store.update(job)
+
+    def _append(self, key: str, rec: dict) -> None:
+        """Audit append (derived state, post-commit; alert-log rotation
+        discipline). Best-effort: a full disk must not stop the
+        actuator — the annotation snapshot already committed."""
+        line = (json.dumps(rec) + "\n").encode()
+        path = job_remediation_log(self.state_dir, key)
+        try:
+            try:
+                if path.stat().st_size + len(line) > LOG_MAX_BYTES:
+                    path.replace(path.with_suffix(".jsonl.1"))
+            except OSError:
+                pass
+            path.parent.mkdir(parents=True, exist_ok=True)
+            with path.open("ab") as f:
+                f.write(line)
+            self.io.log_appends += 1
+        except OSError:
+            pass  # best-effort, like the alert log
+
+    def _apply(self, key: str, rec: dict, effect) -> None:
+        """Side effects, strictly post-commit, best-effort. A lost
+        effect never loses STATE: the preempt's victim dies to the hang
+        deadline eventually, the webhook target re-reads the audit log,
+        and the scale-down delete is healed by adoption."""
+        if effect is None:
+            return
+        try:
+            effect(rec)
+        except Exception as e:  # noqa: BLE001 — actuator must survive
+            self.events.warning(
+                key, "RemediationEffectFailed",
+                f"{rec.get('action')} side effect failed post-commit: "
+                f"{e} (the committed record stands; generation "
+                f"{rec.get('generation')}).",
+            )
+
+    # ---- planners: applicability + detail + mutation + effect ----
+
+    def _plan(self, key, job, pol, rule, action, alert, route):
+        """Resolve one candidate to (detail, mutate, effect) or None
+        when inapplicable (already at the scale bound, victim already
+        dead...). Pure — nothing here touches job, store, or fleet."""
+        if action == ACTION_SCALE_UP:
+            cur = job.spec.total_replicas()
+            new = min(pol.scale_max, max(cur + 1, cur * 2))
+            if new <= cur:
+                return None
+            return (
+                {"from": cur, "to": new},
+                lambda: self._set_workers(job, new),
+                None,
+            )
+        if action == ACTION_SCALE_DOWN:
+            cur = job.spec.total_replicas()
+            new = max(pol.scale_min, cur - 1)
+            if new >= cur:
+                return None
+            return (
+                {"from": cur, "to": new},
+                lambda: self._set_workers(job, new),
+                lambda rec: self._effect_scale_down(key, job),
+            )
+        if action == ACTION_PREEMPT:
+            h = self._find_replica(key, alert.replica if alert else None)
+            if h is None or not h.is_active():
+                return None
+            return (
+                {"replica": h.name},
+                None,
+                lambda rec: self._effect_preempt(h.name),
+            )
+        if action == ACTION_RAISE_CKPT:
+            dp = job.spec.data_plane
+            if (
+                dp is not None
+                and dp.async_checkpoint
+                and job.metadata.annotations.get(CKPT_CADENCE_ANNOTATION)
+            ):
+                return None  # already raised; nothing left to turn up
+            return (
+                {
+                    "async_checkpoint": True,
+                    "cadence_factor": CKPT_CADENCE_FACTOR,
+                },
+                lambda: self._raise_ckpt(job),
+                None,
+            )
+        if action == ACTION_MIGRATE:
+            if not any(
+                h.is_active() for h in self.runner.list_for_job(key)
+            ):
+                return None
+            return (
+                {"world": job.spec.total_replicas()},
+                None,
+                lambda rec: self._effect_migrate(key, job),
+            )
+        if action == ACTION_ROUTE:
+            detail = (
+                {"webhook": route.webhook}
+                if route.webhook
+                else {"exec": " ".join(route.exec)}
+            )
+            return (
+                detail,
+                None,
+                lambda rec: self._deliver(key, route, rec),
+            )
+        return None
+
+    @staticmethod
+    def _raise_ckpt(job) -> None:
+        from ..api.types import DataPlanePolicy
+
+        if job.spec.data_plane is None:
+            job.spec.data_plane = DataPlanePolicy()
+        job.spec.data_plane.async_checkpoint = True
+        job.metadata.annotations[CKPT_CADENCE_ANNOTATION] = str(
+            CKPT_CADENCE_FACTOR
+        )
+
+    def _set_workers(self, job, new_total: int) -> None:
+        """Point the Worker replica count at ``new_total`` total seats
+        (Master + workers). Creates the Worker spec from the Master
+        template on the first grow of a master-only job; clamps the
+        gang floor so a shrink can't strand min_available above the
+        world. The reconciler's create-missing / desired-indices pass
+        converges the fleet to this spec — no restart, no resize epoch
+        (serving seats are independent, not a training gang)."""
+        import copy
+
+        from ..api.types import ReplicaSpec, ReplicaType
+
+        specs = job.spec.replica_specs
+        others = sum(
+            (rs.replicas or 0)
+            for rt, rs in specs.items()
+            if rt != ReplicaType.WORKER
+        )
+        want = max(new_total - others, 0)
+        workers = specs.get(ReplicaType.WORKER)
+        if workers is None:
+            master = specs.get(ReplicaType.MASTER)
+            if master is None:
+                return
+            specs[ReplicaType.WORKER] = ReplicaSpec(
+                replicas=want,
+                restart_policy=master.restart_policy,
+                template=copy.deepcopy(master.template),
+            )
+        else:
+            workers.replicas = want
+        sp = job.spec.run_policy.scheduling_policy
+        if sp.min_available is not None and sp.min_available > new_total:
+            sp.min_available = new_total
+
+    def _find_replica(self, key: str, replica: Optional[str]):
+        """Resolve an alert's replica coordinate (a status-file stem,
+        underscore-escaped) to the runner handle."""
+        if not replica or replica == "*":
+            return None
+        for h in self.runner.list_for_job(key):
+            stem = f"{h.replica_type.value.lower()}-{h.index}"
+            if replica in (h.name, stem) or h.name.endswith(f"-{replica}"):
+                return h
+        return None
+
+    # ---- side effects (post-commit ONLY — see _apply) ----
+
+    def _effect_preempt(self, name: str) -> None:
+        """SIGTERM-with-grace the sick replica (exit 143, retryable):
+        the reconciler's next pass walks the ordinary restart path —
+        hot-spare promote when the pool has one — instead of everyone
+        waiting out the hang-deadline kill."""
+        self.runner.inject_preempt(name)
+
+    def _effect_scale_down(self, key: str, job) -> None:
+        self._delete_excess_workers(key, job)
+
+    def _delete_excess_workers(self, key: str, job) -> None:
+        """Retire seats at indices past the COMMITTED per-type count,
+        highest first — deterministic off the committed spec and
+        idempotent, so adoption re-runs it after a failover that lost
+        the original call."""
+        for h in sorted(
+            self.runner.list_for_job(key), key=lambda h: -h.index
+        ):
+            rs = job.spec.replica_specs.get(h.replica_type)
+            want = (rs.replicas or 0) if rs is not None else 0
+            if h.index >= want and h.is_active():
+                self.runner.delete(h.name)
+
+    def _effect_migrate(self, key: str, job) -> None:
+        """Restart the world off the (noisy) host — the local analog of
+        rescheduling elsewhere. Spends a restart via the shared
+        restart_world path so backoff/conditions stay honest."""
+        self.reconciler.restart_world(
+            job, key, self.runner.list_for_job(key),
+            reason="RemediationMigrated",
+            message=f"remediation: migrating {key} off a noisy host "
+            "(world restart).",
+            warning=False,
+        )
+
+    def _deliver(self, key: str, route, rec: dict) -> None:
+        """Generic route delivery, best-effort post-commit."""
+        payload = json.dumps(rec).encode()
+        if route.webhook:
+            import urllib.request
+
+            req = urllib.request.Request(
+                route.webhook, data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=5.0).close()
+        elif route.exec:
+            import subprocess
+
+            subprocess.run(
+                list(route.exec), input=payload, timeout=10.0, check=False,
+                capture_output=True,
+            )
+
+    # ---- failover adoption ----
+
+    def _adopt(self, key: str, job, jr: _JobRem) -> None:
+        """First sight of a job (startup or shard handoff): converge
+        derived state to the fenced truth. (a) A commit whose audit
+        append was lost is re-materialised from the annotation — only
+        the newest record can be missing. (b) A committed scale-down
+        whose seat delete was lost is finished (deterministic +
+        idempotent). (c) Cooldown clocks rebuild from the log, so the
+        survivor no-ops inside the dead owner's cooldown window instead
+        of double-acting on a still-firing alert. Zero I/O for a job
+        that never remediated (no annotation, generation 0, no log)."""
+        jr.adopted = True
+        ann = job.metadata.annotations.get(LAST_REMEDIATION_ANNOTATION)
+        gen = job.status.remediation_generation
+        if ann is None and gen == 0:
+            p = job_remediation_log(self.state_dir, key)
+            try:
+                if not (
+                    p.exists() or p.with_suffix(".jsonl.1").exists()
+                ):
+                    return
+            except OSError:
+                return
+        recs = load_remediation_log(self.state_dir, key)
+        last: Optional[dict] = None
+        if ann:
+            try:
+                last = json.loads(ann)
+            except ValueError:
+                last = None
+        if (
+            last is not None
+            and gen > 0
+            and int(last.get("generation", 0) or 0) == gen
+        ):
+            if not any(
+                int(r.get("generation", 0) or 0) == gen
+                and r.get("outcome") == "applied"
+                for r in recs
+            ):
+                self._append(key, last)
+                recs.append(last)
+                self.events.normal(
+                    key, "RemediationAdopted",
+                    f"healed audit record for generation {gen} "
+                    f"({last.get('action')}) after supervisor failover.",
+                )
+            if last.get("action") == ACTION_SCALE_DOWN:
+                self._delete_excess_workers(key, job)
+        for r in recs:
+            rule, action = r.get("rule"), r.get("action")
+            if not rule or not action:
+                continue
+            try:
+                ts = float(r.get("ts", 0.0))
+            except (TypeError, ValueError):
+                continue
+            ck = (str(rule), str(action))
+            jr.clocks[ck] = max(jr.clocks.get(ck, 0.0), ts)
+            jr.streaks[ck] = jr.streaks.get(ck, 0) + 1
+
+    # ---- lifecycle edges ----
+
+    def finalize(self, key: str) -> None:
+        """The job finished: drop clocks/streaks; the audit log stays
+        as the postmortem surface. Idempotent."""
+        self._jobs.pop(key, None)
+
+    def retire_job(self, key: str) -> None:
+        """The job was deleted or handed off to another shard owner:
+        drop in-memory state without logging."""
+        self._jobs.pop(key, None)

@@ -662,7 +662,7 @@ class JobStore:
 # Artifact roots under the supervisor state dir that outlive the job object
 # (deliberately — job-level resume, SURVEY.md §5; clock logs feed the
 # offline `tpujob why` postmortem) until an explicit purge.
-ARTIFACT_ROOTS = ("checkpoints", "status", "clock", "alerts")
+ARTIFACT_ROOTS = ("checkpoints", "status", "clock", "alerts", "remediations")
 
 
 def purge_job_artifacts(state_dir: Path, key: str) -> None:

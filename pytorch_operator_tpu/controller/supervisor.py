@@ -240,6 +240,18 @@ class Supervisor:
         self.router = ServeRouter(self.state_dir, metrics=self.metrics)
         self._router_io_seen = self.router.io_snapshot()
         self._router_lane_seen: dict = {}
+        # Auto-remediation (controller/remediation.py): consumes the
+        # watch engine's firing alerts + the router's serve summary,
+        # right after both, on the same pass thread. Jobs without a
+        # spec.remediation block never reach it — one ``is None`` check
+        # per job per pass, zero I/O until something fires.
+        from .remediation import RemediationEngine
+
+        self.remediation = RemediationEngine(
+            self.state_dir, self.store, self.runner, self.reconciler,
+            self.events, self.metrics,
+        )
+        self.remediation.fence_for = self._remediation_fence
         # Serving jobs whose end-of-life drain already ran (the drain
         # scans the front spool — once, not every pass).
         self._serve_finalized: set = set()
@@ -267,6 +279,20 @@ class Supervisor:
 
     def _owns_key(self, key: str, now: Optional[float] = None) -> bool:
         return self.shards.owns_shard(self._job_shard(key), now)
+
+    def _remediation_fence(self, key: str) -> Optional[dict]:
+        """The fencing coordinates a remediation audit record carries:
+        which shard lease (and token epoch) authorized the commit.
+        None unsharded — the store is single-writer by construction."""
+        if self.shards is None:
+            return None
+        s = self._job_shard(key)
+        lease = self.shards.leases.get(s)
+        return {
+            "shard": s,
+            "token": lease.token if lease is not None else 0,
+            "holder": self.identity,
+        }
 
     def _shard_tick(self, now: float) -> dict:
         """Once per pass: renew/claim/release shard leases, then turn
@@ -334,6 +360,7 @@ class Supervisor:
                 self._steady_gen.pop(key, None)
                 self._steady_ok.pop(key, None)
                 self.watch.retire_job(key)
+                self.remediation.retire_job(key)
                 self.metrics.retire_job(key)
 
     def simulate_crash(self) -> None:
@@ -891,6 +918,36 @@ class Supervisor:
                     f"injected kill of {h.name} "
                     f"({f.label()}, storm of {len(victims)} this pass).",
                 )
+        if any(f.kind == "overload_spool" for f in inj.plan.faults):
+            # Offered-rate burst: drop ``times`` synthetic requests into
+            # each targeted serving job's ingress spool — the
+            # deterministic stand-in for a client flood (queue growth /
+            # SLO burn the remediation engine must autoscale against).
+            from ..serving.router import front_spool_dir
+            from ..serving.spool import Spool, make_request
+
+            for key, job in self.store.items():
+                if job.spec.serving is None:
+                    continue
+                for f in inj.overloads_due(self._fault_pass, key):
+                    sp = Spool(
+                        front_spool_dir(
+                            self.router.serve_root, key, job.spec.serving
+                        )
+                    )
+                    sp.enqueue_batch(
+                        [
+                            make_request(prompt_len=16, max_new_tokens=8)
+                            for _ in range(max(1, f.times))
+                        ],
+                        fsync=False,
+                    )
+                    self.events.warning(
+                        key,
+                        "FaultInjected",
+                        f"injected {max(1, f.times)} overload request(s) "
+                        f"into the front spool ({f.label()}).",
+                    )
 
     def _update_gauges(self, jobs, queue_usage: Optional[dict]) -> None:
         """Point-in-time scheduler state for /metrics, refreshed per pass
@@ -1047,6 +1104,7 @@ class Supervisor:
                 # the finish, not dangling. Idempotent after the first
                 # pass (state already dropped).
                 self.watch.finalize(key)
+                self.remediation.finalize(key)
                 if (
                     job.spec.serving is not None
                     and key not in self._serve_finalized
@@ -1149,18 +1207,33 @@ class Supervisor:
                     m.checkpoint_commit_seconds.observe(
                         float(ck["commit_ms"]) / 1000.0, exemplar=ex, job=key
                     )
+            serve_summary = None
             if job.spec.serving is not None:
                 # Serve plane: route this job's requests on the pass
                 # cadence. The replica set is the runner's handle index
                 # (the same truth reconcile acts on); per-replica load
                 # comes from the serve telemetry already tailed above —
                 # the router adds no fold I/O of its own.
-                self.router.tick(
+                serve_summary = self.router.tick(
                     key,
                     job,
                     self.runner.list_for_job(key),
                     by_replica,
                     status_dir=status_dir,
+                )
+            if job.spec.remediation is not None:
+                # Close the loop (controller/remediation.py): this
+                # pass's firing alerts — which include noisy_neighbor
+                # from the PREVIOUS pass's correlate(), the freshest
+                # verdict that exists when this job is folded — plus
+                # the router summary drive at most one fenced action.
+                firing = (
+                    self.watch.active_alerts(key)
+                    if self.watch.tracked(key)
+                    else []
+                )
+                self.remediation.evaluate(
+                    key, job, firing, serve=serve_summary
                 )
 
     def _record_clock_observations(
@@ -1302,6 +1375,7 @@ class Supervisor:
         registry bounded (pinned by tests/test_obs_analyze.py)."""
         self.metrics.retire_job(key)
         self.watch.retire_job(key)
+        self.remediation.retire_job(key)
         self.router.retire_job(key)
         self._serve_finalized.discard(key)
         self._steady_gen.pop(key, None)

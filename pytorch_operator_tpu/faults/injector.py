@@ -265,6 +265,21 @@ class FaultInjector:
     def engine_step_fault(self) -> Optional[Fault]:
         return self._nth_fire("fail_engine_step", "engine_step")
 
+    def overloads_due(self, pass_index: int, key: str) -> List[Fault]:
+        """overload_spool faults scheduled for this supervisor pass
+        whose ``target`` names this serving job (or ``*``). ``times`` is
+        the burst size — the number of synthetic requests the caller
+        injects into the job's ingress spool in this ONE pass — so a due
+        fault is consumed whole, like a storm's victim budget."""
+        out = []
+        with self._lock:
+            for i, f in self._candidates("overload_spool", key=key):
+                if f.at == pass_index:
+                    self._remaining[i] = 0
+                    self.fired.append(f.label())
+                    out.append(f)
+        return out
+
 
 # ---- process-global arming (controller side) ----
 

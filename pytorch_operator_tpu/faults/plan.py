@@ -70,6 +70,14 @@ Fault kinds (``Fault.kind``):
 - ``fail_engine_step``       serving: the ``nth`` engine iteration raises
                              (the serve loop must recover in-flight
                              requests with an error response)
+- ``overload_spool``         serving: inject ``times`` synthetic requests
+                             into the target JOB's ingress spool at
+                             supervisor pass ``at`` — the offered-rate
+                             burst that drives queue growth / SLO burn
+                             (the sustained-overload scenario the
+                             remediation engine autoscales against);
+                             repeat with several faults at successive
+                             passes for a sustained ramp
 
 ``target`` matches a replica as ``<type>-<index>`` (e.g. ``worker-0``,
 ``master-*``) or a job key for job-scoped kinds; ``*`` matches all.
@@ -103,6 +111,7 @@ KINDS = frozenset(
         "fail_spawn",
         "torn_state_write",
         "fail_engine_step",
+        "overload_spool",
     }
 )
 
@@ -235,7 +244,7 @@ class FaultPlan:
 
 
 # Fault kinds whose ``target`` names a JOB KEY (or ``*``), not a replica.
-JOB_TARGET_KINDS = frozenset({"torn_state_write"})
+JOB_TARGET_KINDS = frozenset({"torn_state_write", "overload_spool"})
 
 # Fault kinds whose target is ignored by the injection site (the serving
 # engine has no replica identity at the step hook).

@@ -36,7 +36,14 @@ express are captured:
   same failover;
 - a recorded rendezvous stall (``fault_stall`` records exist only for
   injected stalls, but a join that measurably exceeded the gang's is
-  not reconstructable — skipped).
+  not reconstructable — skipped);
+- a sustained overload the remediation engine autoscaled against
+  (``scale_up`` audit records for ``slo_burn``/``queue_growth`` in the
+  remediation log) maps to ``overload_spool`` bursts at successive
+  passes, each sized to the capacity the engine had to add
+  (:data:`OVERLOAD_BURST_PER_SEAT` × the recorded seat delta) — the
+  replay re-offers enough load that an armed remediation policy must
+  make the same grow decisions.
 
 The plan carries a ``seed`` derived from the job key so two recordings
 of the same incident serialize identically.
@@ -55,6 +62,12 @@ _TAKEOVER_RE = re.compile(r"after lease expiry of (\S+?)\.?$")
 # Two SIGKILL deaths at most this far apart are one correlated burst
 # (kill_storm), not independent crashes.
 STORM_WINDOW_S = 5.0
+
+# Overload reconstruction is a projection: the audit log records how
+# many seats the engine ADDED, not the offered rate that forced them.
+# Replay offers this many requests per added seat — enough queue growth
+# that the same policy grows by at least the recorded delta.
+OVERLOAD_BURST_PER_SEAT = 64
 
 
 def _replica_target(name: str, key: str) -> str:
@@ -158,6 +171,31 @@ def plan_from_recording(state_dir, key: str) -> FaultPlan:
                 ),
                 target=str(rec.get("replica", "*")),
                 nth=int(rec.get("save_index", i) or i),
+            )
+        )
+
+    # ---- remediation-recorded overload -> overload_spool bursts ----
+    from ..controller.remediation import load_remediation_log
+
+    grow_pass = 0
+    for rec in load_remediation_log(state_dir, key):
+        if rec.get("action") != "scale_up" or rec.get("rule") not in (
+            "slo_burn",
+            "queue_growth",
+        ):
+            continue
+        det = rec.get("detail") or {}
+        try:
+            width = max(int(det.get("to", 0)) - int(det.get("from", 0)), 1)
+        except (TypeError, ValueError):
+            width = 1
+        grow_pass += 1
+        faults.append(
+            Fault(
+                kind="overload_spool",
+                target=key,
+                at=grow_pass,
+                times=OVERLOAD_BURST_PER_SEAT * width,
             )
         )
 

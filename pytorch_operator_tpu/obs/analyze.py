@@ -433,6 +433,14 @@ def analyze(
 
     alerts = load_alert_log(state_dir, key)
 
+    # The remediation engine's audit trail (controller/remediation.py):
+    # every alert→decision→action→outcome the closed loop took (or would
+    # have taken, in dry-run) for this job, each citing the triggering
+    # alert instance and the fencing token it committed under.
+    from ..controller.remediation import load_remediation_log
+
+    remediations = load_remediation_log(state_dir, key)
+
     # Control-plane ownership history for this job's shard: who was
     # reconciling it, and when that changed (lease expiry after a
     # supervisor death, rebalance, injected drop) — the citation for
@@ -497,6 +505,7 @@ def analyze(
         "exemplars": exemplars,
         "ttft_attribution": ttft_attribution(tl.spans),
         "alerts": alerts,
+        "remediations": remediations,
         "shard_handoffs": shard_handoffs,
         "resize_history": resize_history,
         "findings": [f.to_dict() for f in findings],
@@ -567,6 +576,7 @@ def render_report(report: dict) -> str:
         not findings
         and not alerts
         and not ttft
+        and not report.get("remediations")
         and not report.get("shard_handoffs")
         and not report.get("resize_history")
     ):
@@ -615,6 +625,28 @@ def render_report(report: dict) -> str:
                 f"{float(rec.get('ts', 0.0)):.3f}  "
                 f"{rec.get('summary', '')}"
             )
+    remediations = report.get("remediations", [])
+    if remediations:
+        # What the closed loop DID about those alerts: each action cites
+        # the causal alert instance so the remediation and the alert read
+        # as one story (and dry-run decisions are visibly inert).
+        lines.append("")
+        lines.append(f"REMEDIATIONS ({len(remediations)} action(s)):")
+        for rec in remediations:
+            lines.append(
+                f"  {rec.get('outcome', '?'):<8} "
+                f"{rec.get('action', '?'):<18} gen={rec.get('generation', 0)} "
+                f"rule={rec.get('rule', '?')} @ "
+                f"{float(rec.get('ts', 0.0)):.3f}  {rec.get('detail', '')}"
+            )
+            al = rec.get("alert")
+            if al:
+                lines.append(
+                    f"           └ alert [{al.get('severity', '?')}] "
+                    f"{al.get('rule', '?')} {al.get('replica') or '*'} "
+                    f"fired @ {float(al.get('fired_at') or 0.0):.3f}  "
+                    f"{al.get('summary', '')}"
+                )
     handoffs = report.get("shard_handoffs", [])
     if handoffs:
         lines.append("")

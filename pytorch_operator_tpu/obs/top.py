@@ -24,6 +24,7 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 from .metrics import histogram_quantile, parse_exemplars, parse_prometheus_text
+from ..controller.remediation import load_remediation_log
 from .watch import fold_alert_log, load_alert_log
 
 STEP_HIST = "tpujob_step_time_seconds"
@@ -49,6 +50,7 @@ COLUMNS = (
     ("BURN", "burn"),
     ("HB AGE", "age_s"),
     ("ALERTS", "alerts"),
+    ("REMED", "remed"),
     ("RESTARTS", "restarts"),
     ("P99 SPAN", "p99_span"),
 )
@@ -194,6 +196,16 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
             for r in fold_alert_log(load_alert_log(state, key))
             if r.get("state") == "firing"
         ]
+        # Auto-remediation (controller/remediation.py audit log): the
+        # committed generation and the newest action, folded from disk
+        # like the alert column — the REMED cell and the --diff action
+        # lines both read this.
+        remed_recs = (
+            load_remediation_log(state, key)
+            if job.spec.remediation is not None
+            else []
+        )
+        last_remed = remed_recs[-1] if remed_recs else None
         rows.append(
             {
                 "job": key,
@@ -220,6 +232,18 @@ def gather_rows(state_dir, now: Optional[float] = None) -> List[dict]:
                 "age_s": (now - hb["ts"]) if hb.get("ts") else None,
                 "alerts": len(firing) or None,
                 "alert_rules": sorted(firing),
+                "remed": (
+                    None
+                    if job.spec.remediation is None
+                    else job.status.remediation_generation
+                ),
+                "remed_last": (
+                    f"{last_remed.get('action', '?')}"
+                    f"[{last_remed.get('outcome', '?')}]"
+                    if last_remed
+                    else None
+                ),
+                "remed_count": len(remed_recs) or None,
                 "restarts": job.status.restart_count,
                 # Exemplar linking: the latest span that landed in the
                 # job's slowest populated step-time bucket — the jump
@@ -322,6 +346,16 @@ def _world_cell(r: dict) -> str:
     return str(w) if t is None or t == w else f"{w}→{t}"
 
 
+def _remed_cell(r: dict) -> str:
+    """``<generation>:<last action>[<outcome>]`` for a remediation-armed
+    job (``0`` = armed, never acted), ``-`` unarmed."""
+    g = r.get("remed")
+    if g is None:
+        return "-"
+    last = r.get("remed_last")
+    return f"{g}:{last}" if last else str(g)
+
+
 def _cells(r: dict) -> tuple:
     return (
         r["job"],
@@ -342,6 +376,7 @@ def _cells(r: dict) -> tuple:
             if r.get("alerts")
             else "-"
         ),
+        _remed_cell(r),
         str(r["restarts"]),
         _fmt(r.get("p99_span")),
     )
@@ -443,6 +478,20 @@ def diff_rows(prev: List[dict], rows: List[dict]) -> List[str]:
             changes.append(f"ALERT firing: {rule}")
         for rule in sorted(prev_alerts - cur_alerts):
             changes.append(f"alert resolved: {rule}")
+        # Remediation actions: a committed-generation move is an action
+        # the fleet actually took; a record-count move without one is a
+        # dry-run decision the operator should read before un-gating.
+        pg, cg = p.get("remed"), c.get("remed")
+        if cg is not None and pg is not None and cg > pg:
+            changes.append(
+                f"REMEDIATION {c.get('remed_last') or 'acted'} "
+                f"(generation {pg}→{cg})"
+            )
+        elif (
+            (c.get("remed_count") or 0) > (p.get("remed_count") or 0)
+            and c.get("remed_last")
+        ):
+            changes.append(f"remediation dry-run: {c['remed_last']}")
         if changes:
             lines.append(f"{job}: " + "; ".join(changes))
     return lines

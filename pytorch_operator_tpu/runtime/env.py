@@ -149,6 +149,25 @@ def build_cluster_env(
         # The transport tier rides its own var so the engine loop can
         # gate ring-attach on one string compare, no JSON parse.
         env["TPUJOB_SERVE_TRANSPORT"] = sv.transport
+    # Auto-remediation policy (spec.remediation): acted on by the
+    # SUPERVISOR, threaded into replicas like TPUJOB_ALERTS so
+    # replica-side tooling resolves the identical policy.
+    rm = job.spec.remediation
+    if rm is not None:
+        import json as _json
+
+        env["TPUJOB_REMEDIATION"] = _json.dumps(
+            rm.to_dict(), sort_keys=True
+        )
+    # A committed raise_ckpt_cadence remediation stamps this annotation;
+    # workloads multiply their checkpoint frequency by it so the "write
+    # more often" decision survives restarts (it rides the spec, not a
+    # live signal).
+    from ..controller.remediation import CKPT_CADENCE_ANNOTATION
+
+    cadence = job.metadata.annotations.get(CKPT_CADENCE_ANNOTATION)
+    if cadence:
+        env["TPUJOB_CKPT_CADENCE_FACTOR"] = str(cadence)
     # Data-plane policy (spec.data_plane): workloads read these as the
     # defaults for --async-checkpoint / --prefetch, so host-I/O overlap
     # is a SPEC property, not per-workload args plumbing.

@@ -63,10 +63,13 @@ def _percentile(values: List[float], q: float) -> float:
 
 def _make_job(i: int, replicas: int = 1):
     """One synthetic job: a Master plus ``replicas - 1`` Workers (the
-    wide-gang cells model N jobs × M replicas this way)."""
+    wide-gang cells model N jobs × M replicas this way). Every job is
+    ARMED with a remediation policy on purpose: an engine that costs
+    I/O while nothing fires would show up in the idle pins below."""
     from ..api.types import (
         ObjectMeta,
         ProcessTemplate,
+        RemediationPolicy,
         ReplicaSpec,
         ReplicaType,
         RestartPolicy,
@@ -93,7 +96,9 @@ def _make_job(i: int, replicas: int = 1):
         )
     return TPUJob(
         metadata=ObjectMeta(name=f"bench-{i:05d}"),
-        spec=TPUJobSpec(replica_specs=specs),
+        spec=TPUJobSpec(
+            replica_specs=specs, remediation=RemediationPolicy()
+        ),
     )
 
 
@@ -167,6 +172,7 @@ def bench_mode(
         latencies_ms: List[float] = []
         io_per_pass: List[Dict[str, int]] = []
         watch_before = sup.watch.io.snapshot()
+        rem_before = sup.remediation.io.snapshot()
         pool_max_seen = sup._sync_workers
         for _ in range(passes):
             before = sup.store.io.snapshot()
@@ -176,6 +182,7 @@ def bench_mode(
             io_per_pass.append(_io_delta(sup.store, before))
             pool_max_seen = max(pool_max_seen, sup._sync_workers)
         watch_after = sup.watch.io.snapshot()
+        rem_after = sup.remediation.io.snapshot()
 
         # ---- finish churn: every master succeeds, jobs complete ----
         for h in sup.runner.list_all():
@@ -213,6 +220,17 @@ def bench_mode(
             ),
             "idle_watch_evaluations": (
                 watch_after["evaluations"] - watch_before["evaluations"]
+            ),
+            # Remediation engine (controller/remediation.py): every
+            # bench job is ARMED, nothing fires — so across the idle
+            # passes the engine must append no audit records and take
+            # no actions (zero extra I/O; only the in-memory candidate
+            # walk, counted as evaluations).
+            "idle_remediation_log_appends": (
+                rem_after["log_appends"] - rem_before["log_appends"]
+            ),
+            "idle_remediation_actions": (
+                rem_after["actions"] - rem_before["actions"]
             ),
             # One runner → structurally impossible; recorded so EVERY
             # cell in the artifact carries the pin.

@@ -76,6 +76,7 @@ def _make_serve_job(
     slo_target: float = 0.0,
     burn_window_s: float = 0.0,
     alerts: Optional[dict] = None,
+    remediation=None,
 ):
     """A serving job of ``replicas`` engine replicas: Master(1) +
     Worker(replicas-1) — validation pins Master at exactly one, and the
@@ -136,6 +137,7 @@ def _make_serve_job(
                 if alerts
                 else None
             ),
+            remediation=remediation,
         ),
     )
 
@@ -161,6 +163,7 @@ def bench_cell(
     slo_target: float = 0.0,
     burn_window_s: float = 0.0,
     alerts: Optional[dict] = None,
+    remediation=None,
     log=print,
 ) -> dict:
     """One (replicas, scenario) cell through the full serve plane."""
@@ -221,6 +224,7 @@ def bench_cell(
             slo_target=slo_target,
             burn_window_s=burn_window_s,
             alerts=alerts,
+            remediation=remediation,
         )
         key = sup.submit(job)
         pump_thread.start()
@@ -287,6 +291,11 @@ def bench_cell(
         # of the window — their TTFT tail is where a cold transport
         # (ring files created at first dispatch) used to spike.
         early_rids: set = set()
+        # Recovery tracking: the rids submitted in the LAST quarter of
+        # the window — where an armed remediation policy has already
+        # grown the fleet, so their ok-rate is the recovered goodput.
+        late_rids: set = set()
+        late_start = start + 0.75 * duration
         while True:
             now = time.time()
             if now >= end:
@@ -303,6 +312,8 @@ def bench_cell(
                 t_next += rng.expovariate(rate)
             if now - start <= 1.0:
                 early_rids.update(r["id"] for r in due)
+            if now >= late_start:
+                late_rids.update(r["id"] for r in due)
             if len(due) == 1:
                 front.enqueue(due[0])
                 rids.append(due[0]["id"])
@@ -316,6 +327,7 @@ def bench_cell(
         # saturation cell's in-flight population gets.
         pending = set(rids)
         early_ttfts: List[float] = []
+        late_ok = 0
         collect_deadline = time.monotonic() + deadline_s + max(30.0, 4 * duration)
         while pending and time.monotonic() < collect_deadline:
             done = []
@@ -331,10 +343,12 @@ def bench_cell(
                     continue
                 resp = front.read_response(rid)
                 if resp is not None:
-                    stats.account(resp)
+                    bucket = stats.account(resp)
                     done.append(rid)
                     if rid in early_rids and resp.get("ttft_ms") is not None:
                         early_ttfts.append(float(resp["ttft_ms"]))
+                    if rid in late_rids and bucket == "ok":
+                        late_ok += 1
             pending.difference_update(done)
             if pending:
                 time.sleep(0.02)
@@ -412,6 +426,16 @@ def bench_cell(
                 or summary["ttft_ms_p99"] <= bound_ms
             ),
         }
+        # Recovered goodput: ok-rate over the last quarter's arrivals
+        # (where a remediation grow, if armed, has already landed).
+        cell["late_window_offered"] = len(late_rids)
+        cell["late_window_ok"] = late_ok
+        cell["late_window_ok_rate"] = round(
+            late_ok / max(1, len(late_rids)), 4
+        )
+        cell["late_window_goodput_rps"] = round(
+            late_ok / max(1e-9, 0.25 * duration), 3
+        )
         if alerts:
             # The live watch's verdicts for this cell, straight from
             # the on-disk transition log — the burn-smoke lifecycle
@@ -422,6 +446,23 @@ def bench_cell(
                 r.get("state")
                 for r in load_alert_log(state_dir, key)
                 if r.get("rule") == "slo_burn"
+            ]
+        if remediation is not None:
+            # The closed loop's audit trail for this cell: every
+            # alert→decision→action→outcome the engine committed, read
+            # back from the same on-disk log `tpujob remediations`
+            # shows (condensed — the full records stay in the log).
+            from ..controller.remediation import load_remediation_log
+
+            cell["remediations"] = [
+                {
+                    "rule": r.get("rule"),
+                    "action": r.get("action"),
+                    "outcome": r.get("outcome"),
+                    "generation": r.get("generation"),
+                    "detail": r.get("detail"),
+                }
+                for r in load_remediation_log(state_dir, key)
             ]
         log(
             f"[serveplane] {cell_name:>20s} "
@@ -564,6 +605,80 @@ def bench_burn_smoke(state_dir: Path, log=print) -> dict:
     return cell
 
 
+def bench_overload_remediation(state_dir: Path, log=print) -> dict:
+    """Sustained overload with the loop CLOSED: the same ~2.6x
+    overload as the burn smoke, but the job carries a live (dry_run
+    off) remediation policy — ``slo_burn`` fires, the engine grows the
+    serving fleet (1 → 2 → 4 under grow-fast doubling), the grown
+    capacity (4 x 100 rps) clears the 260 rps offered rate, and the
+    last quarter of the window measures RECOVERED goodput. The pins:
+    at least one applied ``scale_up`` in the audit log, late-window
+    ok-rate at/above the recovery bar, and the burn alert resolving
+    (burn back under 1.0) once the grown fleet drains the queue."""
+    from ..api.types import RemediationPolicy
+
+    duration = 8.0
+    rate = 260.0
+    cell = bench_cell(
+        1,
+        "healthy",
+        rate=rate,
+        duration=duration,
+        slots=4,
+        tpot_ms=10.0,
+        max_new_tokens=4,
+        max_queue_depth=64,
+        deadline_s=1.0,
+        retry_limit=1,
+        idle_timeout=4.0,
+        state_dir=state_dir,
+        label="overload_remediation",
+        slo_target=0.99,
+        burn_window_s=1.0,
+        alerts={
+            "for_s": 0.5,
+            "clear_s": 0.6,
+            "thresholds": {"slo_burn_samples": 2},
+        },
+        # The closed loop under test: grow on burn, short cooldown so
+        # both doublings land inside the window, shrink never (the
+        # idle watermark outlives the cell).
+        remediation=RemediationPolicy(
+            dry_run=False,
+            cooldown_s=1.0,
+            backoff=1.0,
+            scale_max=4,
+            idle_s=600.0,
+        ),
+        log=log,
+    )
+    states = cell.get("slo_burn_transitions", [])
+    grows = [
+        r
+        for r in cell.get("remediations", [])
+        if r["action"] == "scale_up" and r["outcome"] == "applied"
+    ]
+    cell["burn_alert_fired"] = "firing" in states
+    cell["burn_alert_resolved"] = "resolved" in states
+    cell["remediation_grows"] = len(grows)
+    cell["final_replicas"] = grows[-1]["detail"]["to"] if grows else 1
+    # Recovery bar: the grown fleet's capacity (scale_max x 100 rps)
+    # clears the offered rate, so the last-quarter arrivals should
+    # mostly succeed — vs the ungrown burn smoke, which sheds ~60%
+    # all the way through.
+    cell["recovery_target_ok_rate"] = 0.7
+    cell["recovered"] = (
+        bool(grows)
+        and cell["late_window_ok_rate"] >= cell["recovery_target_ok_rate"]
+    )
+    log(
+        f"[serveplane] overload remediation: grows={len(grows)} "
+        f"-> {cell['final_replicas']} replicas, late ok-rate="
+        f"{cell['late_window_ok_rate']} transitions={states}"
+    )
+    return cell
+
+
 # Router-saturation profile defaults: per-replica capacity is cranked
 # far past the offered rate (slots/(max_new_tokens*tpot_ms) = 2000
 # rps/replica), so the cell measures the ROUTING path — sharded
@@ -600,11 +715,14 @@ def run(
     idle_passes: int = 30,
     saturation: Optional[dict] = None,
     burn_smoke: bool = False,
+    overload_remediation: bool = False,
     out: Optional[str] = None,
     work_dir: Optional[str] = None,
     seed: int = 7,
     log=print,
 ) -> dict:
+    from ..api.types import RemediationPolicy
+
     cells: List[dict] = []
     for scenario in scenarios:
         for n in replica_cells:
@@ -626,6 +744,15 @@ def run(
                         idle_timeout=idle_timeout,
                         state_dir=Path(td),
                         seed=seed,
+                        # Chaos cells run with the remediation engine
+                        # ARMED (live, not dry-run): the exactly-once
+                        # pins (duplicates == 0, lost == 0) must hold
+                        # with the closed loop riding every pass.
+                        remediation=(
+                            RemediationPolicy(dry_run=False)
+                            if scenario == "kill_replica"
+                            else None
+                        ),
                         log=log,
                     )
                 )
@@ -669,6 +796,14 @@ def run(
             prefix="serveplane-burn-", dir=work_dir
         ) as td:
             burn_cell = bench_burn_smoke(Path(td) / "state", log=log)
+    overload_cell: Optional[dict] = None
+    if overload_remediation:
+        with tempfile.TemporaryDirectory(
+            prefix="serveplane-remediate-", dir=work_dir
+        ) as td:
+            overload_cell = bench_overload_remediation(
+                Path(td) / "state", log=log
+            )
     with tempfile.TemporaryDirectory(
         prefix="serveplane-idle-", dir=work_dir
     ) as td:
@@ -804,6 +939,27 @@ def run(
             "resolved": burn_cell["burn_alert_resolved"],
             "why_cites_slo_burn": burn_cell["why_cites_slo_burn"],
         }
+    if overload_cell is not None:
+        result["overload_remediation"] = overload_cell
+        comparisons["overload_remediation"] = {
+            "grows": overload_cell["remediation_grows"],
+            "final_replicas": overload_cell["final_replicas"],
+            "late_window_ok_rate": overload_cell["late_window_ok_rate"],
+            "late_window_goodput_rps": overload_cell[
+                "late_window_goodput_rps"
+            ],
+            "burn_resolved": overload_cell["burn_alert_resolved"],
+            "recovered": overload_cell["recovered"],
+        }
+        if acceptance is not None:
+            acceptance["remediation_recovery_pass"] = (
+                overload_cell["recovered"]
+                and overload_cell["burn_alert_resolved"]
+            )
+            acceptance["pass"] = (
+                acceptance["pass"]
+                and acceptance["remediation_recovery_pass"]
+            )
     if out:
         Path(out).write_text(json.dumps(result, indent=2) + "\n")
         log(f"[serveplane] wrote {out}")
@@ -852,6 +1008,12 @@ def main(argv=None) -> int:
         help="skip the SLO burn-rate smoke cell (sustained overload "
         "driving the slo_burn alert through fire -> resolve)",
     )
+    p.add_argument(
+        "--no-remediation",
+        action="store_true",
+        help="skip the closed-loop overload cell (slo_burn fires, the "
+        "remediation engine grows the fleet, goodput recovers)",
+    )
     p.add_argument("--seed", type=int, default=7)
     p.add_argument(
         "--smoke",
@@ -890,6 +1052,7 @@ def main(argv=None) -> int:
         idle_passes=args.idle_passes,
         saturation=None if args.no_saturation else {},
         burn_smoke=not args.no_burn,
+        overload_remediation=not args.no_remediation,
         seed=args.seed,
         out=args.out,
         work_dir=args.work_dir,

@@ -121,6 +121,16 @@ class TestBenchSmoke:
             assert c["idle_watch_log_appends"] == 0
             assert c["idle_watch_evaluations"] == 0
 
+    def test_remediation_engine_is_free_on_healthy_fleets(self, smoke_result):
+        # Every bench job carries an ARMED remediation policy, nothing
+        # ever fires: across the idle passes the engine must write no
+        # audit records and take no actions — the closed loop costs
+        # zero I/O until an alert actually asks for an action.
+        for mode in ("cached", "legacy"):
+            c = cell(smoke_result, mode)
+            assert c["idle_remediation_log_appends"] == 0
+            assert c["idle_remediation_actions"] == 0
+
     def test_legacy_mode_still_measures_the_old_profile(self, smoke_result):
         legacy = cell(smoke_result, "legacy")
         # The baseline must stay honest: N reads and N writes per idle

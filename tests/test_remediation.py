@@ -1,0 +1,532 @@
+"""Remediation engine (controller/remediation.py) tests.
+
+- policy API: round-trip (presence-arms like serving), validation
+  rejects bad bounds / unknown route rules / ambiguous routes, the
+  policy threads into replica env;
+- engine units (deterministic clock, no sleeps): slo_burn grows the
+  serving replica set fast (doubling, clamped at scale_max), sustained
+  idle shrinks slow (one seat, floored at scale_min, only while
+  nothing fires), cooldown + backoff hysteresis gates repeats, the
+  max_actions budget survives in the committed generation, dry-run
+  writes the audit record but never touches spec or fleet, preempt
+  resolves the alert's replica coordinate and SIGTERMs it post-commit,
+  checkpoint_lag turns the async writer on exactly once, generic exec
+  routes deliver the audit record;
+- exactly-once under failover: a supervisor that dies in the
+  commit→append window loses nothing (the adopter re-materialises the
+  audit tail from the annotation and stays inside the dead owner's
+  cooldown), and one that dies in the append→side-effect window of a
+  scale-down has the seat delete healed — never re-decided;
+- e2e: under a drop_heartbeat world with a LONG hang deadline, the
+  remediation preempt recycles the silent replica and the job finishes
+  — strictly faster than the hang-deadline kill, which never fires.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import pytest
+
+from pytorch_operator_tpu import faults
+from pytorch_operator_tpu.api import (
+    ObjectMeta,
+    ProcessTemplate,
+    RemediationPolicy,
+    RemediationRoute,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    RunPolicy,
+    TPUJob,
+    TPUJobSpec,
+    set_defaults,
+    validate,
+)
+from pytorch_operator_tpu.api.defaults import (
+    HANG_DEADLINE_ANNOTATION,
+    LAST_REMEDIATION_ANNOTATION,
+)
+from pytorch_operator_tpu.api.serialization import job_from_dict
+from pytorch_operator_tpu.api.types import ServingPolicy
+from pytorch_operator_tpu.controller.remediation import (
+    CKPT_CADENCE_ANNOTATION,
+    load_remediation_log,
+)
+from pytorch_operator_tpu.controller.runner import FakeRunner
+from pytorch_operator_tpu.controller.supervisor import Supervisor
+from pytorch_operator_tpu.faults import Fault, FaultPlan
+from pytorch_operator_tpu.obs.watch import Alert
+from tests.testutil import new_job
+
+T0 = 1000.0
+
+
+def _rjob(name="serve", policy=None, workers=0, serving=True):
+    job = new_job(name=name, workers=workers)
+    if serving:
+        job.spec.serving = ServingPolicy()
+    job.spec.remediation = policy
+    return job
+
+
+def _alert(key, rule, replica="*", severity="critical", now=T0):
+    return Alert(
+        job=key, rule=rule, replica=replica, severity=severity,
+        state="firing", since=now - 5.0, last_seen=now,
+        summary=f"{rule} is firing", fired_at=now - 1.0,
+    )
+
+
+def _sup(tmp_path, name="state"):
+    return Supervisor(state_dir=tmp_path / name, runner=FakeRunner())
+
+
+def _armed(tmp_path, policy, name="serve", workers=0):
+    sup = _sup(tmp_path)
+    job = _rjob(name=name, policy=policy, workers=workers)
+    sup.submit(job)
+    key = f"default/{name}"
+    return sup, key, sup.store.get(key)
+
+
+# ---- policy API ----
+
+
+class TestPolicyAPI:
+    def test_roundtrip_and_presence_arms(self):
+        pol = RemediationPolicy(
+            dry_run=False, cooldown_s=7.0, backoff=3.0, max_actions=5,
+            scale_min=2, scale_max=6, idle_s=12.0,
+            routes=[
+                RemediationRoute(rule="step_time_regression",
+                                 webhook="http://hook.local/x"),
+                RemediationRoute(rule="batch_size_collapse",
+                                 exec=["/bin/true", "arg"]),
+            ],
+        )
+        job = _rjob(policy=pol)
+        back = job_from_dict(job.to_dict())
+        rp = back.spec.remediation
+        assert rp is not None and rp.dry_run is False
+        assert rp.cooldown_s == 7.0 and rp.backoff == 3.0
+        assert rp.scale_min == 2 and rp.scale_max == 6
+        assert [r.rule for r in rp.routes] == [
+            "step_time_regression", "batch_size_collapse",
+        ]
+        assert rp.routes[1].exec == ["/bin/true", "arg"]
+        # Presence arms: an empty block round-trips as an armed policy
+        # with the safe default (dry_run) — like `serving: {}`.
+        d = job.to_dict()
+        d["spec"]["remediation"] = {}
+        armed = job_from_dict(d)
+        assert armed.spec.remediation is not None
+        assert armed.spec.remediation.dry_run is True
+        # Absent stays absent.
+        del d["spec"]["remediation"]
+        assert job_from_dict(d).spec.remediation is None
+
+    def test_validation_rejects_bad_policies(self):
+        for pol, msg in [
+            (RemediationPolicy(backoff=0.5), "backoff"),
+            (RemediationPolicy(cooldown_s=-1.0), "cooldown_s"),
+            (RemediationPolicy(scale_min=0), "scale_min"),
+            (RemediationPolicy(scale_min=4, scale_max=2), "scale_max"),
+            (RemediationPolicy(routes=[
+                RemediationRoute(rule="bogus", webhook="http://x"),
+            ]), "bogus"),
+            (RemediationPolicy(routes=[
+                RemediationRoute(rule="straggler", webhook="http://x",
+                                 exec=["/bin/true"]),
+            ]), "exactly one"),
+            (RemediationPolicy(routes=[
+                RemediationRoute(rule="straggler"),
+            ]), "exactly one"),
+        ]:
+            with pytest.raises(Exception) as ei:
+                validate(_rjob(policy=pol))
+            assert msg in str(ei.value), f"{pol} -> {ei.value}"
+        validate(_rjob(policy=RemediationPolicy(routes=[
+            RemediationRoute(rule="step_time_regression", webhook="http://x"),
+        ])))
+
+    def test_policy_threads_into_env(self):
+        from pytorch_operator_tpu.runtime.env import build_cluster_env
+
+        job = _rjob(policy=RemediationPolicy(dry_run=False, scale_max=4))
+        env = build_cluster_env(job, ReplicaType.MASTER, 0)
+        threaded = json.loads(env["TPUJOB_REMEDIATION"])
+        assert threaded["dry_run"] is False and threaded["scale_max"] == 4
+        assert "TPUJOB_REMEDIATION" not in build_cluster_env(
+            _rjob(policy=None), ReplicaType.MASTER, 0
+        )
+        # A committed cadence raise reaches the workload.
+        job.metadata.annotations[CKPT_CADENCE_ANNOTATION] = "2"
+        env = build_cluster_env(job, ReplicaType.MASTER, 0)
+        assert env["TPUJOB_CKPT_CADENCE_FACTOR"] == "2"
+
+
+# ---- engine units ----
+
+
+class TestGrowShrink:
+    def test_slo_burn_grows_fast_and_clamps(self, tmp_path):
+        sup, key, job = _armed(
+            tmp_path, RemediationPolicy(dry_run=False, scale_max=3)
+        )
+        rec = sup.remediation.evaluate(
+            key, job, [_alert(key, "slo_burn")], now=T0
+        )
+        assert rec["action"] == "scale_up" and rec["outcome"] == "applied"
+        assert rec["detail"] == {"from": 1, "to": 2}
+        assert rec["generation"] == 1
+        assert job.spec.total_replicas() == 2
+        assert job.status.remediation_generation == 1
+        # The annotation snapshot rides the same committed write.
+        snap = json.loads(
+            job.metadata.annotations[LAST_REMEDIATION_ANNOTATION]
+        )
+        assert snap["generation"] == 1 and snap["action"] == "scale_up"
+        # Next grow (past cooldown) doubles toward the clamp.
+        rec = sup.remediation.evaluate(
+            key, job, [_alert(key, "queue_growth", severity="warning")],
+            now=T0 + 100.0,
+        )
+        assert rec["detail"] == {"from": 2, "to": 3}
+        # At the clamp the candidate is inapplicable: no action, no
+        # generation burn.
+        assert sup.remediation.evaluate(
+            key, job, [_alert(key, "slo_burn")], now=T0 + 1000.0
+        ) is None
+        assert job.status.remediation_generation == 2
+        assert sup.metrics.remediations_total.get(
+            job=key, rule="slo_burn", action="scale_up", outcome="applied"
+        ) == 1
+
+    def test_sustained_idle_shrinks_slow(self, tmp_path):
+        sup, key, job = _armed(
+            tmp_path,
+            RemediationPolicy(dry_run=False, idle_s=60.0, scale_min=1),
+            workers=2,
+        )
+        idle = {"queue_depth": 0, "inflight": 0}
+        # Idle starts the clock; nothing shrinks before idle_s.
+        assert sup.remediation.evaluate(key, job, [], serve=idle, now=T0) is None
+        assert sup.remediation.evaluate(
+            key, job, [], serve=idle, now=T0 + 30.0
+        ) is None
+        rec = sup.remediation.evaluate(
+            key, job, [], serve=idle, now=T0 + 61.0
+        )
+        assert rec["action"] == "scale_down"
+        assert rec["rule"] == "sustained_idle"
+        assert rec["detail"] == {"from": 3, "to": 2}  # ONE seat, not half
+        # Busy (or firing) resets the idle watermark.
+        assert sup.remediation.evaluate(
+            key, job, [], serve={"queue_depth": 4, "inflight": 1},
+            now=T0 + 200.0,
+        ) is None
+        assert sup.remediation.evaluate(
+            key, job, [], serve=idle, now=T0 + 230.0
+        ) is None  # only 30s idle again
+        # A firing alert suppresses the shrink even when idle long.
+        sup.remediation.evaluate(
+            key, job, [_alert(key, "straggler", replica="worker-0")],
+            serve=idle, now=T0 + 400.0,
+        )
+        assert sup.remediation.evaluate(
+            key, job, [], serve=idle, now=T0 + 430.0
+        ) is None
+
+    def test_shrink_floors_at_scale_min(self, tmp_path):
+        sup, key, job = _armed(
+            tmp_path,
+            RemediationPolicy(dry_run=False, idle_s=0.0, cooldown_s=0.0,
+                              scale_min=2),
+            workers=1,
+        )
+        idle = {"queue_depth": 0, "inflight": 0}
+        sup.remediation.evaluate(key, job, [], serve=idle, now=T0)
+        assert sup.remediation.evaluate(
+            key, job, [], serve=idle, now=T0 + 1.0
+        ) is None  # already at the floor (total 2)
+
+
+class TestGates:
+    def test_cooldown_and_backoff_hysteresis(self, tmp_path):
+        sup, key, job = _armed(
+            tmp_path,
+            RemediationPolicy(dry_run=False, cooldown_s=10.0, backoff=2.0,
+                              scale_max=8),
+        )
+        burn = lambda t: sup.remediation.evaluate(
+            key, job, [_alert(key, "slo_burn")], now=t
+        )
+        assert burn(T0) is not None
+        # Streak 1: next action needs cooldown_s.
+        assert burn(T0 + 9.0) is None
+        assert burn(T0 + 10.5) is not None
+        # Streak 2: the window doubles (cooldown * backoff).
+        assert burn(T0 + 10.5 + 15.0) is None
+        assert burn(T0 + 10.5 + 21.0) is not None
+
+    def test_budget_is_the_committed_generation(self, tmp_path):
+        sup, key, job = _armed(
+            tmp_path,
+            RemediationPolicy(dry_run=False, cooldown_s=0.0, max_actions=2,
+                              scale_max=8),
+        )
+        a = [_alert(key, "slo_burn")]
+        assert sup.remediation.evaluate(key, job, a, now=T0) is not None
+        assert sup.remediation.evaluate(key, job, a, now=T0 + 1) is not None
+        assert sup.remediation.evaluate(key, job, a, now=T0 + 2) is None
+        assert job.status.remediation_generation == 2
+        assert "RemediationBudgetExhausted" in [
+            e.reason for e in sup.events.for_job(key)
+        ]
+
+    def test_dry_run_audits_but_never_acts(self, tmp_path):
+        sup, key, job = _armed(tmp_path, RemediationPolicy())  # safe default
+        before = job.spec.to_dict()
+        rec = sup.remediation.evaluate(
+            key, job, [_alert(key, "slo_burn")], now=T0
+        )
+        assert rec["outcome"] == "dry_run"
+        assert job.spec.to_dict() == before
+        assert job.status.remediation_generation == 0
+        assert LAST_REMEDIATION_ANNOTATION not in job.metadata.annotations
+        assert sup.runner.actions == []
+        recs = load_remediation_log(sup.state_dir, key)
+        assert [r["outcome"] for r in recs] == ["dry_run"]
+        assert recs[0]["alert"]["rule"] == "slo_burn"
+        assert "RemediationDryRun" in [
+            e.reason for e in sup.events.for_job(key)
+        ]
+
+
+class TestActuators:
+    def test_preempt_resolves_replica_and_fires_post_commit(self, tmp_path):
+        sup, key, job = _armed(
+            tmp_path,
+            RemediationPolicy(dry_run=False), name="train", workers=1,
+        )
+        sup.sync_once()  # spawn the fake replicas
+        rec = sup.remediation.evaluate(
+            key, job,
+            [_alert(key, "heartbeat_silence", replica="worker-0")],
+            now=T0,
+        )
+        assert rec["action"] == "preempt" and rec["outcome"] == "applied"
+        assert rec["alert"]["replica"] == "worker-0"
+        assert rec["fence"] is None or "token" in rec["fence"]
+        victim = next(
+            h for h in sup.runner.list_for_job(key)
+            if h.name.endswith("worker-0")
+        )
+        assert not victim.is_active() and victim.exit_code == 143
+        # Victim gone -> the candidate is inapplicable, not an error.
+        for h in sup.runner.list_for_job(key):
+            sup.runner.delete(h.name)
+        assert sup.remediation.evaluate(
+            key, job,
+            [_alert(key, "straggler", replica="worker-0")],
+            now=T0 + 100.0,
+        ) is None
+
+    def test_checkpoint_lag_raises_cadence_once(self, tmp_path):
+        sup, key, job = _armed(
+            tmp_path, RemediationPolicy(dry_run=False), name="ckpt",
+        )
+        rec = sup.remediation.evaluate(
+            key, job, [_alert(key, "checkpoint_lag", severity="warning")],
+            now=T0,
+        )
+        assert rec["action"] == "raise_ckpt_cadence"
+        assert job.spec.data_plane.async_checkpoint is True
+        assert job.metadata.annotations[CKPT_CADENCE_ANNOTATION] == "2"
+        # Already raised: nothing left to turn up.
+        assert sup.remediation.evaluate(
+            key, job, [_alert(key, "checkpoint_lag", severity="warning")],
+            now=T0 + 100.0,
+        ) is None
+
+    def test_exec_route_delivers_audit_record(self, tmp_path):
+        out = tmp_path / "delivered.json"
+        pol = RemediationPolicy(dry_run=False, routes=[
+            RemediationRoute(rule="step_time_regression", exec=[
+                sys.executable, "-c",
+                "import sys, pathlib; pathlib.Path(sys.argv[1])"
+                ".write_bytes(sys.stdin.buffer.read())",
+                str(out),
+            ]),
+        ])
+        sup, key, job = _armed(tmp_path, pol, name="routed")
+        rec = sup.remediation.evaluate(
+            key, job,
+            [_alert(key, "step_time_regression", severity="warning")],
+            now=T0,
+        )
+        assert rec["action"] == "route"
+        delivered = json.loads(out.read_bytes())
+        assert delivered["rule"] == "step_time_regression"
+        assert delivered["generation"] == 1
+        # A rule with neither builtin nor route is skipped entirely.
+        assert sup.remediation.evaluate(
+            key, job,
+            [_alert(key, "world_resize_thrash", severity="warning")],
+            now=T0 + 100.0,
+        ) is None
+
+
+# ---- exactly-once under failover ----
+
+
+class TestExactlyOnceFailover:
+    def test_commit_append_window_heals_without_reacting(
+        self, tmp_path, monkeypatch
+    ):
+        """The dead supervisor committed (spec + generation + annotation
+        in ONE store write) but died before the audit append. The
+        adopter re-materialises the audit record from the annotation
+        and stays inside the cooldown — the action happened ONCE."""
+        sup1, key, job = _armed(
+            tmp_path, RemediationPolicy(dry_run=False, cooldown_s=300.0)
+        )
+        monkeypatch.setattr(
+            sup1.remediation, "_append", lambda *a, **k: None
+        )
+        rec = sup1.remediation.evaluate(
+            key, job, [_alert(key, "slo_burn")], now=T0
+        )
+        assert rec["generation"] == 1
+        assert load_remediation_log(sup1.state_dir, key) == []  # lost
+
+        sup2 = Supervisor(state_dir=sup1.state_dir, runner=FakeRunner())
+        job2 = sup2.store.get(key)
+        assert job2.status.remediation_generation == 1  # commit survived
+        again = sup2.remediation.evaluate(
+            key, job2, [_alert(key, "slo_burn")], now=T0 + 1.0
+        )
+        assert again is None  # adopted cooldown gates the repeat
+        recs = load_remediation_log(sup2.state_dir, key)
+        assert [r["generation"] for r in recs] == [1]  # healed, once
+        assert recs[0]["outcome"] == "applied"
+        assert job2.spec.total_replicas() == 2
+        assert job2.status.remediation_generation == 1
+        assert "RemediationAdopted" in [
+            e.reason for e in sup2.events.for_job(key)
+        ]
+        # A third sight heals nothing further (idempotent adoption).
+        sup3 = Supervisor(state_dir=sup1.state_dir, runner=FakeRunner())
+        sup3.remediation.evaluate(
+            key, sup3.store.get(key), [_alert(key, "slo_burn")],
+            now=T0 + 2.0,
+        )
+        assert len(load_remediation_log(sup3.state_dir, key)) == 1
+
+    def test_scale_down_side_effect_is_healed_not_redecided(
+        self, tmp_path, monkeypatch
+    ):
+        """Death in the append→side-effect window of a scale-down: the
+        committed spec says 2 seats, 3 still run. Adoption re-runs the
+        deterministic seat delete off the committed spec — it does NOT
+        re-decide (no new generation, no new audit record)."""
+        sup1, key, job = _armed(
+            tmp_path,
+            RemediationPolicy(dry_run=False, idle_s=10.0, scale_min=1),
+            name="shrink", workers=2,
+        )
+        sup1.sync_once()
+        assert len([h for h in sup1.runner.list_for_job(key)
+                    if h.is_active()]) == 3
+        monkeypatch.setattr(
+            sup1.remediation, "_apply", lambda *a, **k: None
+        )
+        # sync_once ran the in-pass evaluate with the wall clock, so
+        # stay on it: watermark now, shrink once sustained past idle_s.
+        t = time.time()
+        idle = {"queue_depth": 0, "inflight": 0}
+        sup1.remediation.evaluate(key, job, [], serve=idle, now=t)
+        rec = sup1.remediation.evaluate(
+            key, job, [], serve=idle, now=t + 60.0
+        )
+        assert rec["action"] == "scale_down"
+        # The doomed seat still runs: the effect was lost with the owner.
+        assert len([h for h in sup1.runner.list_for_job(key)
+                    if h.is_active()]) == 3
+
+        sup2 = Supervisor(state_dir=sup1.state_dir, runner=sup1.runner)
+        job2 = sup2.store.get(key)
+        sup2.remediation.evaluate(key, job2, [], serve=idle, now=t + 61.0)
+        assert len([h for h in sup2.runner.list_for_job(key)
+                    if h.is_active()]) == 2
+        assert job2.status.remediation_generation == 1  # healed, not redone
+        assert len(load_remediation_log(sup2.state_dir, key)) == 1
+
+
+# ---- e2e: preempt-into-restart beats the hang-deadline kill ----
+
+
+@pytest.mark.chaos
+def test_preempt_recycles_silent_replica_before_hang_kill(tmp_path):
+    """A replica goes silent under a drop_heartbeat fault pinned to its
+    first incarnation, with a hang deadline far beyond the test budget.
+    The remediation preempt (SIGTERM, exit 143, retryable) recycles it
+    through the ordinary restart path and the job FINISHES — strictly
+    faster than the hang-deadline kill, which never fires."""
+    faults.disarm()
+    state = tmp_path / "state"
+    sup = Supervisor(state_dir=state, poll_interval=0.03)
+    key = "default/heal-e2e"
+    try:
+        faults.arm(FaultPlan(seed=1, faults=[
+            Fault(kind="drop_heartbeat", target="master-0",
+                  nth=3, times=100000, restart=0),
+        ]))
+        job = TPUJob(
+            metadata=ObjectMeta(
+                name="heal-e2e",
+                annotations={HANG_DEADLINE_ANNOTATION: "120"},
+            ),
+            spec=TPUJobSpec(
+                replica_specs={
+                    ReplicaType.MASTER: ReplicaSpec(
+                        replicas=1,
+                        restart_policy=RestartPolicy.ON_FAILURE,
+                        template=ProcessTemplate(
+                            module="pytorch_operator_tpu.workloads.exit_with",
+                            args=["--steps", "40", "--step-time", "0.05"],
+                        ),
+                    ),
+                },
+                run_policy=RunPolicy(),
+                remediation=RemediationPolicy(
+                    dry_run=False, cooldown_s=5.0
+                ),
+            ),
+        )
+        set_defaults(job)
+        sup.submit(job)
+        deadline = time.time() + 60.0
+        j = None
+        while time.time() < deadline:
+            sup.sync_once()
+            j = sup.store.get(key)
+            if j is None or j.is_finished():
+                sup.sync_once()
+                break
+            time.sleep(0.03)
+        reasons = [e.reason for e in sup.events.for_job(key)]
+    finally:
+        faults.disarm()
+        sup.shutdown()
+    assert j is not None and j.is_succeeded(), reasons
+    assert "RemediationApplied" in reasons
+    assert "TPUJobHung" not in reasons
+    recs = load_remediation_log(state, key)
+    preempts = [r for r in recs if r["action"] == "preempt"]
+    assert preempts and preempts[0]["outcome"] == "applied"
+    assert preempts[0]["alert"]["rule"] == "heartbeat_silence"
+    assert preempts[0]["generation"] >= 1

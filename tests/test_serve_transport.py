@@ -116,6 +116,92 @@ class TestBatchFraming:
         assert sp.read_response("r0001")["tokens"] == [1]
 
 
+# ---- cross-host spill path: shared-filesystem visibility lag ----
+
+
+class TestCrossHostSpillLag:
+    """The ring's file-spool spill tier over a SHARED filesystem.
+
+    On one host the maildir discipline is airtight: rename is atomic
+    and a reader sees either the whole file or nothing. A shared
+    filesystem (the cross-host spill path) weakens both halves: a
+    rename lands on the writer host but becomes VISIBLE to the reader's
+    directory scan only after an attribute-cache window, and a file's
+    size can be visible BEFORE its content (the reader gets the final
+    length but stale/zero pages for the not-yet-propagated tail).
+    The spill contract must hold anyway: every record served exactly
+    once, late — never lost, never twice."""
+
+    def test_late_visible_rename_claims_exactly_once(self, tmp_path):
+        """Rename-visible-late: the batch exists on the writer's view
+        but the reader's scan cannot see it yet. The claim simply comes
+        up empty — and the first scan after propagation claims every
+        record exactly once."""
+        shared = tmp_path / "spool"
+        writer = Spool(shared)
+        reader = Spool(shared, create=False)
+        # The writer's rename has not propagated: model the reader's
+        # stale directory cache by parking the batch outside requests/.
+        writer.enqueue_batch(_recs(6))
+        (batch,) = list(writer.requests.glob("*.jsonb"))
+        hidden = tmp_path / "in-flight" / batch.name
+        hidden.parent.mkdir()
+        batch.rename(hidden)
+        assert reader.claim(16) == []  # not visible yet: empty, not torn
+        hidden.rename(batch)  # the attribute cache expires
+        got = reader.claim(16)
+        assert sorted(r["id"] for r in got) == [f"r{i:04d}" for i in range(6)]
+        assert reader.claim(16) == []
+
+    def test_size_before_content_recovers_tail_without_dup(self, tmp_path):
+        """Size-visible-before-content: the reader sees the batch at
+        its final length but the tail pages are still zeros. The crc
+        framing drops the unpropagated tail as torn (prefix records
+        serve immediately); once the content lands, the recover path
+        re-claims the batch and serves ONLY the records that were
+        never answered — exactly-once across the lag."""
+        shared = tmp_path / "spool"
+        writer = Spool(shared)
+        writer.enqueue_batch(_recs(8))
+        (batch,) = list(writer.requests.glob("*.jsonb"))
+        full = batch.read_bytes()
+        # Frame boundary of record 5: final size, zeroed tail.
+        cut = full.find(b"\n", full.find(b"r0005")) + 1
+        batch.write_bytes(full[:cut] + b"\x00" * (len(full) - cut))
+
+        reader = Spool(shared, create=False)
+        first = reader.claim(16)
+        assert [r["id"] for r in first] == [f"r{i:04d}" for i in range(6)]
+        # Half the prefix answers before the tail pages land (the lag
+        # window is real time; serving is too).
+        for r in first[:3]:
+            assert reader.respond_once(r["id"], {"id": r["id"], "tokens": [1]})
+
+        # The data pages propagate: the claimed file fills in under the
+        # same name (same inode on the shared filesystem).
+        (claimed,) = list(reader.claimed.glob("*.jsonb"))
+        claimed.write_bytes(full)
+
+        # Next engine life walks the recover path and re-claims: the
+        # answered prefix is deduped, the unanswered rest — including
+        # the late tail — is served now.
+        second_life = Spool(shared, create=False)
+        assert second_life.recover_claimed() == 8
+        again = second_life.claim(16)
+        assert sorted(r["id"] for r in again) == [
+            f"r{i:04d}" for i in range(3, 8)
+        ]
+        # The answered prefix kept exactly one response each; the tail
+        # publishes exactly once too.
+        for r in again:
+            assert second_life.respond_once(
+                r["id"], {"id": r["id"], "tokens": [2]}
+            )
+        for i in range(8):
+            assert second_life.read_response(f"r{i:04d}") is not None
+        assert not second_life.respond_once("r0000", {"id": "r0000"})
+
+
 # ---- syscall budget ----
 
 

@@ -375,6 +375,73 @@ class TestClockDiscipline:
 
 
 # ---------------------------------------------------------------------------
+# rule 7: remediation-discipline
+
+
+class TestRemediationDiscipline:
+    def test_mutation_and_actuation_outside_commit_path_fire(self, tmp_path):
+        rep = analyze_fixture(tmp_path, {
+            "controller/remediation.py": """
+                class RemediationEngine:
+                    def __init__(self, store, runner):
+                        self.store = store
+                        self.runner = runner
+
+                    def _plan(self, key, job):
+                        # actuation BEFORE the commit: unfenced
+                        self.runner.inject_preempt(key)
+                        # store write outside _commit/_adopt: a second
+                        # fenced write = a replay window
+                        job.status.remediation_generation += 1
+                        self.store.update(job)
+
+                    def _commit(self, key, job):
+                        job.status.remediation_generation += 1
+                        self.store.update(job)
+
+                    def _effect_preempt(self, name):
+                        self.runner.inject_preempt(name)
+            """,
+            "controller/other.py": """
+                def poke(sup, key, job):
+                    # engine-private internals are remediation.py-private
+                    sup.remediation._commit(key, job)
+            """,
+        })
+        got = rule_findings(rep, "remediation-discipline")
+        msgs = " | ".join(f.message for f in got)
+        assert len(got) == 4, msgs
+        assert "inject_preempt" in msgs
+        assert "remediation_generation" in msgs
+        assert "_commit()" in msgs
+
+    def test_commit_adopt_and_effectors_are_clean(self, tmp_path):
+        rep = analyze_fixture(tmp_path, {
+            "controller/remediation.py": """
+                class RemediationEngine:
+                    def __init__(self, store, runner):
+                        self.store = store
+                        self.runner = runner
+
+                    def _commit(self, key, job):
+                        job.status.remediation_generation += 1
+                        self.store.update(job)
+
+                    def _adopt(self, key, job):
+                        job.status.remediation_generation += 0
+                        self.store.update(job)
+
+                    def _effect_preempt(self, name):
+                        self.runner.inject_preempt(name)
+
+                    def _delete_excess_workers(self, key, job):
+                        self.runner.delete(key)
+            """,
+        })
+        assert rule_findings(rep, "remediation-discipline") == []
+
+
+# ---------------------------------------------------------------------------
 # waiver syntax
 
 
